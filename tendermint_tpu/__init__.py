@@ -1,0 +1,12 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+Capability surface modeled on Tendermint Core v0.34.20 (see SURVEY.md), but
+re-designed TPU-first: the host control plane (consensus state machine, p2p
+gossip, storage, RPC) is latency-oriented Python/asyncio, while the
+throughput-bound data plane — batch signature verification and hashing for
+vote sets, commits, block sync replay and the light client — runs as vmapped
+JAX kernels on TPU, sharded over a `jax.sharding.Mesh` with a `psum` over the
+pass/fail bitmap.
+"""
+
+__version__ = "0.1.0"

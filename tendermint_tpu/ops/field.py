@@ -1,0 +1,274 @@
+"""GF(2^255 - 19) arithmetic on TPU-friendly int32 limb vectors.
+
+Design notes (TPU-first, not a port):
+
+The reference implements ed25519 via Go's golang.org/x/crypto, verifying one
+signature at a time in a sequential loop (reference:
+types/validator_set.go:680-702, crypto/ed25519/ed25519.go:148).  Here the
+field layer is built for *batched* verification on the TPU VPU: an element of
+GF(2^255-19) is a vector of NLIMB=22 signed int32 limbs in radix 2^12
+(little-endian), and every operation is elementwise over an arbitrary leading
+batch shape, so `vmap` is implicit — a (B, 22) array is B field elements.
+
+Why radix 2^12 / int32:
+  * TPU has no native u64xu64 multiply; int32 multiply-add on the VPU is the
+    fast path.  With limbs < 2^13 (one "lazy" add allowed on top of a carried
+    element), convolution partial products are < 2^26 and a 22-term column
+    sum is < 22 * 2^26 < 2^31, so the schoolbook product never overflows
+    int32.
+  * Signed limbs + arithmetic-shift carries make subtraction free of borrow
+    plumbing: a carried element has limbs in [0, 2^12); a-b has limbs in
+    (-2^12, 2^12) and |partial products| still fit comfortably.
+
+Reduction: 22 limbs * 12 bits = 264 bits, and 2^264 = 2^9 * 2^255 = 9728
+(mod p), so coefficients of weight >= 2^264 fold back with multiplier 9728.
+
+Canonical form is only needed at encode/compare boundaries (`freeze`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+RADIX = 12
+NLIMB = 22
+MASK = (1 << RADIX) - 1
+TOTAL_BITS = RADIX * NLIMB  # 264
+# 2^264 mod p  (p = 2^255 - 19):  2^264 = 2^9 * 2^255 ≡ 2^9 * 19 = 9728
+FOLD = 19 << (TOTAL_BITS - 255)  # 9728
+
+P = (1 << 255) - 19
+
+_i32 = jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# host <-> limb conversion (numpy; used at kernel boundaries only)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (already reduced mod p) -> (NLIMB,) int32 limb array."""
+    x %= P
+    out = np.zeros(NLIMB, dtype=np.int32)
+    for i in range(NLIMB):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0
+    return out
+
+def limbs_to_int(limbs) -> int:
+    """(..., NLIMB) limb array -> Python int (not reduced)."""
+    limbs = np.asarray(limbs)
+    acc = 0
+    for i in reversed(range(NLIMB)):
+        acc = (acc << RADIX) + int(limbs[..., i])
+    return acc
+
+def batch_int_to_limbs(xs) -> np.ndarray:
+    """list[int] -> (B, NLIMB) int32."""
+    out = np.zeros((len(xs), NLIMB), dtype=np.int32)
+    for b, x in enumerate(xs):
+        out[b] = int_to_limbs(x)
+    return out
+
+def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
+    """(..., 32) uint8 little-endian byte arrays -> (..., NLIMB) int32 limbs.
+
+    Vectorized (numpy) — used to stage pubkey/sig point encodings for the
+    device.  The top bit (sign bit of the x-coordinate in ed25519 encodings)
+    is NOT stripped here; callers mask it.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    bits = np.unpackbits(data, axis=-1, bitorder="little")  # (..., 256)
+    pad = np.zeros(bits.shape[:-1] + (TOTAL_BITS - 256,), dtype=bits.dtype)
+    bits = np.concatenate([bits, pad], axis=-1)
+    bits = bits.reshape(bits.shape[:-1] + (NLIMB, RADIX)).astype(np.int32)
+    weights = (1 << np.arange(RADIX, dtype=np.int32))
+    return (bits * weights).sum(axis=-1, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# carries
+# ---------------------------------------------------------------------------
+
+def _carry_chain(c, out_len):
+    """Sequential carry over the last axis; returns (limbs in [0,2^RADIX),
+    carry_out).  Works for signed inputs via arithmetic shifts."""
+    outs = []
+    carry = jnp.zeros_like(c[..., 0])
+    for i in range(c.shape[-1]):
+        v = c[..., i] + carry
+        outs.append(v & MASK)
+        carry = v >> RADIX
+    while len(outs) < out_len:
+        outs.append(carry & MASK)
+        carry = carry >> RADIX
+    return jnp.stack(outs, axis=-1), carry
+
+
+def carry(c):
+    """Fully reduce a (..., NLIMB) signed-limb value to limbs in [0, 2^12).
+
+    Folds the carry-out (weight 2^264 ≡ FOLD mod p) back into the low limbs;
+    two passes guarantee termination for |carry_out| up to ~2^18 since
+    FOLD * carry_out is then < 2^31 and the refold carry is tiny.
+    """
+    limbs, co = _carry_chain(c, NLIMB)
+    # fold carry-out: co * 2^264 ≡ co * FOLD.  |co| can reach ~2^19 (raw
+    # convolution limbs are ~2^30.5), so FOLD*co may overflow int32; split co
+    # into two radix-2^12 digits first (exact for signed co with arithmetic
+    # shift + mask in two's complement).
+    limbs = limbs.at[..., 0].add((co & MASK) * FOLD)
+    limbs = limbs.at[..., 1].add((co >> RADIX) * FOLD)
+    limbs, co2 = _carry_chain(limbs, NLIMB)
+    limbs = limbs.at[..., 0].add(co2 * FOLD)  # |co2| <= 1 here
+    limbs, _ = _carry_chain(limbs, NLIMB)
+    return limbs
+
+
+# ---------------------------------------------------------------------------
+# ring ops
+# ---------------------------------------------------------------------------
+
+def zero(shape=()):
+    return jnp.zeros(shape + (NLIMB,), dtype=_i32)
+
+def one(shape=()):
+    return jnp.zeros(shape + (NLIMB,), dtype=_i32).at[..., 0].set(1)
+
+def add(a, b):
+    """Lazy add: result limbs < 2^13, safe as a mul operand. NOT carried."""
+    return a + b
+
+def add_carried(a, b):
+    return carry(a + b)
+
+def sub(a, b):
+    """Lazy sub: limbs in (-2^13, 2^13), safe as a mul operand."""
+    return a - b
+
+def neg(a):
+    return -a
+
+def mul(a, b):
+    """Field multiply.  Operands may be lazy (|limbs| < 2^13); the result is
+    fully carried (limbs in [0, 2^12))."""
+    B = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, B + (NLIMB,))
+    b = jnp.broadcast_to(b, B + (NLIMB,))
+    # schoolbook convolution: c[k] = sum_{i+j=k} a[i]*b[j], k in [0, 2N-2]
+    c = jnp.zeros(B + (2 * NLIMB - 1,), dtype=_i32)
+    for i in range(NLIMB):
+        c = c.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    return _reduce_wide(c)
+
+def _reduce_wide(c):
+    """Reduce a (..., 2N-1) signed coefficient vector to (..., N) carried."""
+    lo = c[..., :NLIMB]
+    hi = c[..., NLIMB:]
+    # carry the high part first so each high limb is < 2^12 before the
+    # FOLD multiply (9728 * 2^12 < 2^26, overflow-safe when added to lo).
+    hi_l, hi_co = _carry_chain(hi, NLIMB)  # hi has NLIMB-1 coeffs -> padded
+    lo = lo + FOLD * hi_l
+    # hi carry-out has weight 2^264 * 2^264?  No: hi_l is NLIMB limbs of the
+    # high value H (< 2^268), carry-out of its chain has weight 2^264
+    # *relative to H's base 2^264*, i.e. absolute weight 2^528 ≡ FOLD^2.
+    # For our operand bounds H < 2^267 so hi_co < 2^3; FOLD^2 = 9728^2 < 2^27.
+    lo = lo.at[..., 0].add(hi_co * ((FOLD * FOLD) % P & MASK))
+    lo = lo.at[..., 1].add(hi_co * (((FOLD * FOLD) % P) >> RADIX))
+    return carry(lo)
+
+def sqr(a):
+    return mul(a, a)
+
+def mul_small(a, k: int):
+    """Multiply by a small public constant k (|k| < 2^17)."""
+    return carry(a * jnp.int32(k))
+
+
+# ---------------------------------------------------------------------------
+# exponentiation: inversion and sqrt chains
+# ---------------------------------------------------------------------------
+
+def _pow2k(x, k):
+    """x^(2^k) via k squarings inside a fori_loop (keeps the HLO small)."""
+    return jax.lax.fori_loop(0, k, lambda _, v: sqr(v), x)
+
+def invert(a):
+    """a^(p-2) — Fermat inversion.  Standard 255-squaring ladder."""
+    # addition chain for p-2 = 2^255 - 21 (classic curve25519 chain)
+    z2 = sqr(a)                      # 2
+    z8 = _pow2k(z2, 2)               # 8
+    z9 = mul(z8, a)                  # 9
+    z11 = mul(z9, z2)                # 11
+    z22 = sqr(z11)                   # 22
+    z_5_0 = mul(z22, z9)             # 2^5 - 1
+    z_10_0 = mul(_pow2k(z_5_0, 5), z_5_0)
+    z_20_0 = mul(_pow2k(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_pow2k(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_pow2k(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_pow2k(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_pow2k(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_pow2k(z_200_0, 50), z_50_0)
+    return mul(_pow2k(z_250_0, 5), z11)  # 2^255 - 21
+
+def pow_p58(a):
+    """a^((p-5)/8) — used for combined sqrt/division in point decompression.
+    (p-5)/8 = 2^252 - 3."""
+    z2 = sqr(a)
+    z8 = _pow2k(z2, 2)
+    z9 = mul(z8, a)
+    z11 = mul(z9, z2)
+    z22 = sqr(z11)
+    z_5_0 = mul(z22, z9)
+    z_10_0 = mul(_pow2k(z_5_0, 5), z_5_0)
+    z_20_0 = mul(_pow2k(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_pow2k(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_pow2k(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_pow2k(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_pow2k(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_pow2k(z_200_0, 50), z_50_0)
+    return mul(_pow2k(z_250_0, 2), a)  # 2^252 - 3
+
+
+# ---------------------------------------------------------------------------
+# canonicalization / comparison / encoding
+# ---------------------------------------------------------------------------
+
+def _freeze_pass(a):
+    """One pass of quotient-estimate reduction: a (carried, < 2^264) ->
+    a - q*p where q = floor((a+19)/2^255).  Result is >= 0 and within one p
+    of canonical; two passes are exact (after pass one the value is
+    < p + 19*512, for which the estimate q ∈ {0,1} is exact)."""
+    top_shift = 255 - RADIX * (NLIMB - 1)  # bits of limb 21 below 2^255
+    t, co = _carry_chain(a.at[..., 0].add(19), NLIMB)
+    q = (t[..., NLIMB - 1] >> top_shift) + (co << (RADIX - top_shift))
+    # v - q*p = v - q*2^255 + 19q
+    a = a.at[..., 0].add(19 * q)
+    a = a.at[..., NLIMB - 1].add(-(q << top_shift))
+    out, _ = _carry_chain(a, NLIMB)
+    return out
+
+def freeze(a):
+    """Carried (..., N) limbs -> canonical representative in [0, p)."""
+    return _freeze_pass(_freeze_pass(carry(a)))
+
+def eq(a, b):
+    """Exact field equality (handles non-canonical inputs)."""
+    return jnp.all(freeze(a) == freeze(b), axis=-1)
+
+def is_zero(a):
+    return jnp.all(freeze(a) == 0, axis=-1)
+
+def is_neg(a):
+    """'Sign' bit per RFC 8032: lowest bit of the canonical encoding."""
+    return (freeze(a)[..., 0] & 1).astype(jnp.bool_)
+
+def to_bytes_bits(a):
+    """Canonical little-endian 255-bit encoding as (..., 256) bits (jnp).
+    Mostly for tests; production encoding happens host-side."""
+    f = freeze(a)
+    shifts = jnp.arange(RADIX, dtype=_i32)
+    bits = (f[..., :, None] >> shifts[None, :]) & 1  # (..., N, RADIX)
+    return bits.reshape(f.shape[:-1] + (TOTAL_BITS,))[..., :256]

@@ -1,0 +1,137 @@
+"""Oracle tests for GF(2^255-19) limb arithmetic vs Python bignum ints."""
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.ops import field as F
+
+rng = random.Random(0xED25519)
+
+P = F.P
+
+
+def rand_elems(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+SPECIAL = [0, 1, 2, 19, P - 1, P - 2, P - 19, (1 << 255) - 1 - P,  # junk
+           1 << 254, (1 << 255) - 20, P // 2, P // 2 + 1]
+
+
+def test_roundtrip():
+    xs = SPECIAL + rand_elems(64)
+    limbs = F.batch_int_to_limbs(xs)
+    for x, l in zip(xs, limbs):
+        assert F.limbs_to_int(l) == x % P
+
+
+def test_bytes_to_limbs():
+    xs = rand_elems(32) + [0, 1, P - 1]
+    data = np.stack([
+        np.frombuffer((x).to_bytes(32, "little"), dtype=np.uint8) for x in xs
+    ])
+    limbs = F.bytes32_to_limbs_np(data)
+    for x, l in zip(xs, limbs):
+        assert F.limbs_to_int(l) == x
+
+
+@pytest.mark.parametrize("op,pyop", [
+    ("add", lambda a, b: (a + b) % P),
+    ("sub", lambda a, b: (a - b) % P),
+    ("mul", lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    a_int = SPECIAL + rand_elems(52)
+    b_int = rand_elems(len(a_int))
+    a = jnp.asarray(F.batch_int_to_limbs(a_int))
+    b = jnp.asarray(F.batch_int_to_limbs(b_int))
+    if op == "add":
+        out = F.carry(F.add(a, b))
+    elif op == "sub":
+        out = F.carry(F.sub(a, b))
+    else:
+        out = F.mul(a, b)
+    out = np.asarray(out)
+    for i, (x, y) in enumerate(zip(a_int, b_int)):
+        got = F.limbs_to_int(out[i]) % P
+        assert got == pyop(x % P, y % P), (op, i)
+
+
+def test_mul_lazy_operands():
+    """mul must accept one-lazy-add operands (limbs < 2^13) and lazy subs
+    (signed limbs) without overflow."""
+    a_int = rand_elems(32)
+    b_int = rand_elems(32)
+    c_int = rand_elems(32)
+    d_int = rand_elems(32)
+    a = jnp.asarray(F.batch_int_to_limbs(a_int))
+    b = jnp.asarray(F.batch_int_to_limbs(b_int))
+    c = jnp.asarray(F.batch_int_to_limbs(c_int))
+    d = jnp.asarray(F.batch_int_to_limbs(d_int))
+    out = np.asarray(F.mul(F.add(a, b), F.sub(c, d)))
+    for i in range(32):
+        want = ((a_int[i] + b_int[i]) * (c_int[i] - d_int[i])) % P
+        assert F.limbs_to_int(out[i]) % P == want
+
+
+def test_mul_worst_case_limbs():
+    """All-ones worst-case limb magnitudes: limbs at ±(2^13-1)."""
+    hi = np.full((1, F.NLIMB), (1 << 13) - 1, dtype=np.int32)
+    lo = -hi
+    for a_np, b_np in [(hi, hi), (hi, lo), (lo, lo)]:
+        a_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(a_np[0]))
+        b_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(b_np[0]))
+        out = np.asarray(F.mul(jnp.asarray(a_np), jnp.asarray(b_np)))
+        assert F.limbs_to_int(out[0]) % P == (a_val * b_val) % P
+
+
+def test_freeze_and_eq():
+    xs = SPECIAL + rand_elems(20)
+    # construct non-canonical representations: x + k*p in limbs via ints
+    reps = []
+    for x in xs:
+        k = rng.randrange(0, 200)
+        v = x % P + k * P
+        if v < (1 << 264):
+            reps.append(v)
+        else:
+            reps.append(x % P)
+    limbs = np.zeros((len(reps), F.NLIMB), dtype=np.int32)
+    for i, v in enumerate(reps):
+        for j in range(F.NLIMB):
+            limbs[i, j] = v & F.MASK
+            v >>= F.RADIX
+    frozen = np.asarray(F.freeze(jnp.asarray(limbs)))
+    for i, v in enumerate(reps):
+        assert F.limbs_to_int(frozen[i]) == v % P
+    # eq across different representations of the same class
+    a = jnp.asarray(limbs)
+    b = jnp.asarray(F.batch_int_to_limbs([v % P for v in reps]))
+    assert bool(np.all(np.asarray(F.eq(a, b))))
+
+
+def test_invert():
+    xs = [x for x in SPECIAL if x % P != 0] + rand_elems(16)
+    a = jnp.asarray(F.batch_int_to_limbs(xs))
+    inv = np.asarray(F.invert(a))
+    for i, x in enumerate(xs):
+        assert (F.limbs_to_int(inv[i]) * (x % P)) % P == 1
+
+
+def test_pow_p58():
+    xs = rand_elems(8) + [1, 2]
+    a = jnp.asarray(F.batch_int_to_limbs(xs))
+    out = np.asarray(F.pow_p58(a))
+    e = (P - 5) // 8
+    for i, x in enumerate(xs):
+        assert F.limbs_to_int(out[i]) % P == pow(x % P, e, P)
+
+
+def test_is_neg():
+    xs = [1, 2, P - 1, P - 2, 0] + rand_elems(16)
+    a = jnp.asarray(F.batch_int_to_limbs(xs))
+    got = np.asarray(F.is_neg(a))
+    for i, x in enumerate(xs):
+        assert bool(got[i]) == bool((x % P) & 1)
