@@ -7,8 +7,14 @@ signature at a time in a sequential loop (reference:
 types/validator_set.go:680-702, crypto/ed25519/ed25519.go:148).  Here the
 field layer is built for *batched* verification on the TPU VPU: an element of
 GF(2^255-19) is a vector of NLIMB=22 signed int32 limbs in radix 2^12
-(little-endian), and every operation is elementwise over an arbitrary leading
-batch shape, so `vmap` is implicit — a (B, 22) array is B field elements.
+(little-endian), and every operation is elementwise over an arbitrary
+*trailing* batch shape — an array of shape (22, B) is B field elements.
+
+Layout: the limb axis is axis 0 and the batch axes trail, so on TPU the
+batch dimension lands on the 128-wide lane axis and every limb op is a
+full-width VPU op.  (Limb-last would waste 106/128 lanes on the minormost
+axis.)  This "limb-sliced" layout is the classic SIMD bignum design, here
+driven by XLA's fixed (sublane, lane) tiling.
 
 Why radix 2^12 / int32:
   * TPU has no native u64xu64 multiply; int32 multiply-add on the VPU is the
@@ -58,22 +64,22 @@ def int_to_limbs(x: int) -> np.ndarray:
     return out
 
 def limbs_to_int(limbs) -> int:
-    """(..., NLIMB) limb array -> Python int (not reduced)."""
+    """(NLIMB,) limb array -> Python int (not reduced)."""
     limbs = np.asarray(limbs)
     acc = 0
     for i in reversed(range(NLIMB)):
-        acc = (acc << RADIX) + int(limbs[..., i])
+        acc = (acc << RADIX) + int(limbs[i])
     return acc
 
 def batch_int_to_limbs(xs) -> np.ndarray:
-    """list[int] -> (B, NLIMB) int32."""
-    out = np.zeros((len(xs), NLIMB), dtype=np.int32)
+    """list[int] -> (NLIMB, B) int32."""
+    out = np.zeros((NLIMB, len(xs)), dtype=np.int32)
     for b, x in enumerate(xs):
-        out[b] = int_to_limbs(x)
+        out[:, b] = int_to_limbs(x)
     return out
 
 def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
-    """(..., 32) uint8 little-endian byte arrays -> (..., NLIMB) int32 limbs.
+    """(..., 32) uint8 little-endian byte arrays -> (NLIMB, ...) int32 limbs.
 
     Vectorized (numpy) — used to stage pubkey/sig point encodings for the
     device.  The top bit (sign bit of the x-coordinate in ed25519 encodings)
@@ -85,7 +91,8 @@ def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
     bits = np.concatenate([bits, pad], axis=-1)
     bits = bits.reshape(bits.shape[:-1] + (NLIMB, RADIX)).astype(np.int32)
     weights = (1 << np.arange(RADIX, dtype=np.int32))
-    return (bits * weights).sum(axis=-1, dtype=np.int32)
+    limbs_last = (bits * weights).sum(axis=-1, dtype=np.int32)  # (..., NLIMB)
+    return np.moveaxis(limbs_last, -1, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -93,22 +100,22 @@ def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _carry_chain(c, out_len):
-    """Sequential carry over the last axis; returns (limbs in [0,2^RADIX),
+    """Sequential carry over axis 0; returns (limbs in [0,2^RADIX),
     carry_out).  Works for signed inputs via arithmetic shifts."""
     outs = []
-    carry = jnp.zeros_like(c[..., 0])
-    for i in range(c.shape[-1]):
-        v = c[..., i] + carry
+    carry = jnp.zeros_like(c[0])
+    for i in range(c.shape[0]):
+        v = c[i] + carry
         outs.append(v & MASK)
         carry = v >> RADIX
     while len(outs) < out_len:
         outs.append(carry & MASK)
         carry = carry >> RADIX
-    return jnp.stack(outs, axis=-1), carry
+    return jnp.stack(outs, axis=0), carry
 
 
 def carry(c):
-    """Fully reduce a (..., NLIMB) signed-limb value to limbs in [0, 2^12).
+    """Fully reduce a (NLIMB, ...) signed-limb value to limbs in [0, 2^12).
 
     Folds the carry-out (weight 2^264 ≡ FOLD mod p) back into the low limbs;
     two passes guarantee termination for |carry_out| up to ~2^18 since
@@ -119,10 +126,10 @@ def carry(c):
     # convolution limbs are ~2^30.5), so FOLD*co may overflow int32; split co
     # into two radix-2^12 digits first (exact for signed co with arithmetic
     # shift + mask in two's complement).
-    limbs = limbs.at[..., 0].add((co & MASK) * FOLD)
-    limbs = limbs.at[..., 1].add((co >> RADIX) * FOLD)
+    limbs = limbs.at[0].add((co & MASK) * FOLD)
+    limbs = limbs.at[1].add((co >> RADIX) * FOLD)
     limbs, co2 = _carry_chain(limbs, NLIMB)
-    limbs = limbs.at[..., 0].add(co2 * FOLD)  # |co2| <= 1 here
+    limbs = limbs.at[0].add(co2 * FOLD)  # |co2| <= 1 here
     limbs, _ = _carry_chain(limbs, NLIMB)
     return limbs
 
@@ -132,10 +139,10 @@ def carry(c):
 # ---------------------------------------------------------------------------
 
 def zero(shape=()):
-    return jnp.zeros(shape + (NLIMB,), dtype=_i32)
+    return jnp.zeros((NLIMB,) + shape, dtype=_i32)
 
 def one(shape=()):
-    return jnp.zeros(shape + (NLIMB,), dtype=_i32).at[..., 0].set(1)
+    return jnp.zeros((NLIMB,) + shape, dtype=_i32).at[0].set(1)
 
 def add(a, b):
     """Lazy add: result limbs < 2^13, safe as a mul operand. NOT carried."""
@@ -151,32 +158,39 @@ def sub(a, b):
 def neg(a):
     return -a
 
+def _bcast(x, batch):
+    """Broadcast (NLIMB, *b) to (NLIMB, *batch), left-padding batch dims
+    (numpy broadcasting right-aligns, which would misalign the limb axis)."""
+    pad = len(batch) - (x.ndim - 1)
+    x = x.reshape((NLIMB,) + (1,) * pad + x.shape[1:])
+    return jnp.broadcast_to(x, (NLIMB,) + batch)
+
 def mul(a, b):
     """Field multiply.  Operands may be lazy (|limbs| < 2^13); the result is
     fully carried (limbs in [0, 2^12))."""
-    B = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    a = jnp.broadcast_to(a, B + (NLIMB,))
-    b = jnp.broadcast_to(b, B + (NLIMB,))
+    B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    a = _bcast(a, B)
+    b = _bcast(b, B)
     # schoolbook convolution: c[k] = sum_{i+j=k} a[i]*b[j], k in [0, 2N-2]
-    c = jnp.zeros(B + (2 * NLIMB - 1,), dtype=_i32)
+    c = jnp.zeros((2 * NLIMB - 1,) + B, dtype=_i32)
     for i in range(NLIMB):
-        c = c.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+        c = c.at[i : i + NLIMB].add(a[i] * b)
     return _reduce_wide(c)
 
 def _reduce_wide(c):
-    """Reduce a (..., 2N-1) signed coefficient vector to (..., N) carried."""
-    lo = c[..., :NLIMB]
-    hi = c[..., NLIMB:]
+    """Reduce a (2N-1, ...) signed coefficient vector to (N, ...) carried."""
+    lo = c[:NLIMB]
+    hi = c[NLIMB:]
     # carry the high part first so each high limb is < 2^12 before the
     # FOLD multiply (9728 * 2^12 < 2^26, overflow-safe when added to lo).
     hi_l, hi_co = _carry_chain(hi, NLIMB)  # hi has NLIMB-1 coeffs -> padded
     lo = lo + FOLD * hi_l
-    # hi carry-out has weight 2^264 * 2^264?  No: hi_l is NLIMB limbs of the
-    # high value H (< 2^268), carry-out of its chain has weight 2^264
-    # *relative to H's base 2^264*, i.e. absolute weight 2^528 ≡ FOLD^2.
-    # For our operand bounds H < 2^267 so hi_co < 2^3; FOLD^2 = 9728^2 < 2^27.
-    lo = lo.at[..., 0].add(hi_co * ((FOLD * FOLD) % P & MASK))
-    lo = lo.at[..., 1].add(hi_co * (((FOLD * FOLD) % P) >> RADIX))
+    # hi_l is NLIMB limbs of the high value H (< 2^268); the carry-out of its
+    # chain has weight 2^264 *relative to H's base 2^264*, i.e. absolute
+    # weight 2^528 ≡ FOLD^2 mod p.  For our operand bounds H < 2^267 so
+    # hi_co < 2^3; FOLD^2 = 9728^2 < 2^27.
+    lo = lo.at[0].add(hi_co * ((FOLD * FOLD) % P & MASK))
+    lo = lo.at[1].add(hi_co * (((FOLD * FOLD) % P) >> RADIX))
     return carry(lo)
 
 def sqr(a):
@@ -195,9 +209,9 @@ def _pow2k(x, k):
     """x^(2^k) via k squarings inside a fori_loop (keeps the HLO small)."""
     return jax.lax.fori_loop(0, k, lambda _, v: sqr(v), x)
 
-def invert(a):
-    """a^(p-2) — Fermat inversion.  Standard 255-squaring ladder."""
-    # addition chain for p-2 = 2^255 - 21 (classic curve25519 chain)
+def _chain_250(a):
+    """Shared prefix of the classic curve25519 exponent ladder: returns
+    (a^(2^250 - 1), a^11)."""
     z2 = sqr(a)                      # 2
     z8 = _pow2k(z2, 2)               # 8
     z9 = mul(z8, a)                  # 9
@@ -211,25 +225,18 @@ def invert(a):
     z_100_0 = mul(_pow2k(z_50_0, 50), z_50_0)
     z_200_0 = mul(_pow2k(z_100_0, 100), z_100_0)
     z_250_0 = mul(_pow2k(z_200_0, 50), z_50_0)
-    return mul(_pow2k(z_250_0, 5), z11)  # 2^255 - 21
+    return z_250_0, z11
+
+def invert(a):
+    """a^(p-2) — Fermat inversion.  p-2 = 2^255 - 21."""
+    z_250_0, z11 = _chain_250(a)
+    return mul(_pow2k(z_250_0, 5), z11)
 
 def pow_p58(a):
     """a^((p-5)/8) — used for combined sqrt/division in point decompression.
     (p-5)/8 = 2^252 - 3."""
-    z2 = sqr(a)
-    z8 = _pow2k(z2, 2)
-    z9 = mul(z8, a)
-    z11 = mul(z9, z2)
-    z22 = sqr(z11)
-    z_5_0 = mul(z22, z9)
-    z_10_0 = mul(_pow2k(z_5_0, 5), z_5_0)
-    z_20_0 = mul(_pow2k(z_10_0, 10), z_10_0)
-    z_40_0 = mul(_pow2k(z_20_0, 20), z_20_0)
-    z_50_0 = mul(_pow2k(z_40_0, 10), z_10_0)
-    z_100_0 = mul(_pow2k(z_50_0, 50), z_50_0)
-    z_200_0 = mul(_pow2k(z_100_0, 100), z_100_0)
-    z_250_0 = mul(_pow2k(z_200_0, 50), z_50_0)
-    return mul(_pow2k(z_250_0, 2), a)  # 2^252 - 3
+    z_250_0, _ = _chain_250(a)
+    return mul(_pow2k(z_250_0, 2), a)
 
 
 # ---------------------------------------------------------------------------
@@ -242,33 +249,37 @@ def _freeze_pass(a):
     of canonical; two passes are exact (after pass one the value is
     < p + 19*512, for which the estimate q ∈ {0,1} is exact)."""
     top_shift = 255 - RADIX * (NLIMB - 1)  # bits of limb 21 below 2^255
-    t, co = _carry_chain(a.at[..., 0].add(19), NLIMB)
-    q = (t[..., NLIMB - 1] >> top_shift) + (co << (RADIX - top_shift))
+    t, co = _carry_chain(a.at[0].add(19), NLIMB)
+    q = (t[NLIMB - 1] >> top_shift) + (co << (RADIX - top_shift))
     # v - q*p = v - q*2^255 + 19q
-    a = a.at[..., 0].add(19 * q)
-    a = a.at[..., NLIMB - 1].add(-(q << top_shift))
+    a = a.at[0].add(19 * q)
+    a = a.at[NLIMB - 1].add(-(q << top_shift))
     out, _ = _carry_chain(a, NLIMB)
     return out
 
 def freeze(a):
-    """Carried (..., N) limbs -> canonical representative in [0, p)."""
+    """Carried (N, ...) limbs -> canonical representative in [0, p)."""
     return _freeze_pass(_freeze_pass(carry(a)))
 
 def eq(a, b):
     """Exact field equality (handles non-canonical inputs)."""
-    return jnp.all(freeze(a) == freeze(b), axis=-1)
+    return jnp.all(freeze(a) == freeze(b), axis=0)
 
 def is_zero(a):
-    return jnp.all(freeze(a) == 0, axis=-1)
+    return jnp.all(freeze(a) == 0, axis=0)
 
 def is_neg(a):
     """'Sign' bit per RFC 8032: lowest bit of the canonical encoding."""
-    return (freeze(a)[..., 0] & 1).astype(jnp.bool_)
+    return (freeze(a)[0] & 1).astype(jnp.bool_)
+
+def select(cond, a, b):
+    """Elementwise select over the batch: cond has the batch shape."""
+    return jnp.where(cond[None, ...], a, b)
 
 def to_bytes_bits(a):
-    """Canonical little-endian 255-bit encoding as (..., 256) bits (jnp).
+    """Canonical little-endian 255-bit encoding as (256, ...) bits (jnp).
     Mostly for tests; production encoding happens host-side."""
-    f = freeze(a)
-    shifts = jnp.arange(RADIX, dtype=_i32)
-    bits = (f[..., :, None] >> shifts[None, :]) & 1  # (..., N, RADIX)
-    return bits.reshape(f.shape[:-1] + (TOTAL_BITS,))[..., :256]
+    f = freeze(a)  # (N, ...)
+    shifts = jnp.arange(RADIX, dtype=_i32).reshape((1, RADIX) + (1,) * (f.ndim - 1))
+    bits = (f[:, None] >> shifts) & 1  # (N, RADIX, ...)
+    return bits.reshape((TOTAL_BITS,) + f.shape[1:])[:256]

@@ -1,4 +1,7 @@
-"""Oracle tests for GF(2^255-19) limb arithmetic vs Python bignum ints."""
+"""Oracle tests for GF(2^255-19) limb arithmetic vs Python bignum ints.
+
+Layout: limb axis first — a batch of B field elements is (NLIMB, B).
+"""
 import random
 
 import numpy as np
@@ -20,11 +23,16 @@ SPECIAL = [0, 1, 2, 19, P - 1, P - 2, P - 19, (1 << 255) - 1 - P,  # junk
            1 << 254, (1 << 255) - 20, P // 2, P // 2 + 1]
 
 
+def col(limbs, i):
+    """Extract element i from a (NLIMB, B) batch as a (NLIMB,) vector."""
+    return np.asarray(limbs)[:, i]
+
+
 def test_roundtrip():
     xs = SPECIAL + rand_elems(64)
     limbs = F.batch_int_to_limbs(xs)
-    for x, l in zip(xs, limbs):
-        assert F.limbs_to_int(l) == x % P
+    for i, x in enumerate(xs):
+        assert F.limbs_to_int(col(limbs, i)) == x % P
 
 
 def test_bytes_to_limbs():
@@ -32,9 +40,9 @@ def test_bytes_to_limbs():
     data = np.stack([
         np.frombuffer((x).to_bytes(32, "little"), dtype=np.uint8) for x in xs
     ])
-    limbs = F.bytes32_to_limbs_np(data)
-    for x, l in zip(xs, limbs):
-        assert F.limbs_to_int(l) == x
+    limbs = F.bytes32_to_limbs_np(data)  # (NLIMB, B)
+    for i, x in enumerate(xs):
+        assert F.limbs_to_int(col(limbs, i)) == x
 
 
 @pytest.mark.parametrize("op,pyop", [
@@ -55,7 +63,7 @@ def test_binary_ops(op, pyop):
         out = F.mul(a, b)
     out = np.asarray(out)
     for i, (x, y) in enumerate(zip(a_int, b_int)):
-        got = F.limbs_to_int(out[i]) % P
+        got = F.limbs_to_int(col(out, i)) % P
         assert got == pyop(x % P, y % P), (op, i)
 
 
@@ -73,39 +81,32 @@ def test_mul_lazy_operands():
     out = np.asarray(F.mul(F.add(a, b), F.sub(c, d)))
     for i in range(32):
         want = ((a_int[i] + b_int[i]) * (c_int[i] - d_int[i])) % P
-        assert F.limbs_to_int(out[i]) % P == want
+        assert F.limbs_to_int(col(out, i)) % P == want
 
 
 def test_mul_worst_case_limbs():
     """All-ones worst-case limb magnitudes: limbs at ±(2^13-1)."""
-    hi = np.full((1, F.NLIMB), (1 << 13) - 1, dtype=np.int32)
+    hi = np.full((F.NLIMB, 1), (1 << 13) - 1, dtype=np.int32)
     lo = -hi
     for a_np, b_np in [(hi, hi), (hi, lo), (lo, lo)]:
-        a_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(a_np[0]))
-        b_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(b_np[0]))
+        a_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(a_np[:, 0]))
+        b_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(b_np[:, 0]))
         out = np.asarray(F.mul(jnp.asarray(a_np), jnp.asarray(b_np)))
-        assert F.limbs_to_int(out[0]) % P == (a_val * b_val) % P
+        assert F.limbs_to_int(col(out, 0)) % P == (a_val * b_val) % P
 
 
 def test_freeze_and_eq():
     xs = SPECIAL + rand_elems(20)
-    # construct non-canonical representations: x + k*p in limbs via ints
-    reps = []
-    for x in xs:
-        k = rng.randrange(0, 200)
-        v = x % P + k * P
-        if v < (1 << 264):
-            reps.append(v)
-        else:
-            reps.append(x % P)
-    limbs = np.zeros((len(reps), F.NLIMB), dtype=np.int32)
+    # construct non-canonical representations: x + k*p (< 200*2^255 < 2^264)
+    reps = [x % P + rng.randrange(200) * P for x in xs]
+    limbs = np.zeros((F.NLIMB, len(reps)), dtype=np.int32)
     for i, v in enumerate(reps):
         for j in range(F.NLIMB):
-            limbs[i, j] = v & F.MASK
+            limbs[j, i] = v & F.MASK
             v >>= F.RADIX
     frozen = np.asarray(F.freeze(jnp.asarray(limbs)))
     for i, v in enumerate(reps):
-        assert F.limbs_to_int(frozen[i]) == v % P
+        assert F.limbs_to_int(col(frozen, i)) == v % P
     # eq across different representations of the same class
     a = jnp.asarray(limbs)
     b = jnp.asarray(F.batch_int_to_limbs([v % P for v in reps]))
@@ -117,7 +118,7 @@ def test_invert():
     a = jnp.asarray(F.batch_int_to_limbs(xs))
     inv = np.asarray(F.invert(a))
     for i, x in enumerate(xs):
-        assert (F.limbs_to_int(inv[i]) * (x % P)) % P == 1
+        assert (F.limbs_to_int(col(inv, i)) * (x % P)) % P == 1
 
 
 def test_pow_p58():
@@ -126,7 +127,7 @@ def test_pow_p58():
     out = np.asarray(F.pow_p58(a))
     e = (P - 5) // 8
     for i, x in enumerate(xs):
-        assert F.limbs_to_int(out[i]) % P == pow(x % P, e, P)
+        assert F.limbs_to_int(col(out, i)) % P == pow(x % P, e, P)
 
 
 def test_is_neg():
@@ -135,3 +136,27 @@ def test_is_neg():
     got = np.asarray(F.is_neg(a))
     for i, x in enumerate(xs):
         assert bool(got[i]) == bool((x % P) & 1)
+
+
+def test_unbatched_scalar_shape():
+    """Ops must also work on a single (NLIMB,) element (empty batch shape)."""
+    x, y = rand_elems(2)
+    a = jnp.asarray(F.int_to_limbs(x))
+    b = jnp.asarray(F.int_to_limbs(y))
+    assert F.limbs_to_int(np.asarray(F.mul(a, b))) % P == (x * y) % P
+    assert bool(np.asarray(F.eq(a, a)))
+
+
+@pytest.mark.parametrize("bsize", [3, F.NLIMB])
+def test_scalar_times_batch_broadcast(bsize):
+    """A (NLIMB,) constant times a (NLIMB, B) batch must broadcast over the
+    batch — including the B == NLIMB trap where right-aligned broadcasting
+    would silently transpose limbs."""
+    c = rand_elems(1)[0]
+    xs = rand_elems(bsize)
+    a = jnp.asarray(F.int_to_limbs(c))
+    b = jnp.asarray(F.batch_int_to_limbs(xs))
+    for out in (np.asarray(F.mul(a, b)), np.asarray(F.mul(b, a))):
+        assert out.shape == (F.NLIMB, bsize)
+        for i, x in enumerate(xs):
+            assert F.limbs_to_int(col(out, i)) % P == (c * x) % P
