@@ -9,4 +9,21 @@ JAX kernels on TPU, sharded over a `jax.sharding.Mesh` with a `psum` over the
 pass/fail bitmap.
 """
 
+import os as _os
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Opt in to JAX's persistent compilation cache (the verify kernel costs
+    minutes of XLA compile per shape/platform).  Must run before jax is
+    imported to take effect via env vars; no-op on backends whose compile
+    path bypasses the persistent cache (e.g. remote-compile tunnels).
+    """
+    d = cache_dir or _os.path.expanduser("~/.cache/jax_comp")
+    _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+
+enable_compilation_cache()
+
 __version__ = "0.1.0"

@@ -1,0 +1,127 @@
+"""Pure-Python ed25519 (RFC 8032) — host-side signing and CPU fallback.
+
+Signing is latency-bound, low-volume control-plane work (a validator signs
+one vote per step, reference: privval/file.go:254), so it stays host-side;
+the batched TPU kernel (ops/ed25519.py) is the verification data plane.
+This module is also the independent oracle for kernel tests (alongside the
+OpenSSL-backed `cryptography` package).
+
+Bignum arithmetic throughout — clarity over speed.
+"""
+from __future__ import annotations
+
+import hashlib
+
+P = (1 << 255) - 19
+L = (1 << 252) + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+BY = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int):
+    """x from y per RFC 8032 §5.1.3; None if no square root exists or
+    x == 0 with sign == 1."""
+    xx = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    x = pow(xx, (P + 3) // 8, P)
+    if (x * x - xx) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - xx) % P != 0:
+        return None
+    if x == 0 and sign == 1:
+        return None
+    if x % 2 != sign:
+        x = P - x
+    return x
+
+
+BX = _recover_x(BY, 0)
+BASE = (BX, BY, 1, BX * BY % P)  # extended coords
+IDENT = (0, 1, 1, 0)
+
+
+def _add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 % P * D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _dbl(p):
+    return _add(p, p)
+
+
+def _mul(s: int, p):
+    q = IDENT
+    while s:
+        if s & 1:
+            q = _add(q, p)
+        p = _dbl(p)
+        s >>= 1
+    return q
+
+
+def _encode(p) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    x, y = x * zi % P, y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decode(s: bytes):
+    if len(s) != 32:
+        return None
+    v = int.from_bytes(s, "little")
+    y = v & ((1 << 255) - 1)  # non-canonical y accepted (reduced), as in Go
+    sign = v >> 255
+    x = _recover_x(y % P, sign)
+    if x is None:
+        return None
+    y %= P
+    return (x, y, 1, x * y % P)
+
+
+def pubkey_from_seed(seed: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return _encode(_mul(a, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 Ed25519 signature with the 32-byte private seed."""
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    pub = _encode(_mul(a, BASE))
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    rb = _encode(_mul(r, BASE))
+    k = int.from_bytes(hashlib.sha512(rb + pub + msg).digest(), "little") % L
+    s = (r + k * a) % L
+    return rb + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Cofactorless verify, matching Go crypto/ed25519 semantics."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    a = _decode(pub)
+    if a is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(),
+                       "little") % L
+    # encode([s]B + [k](-A)) must equal R byte-for-byte
+    neg_a = (P - a[0], a[1], 1, (P - a[0]) * a[1] % P)
+    rp = _add(_mul(s, BASE), _mul(k, neg_a))
+    return _encode(rp) == sig[:32]

@@ -1,0 +1,105 @@
+"""BatchVerifier — the TPU signature-verification data plane.
+
+The reference at v0.34.20 has no batch verifier; every call site verifies
+serially through crypto.PubKey.VerifySignature (reference
+crypto/crypto.go:22-28, hot loops types/validator_set.go:680-702 and
+blocksync/reactor.go:375).  This is the new component the build introduces:
+call sites enqueue (pubkey, msg, sig) triples and get back an exact
+per-triple validity bitmap, computed in one batched TPU kernel launch
+(one signature per vector lane; see ops/ed25519.py).
+
+Routing policy (BASELINE.md config 5 / SURVEY.md §7 hard part 5): tiny
+batches are latency-bound and stay on the host CPU (OpenSSL); batches of at
+least `tpu_threshold` go to the device kernel.  Mixed key types dispatch
+per-scheme sub-batches and merge bitmaps by original index.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from . import PubKey
+from . import ed25519 as ed
+
+
+def _use_device() -> bool:
+    return os.environ.get("TM_TPU_DISABLE_BATCH", "") != "1"
+
+
+@dataclass
+class _Item:
+    pub: PubKey
+    msg: bytes
+    sig: bytes
+
+
+class BatchVerifier:
+    """Collect (pubkey, msg, sig) triples; verify them in one batch.
+
+    Semantics match the reference's check-all commit verification
+    (types/validator_set.go:657-661): every triple is verified exactly and
+    independently — no early exit, no probabilistic batch equation — so the
+    returned bitmap identifies offenders directly.
+    """
+
+    def __init__(self, tpu_threshold: int = 32):
+        self._items: List[_Item] = []
+        self.tpu_threshold = tpu_threshold
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, pub: PubKey, msg: bytes, sig: bytes) -> None:
+        self._items.append(_Item(pub, bytes(msg), bytes(sig)))
+
+    def verify(self) -> Tuple[bool, np.ndarray]:
+        """Returns (all_valid, per-item bool bitmap, in insertion order)."""
+        n = len(self._items)
+        if n == 0:
+            return True, np.zeros(0, dtype=bool)
+        out = np.zeros(n, dtype=bool)
+        # dispatch per key scheme
+        by_type: dict = {}
+        for i, it in enumerate(self._items):
+            by_type.setdefault(it.pub.type_name, []).append(i)
+        for tname, idxs in by_type.items():
+            items = [self._items[i] for i in idxs]
+            if (tname == ed.KEY_TYPE and _use_device()
+                    and len(items) >= self.tpu_threshold):
+                bits = verify_ed25519_batch(
+                    [it.pub.bytes() for it in items],
+                    [it.msg for it in items],
+                    [it.sig for it in items])
+            else:
+                bits = np.array([
+                    it.pub.verify_signature(it.msg, it.sig) for it in items])
+            out[np.asarray(idxs)] = bits
+        return bool(out.all()), out
+
+
+def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],
+                         sigs: Sequence[bytes]) -> np.ndarray:
+    """Raw-bytes ed25519 batch verify on the device (malformed lengths are
+    rejected host-side without poisoning the batch)."""
+    n = len(pubkeys)
+    ok_len = np.array([
+        len(pubkeys[i]) == 32 and len(sigs[i]) == 64 for i in range(n)])
+    if not ok_len.all():
+        good = np.flatnonzero(ok_len)
+        if good.size == 0:
+            return ok_len
+        sub = verify_ed25519_batch([pubkeys[i] for i in good],
+                                   [msgs[i] for i in good],
+                                   [sigs[i] for i in good])
+        out = np.zeros(n, dtype=bool)
+        out[good] = sub
+        return out
+    return ed_ops_verify(pubkeys, msgs, sigs)
+
+
+def ed_ops_verify(pubkeys, msgs, sigs) -> np.ndarray:
+    from tendermint_tpu.ops import ed25519 as edops
+    return edops.verify_batch(pubkeys, msgs, sigs)

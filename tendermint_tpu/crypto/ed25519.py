@@ -1,0 +1,99 @@
+"""Ed25519 keys (reference crypto/ed25519/ed25519.go).
+
+Key/signature wire formats match the reference exactly: 32-byte public key,
+64-byte private key (seed || pubkey, Go crypto/ed25519 layout), 64-byte
+signature, address = SHA-256(pubkey)[:20].
+
+`PubKey.verify_signature` is the single-item path (host CPU, OpenSSL when
+available).  The throughput path is crypto/batch.py, which coalesces triples
+and runs the TPU kernel (ops/ed25519.py).
+"""
+from __future__ import annotations
+
+import os
+
+from . import PrivKey as _PrivKey, PubKey as _PubKey
+from . import _edref
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64
+SIGNATURE_SIZE = 64
+
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _OsslPriv, Ed25519PublicKey as _OsslPub)
+    from cryptography.exceptions import InvalidSignature as _InvalidSignature
+    _HAVE_OSSL = True
+except ImportError:  # pragma: no cover
+    _HAVE_OSSL = False
+
+
+def _ossl_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    try:
+        _OsslPub.from_public_bytes(pub).verify(sig, msg)
+        return True
+    except (_InvalidSignature, ValueError):
+        return False
+
+
+class PubKey(_PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    @property
+    def type_name(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        if _HAVE_OSSL:
+            return _ossl_verify(self._bytes, msg, sig)
+        return _edref.verify(self._bytes, msg, sig)
+
+    def __repr__(self):
+        return f"PubKeyEd25519({self._bytes.hex()})"
+
+
+class PrivKey(_PrivKey):
+    __slots__ = ("_seed", "_pub")
+
+    def __init__(self, data: bytes):
+        """Accepts the 64-byte Go layout (seed || pub) or a 32-byte seed."""
+        if len(data) == PRIVKEY_SIZE:
+            self._seed = bytes(data[:32])
+            self._pub = bytes(data[32:])
+            if _edref.pubkey_from_seed(self._seed) != self._pub:
+                raise ValueError("ed25519 privkey: pubkey half mismatch")
+        elif len(data) == 32:
+            self._seed = bytes(data)
+            self._pub = _edref.pubkey_from_seed(self._seed)
+        else:
+            raise ValueError("ed25519 privkey must be 32 or 64 bytes")
+
+    @classmethod
+    def generate(cls) -> "PrivKey":
+        return cls(os.urandom(32))
+
+    def bytes(self) -> bytes:
+        return self._seed + self._pub
+
+    @property
+    def type_name(self) -> str:
+        return KEY_TYPE
+
+    def sign(self, msg: bytes) -> bytes:
+        if _HAVE_OSSL:
+            return _OsslPriv.from_private_bytes(self._seed).sign(msg)
+        return _edref.sign(self._seed, msg)
+
+    def pub_key(self) -> PubKey:
+        return PubKey(self._pub)

@@ -1,0 +1,138 @@
+"""RFC-6962-style merkle tree (reference crypto/merkle/tree.go, hash.go).
+
+Domain separation: leaf = SHA-256(0x00 || data), inner = SHA-256(0x01 || L
+|| R); empty tree hashes to SHA-256("").  Split point is the largest power
+of two strictly less than n (reference crypto/merkle/tree.go:92).
+
+Host-side (hashlib) implementation; the batched TPU tree-hash kernel for
+large leaf sets plugs in behind the same functions later (SURVEY.md §7
+native-component ledger item 4).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def leaf_hash(data: bytes) -> bytes:
+    return _sha256(_LEAF_PREFIX + data)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(_INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two < n."""
+    b = 1 << (n - 1).bit_length() - 1 if n > 1 else 0
+    if b == n:
+        b >>= 1
+    return b
+
+
+def hash_from_byte_slices(items: List[bytes]) -> bytes:
+    """Root hash of a list of byte slices (reference crypto/merkle/tree.go:9)."""
+    n = len(items)
+    if n == 0:
+        return _sha256(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]),
+                      hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (reference crypto/merkle/proof.go)."""
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes]
+
+    def compute_root(self) -> bytes:
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash,
+                                   self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or self.index >= self.total:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = self.compute_root()
+        return computed is not None and computed == root
+
+
+def _compute_from_aunts(index: int, total: int, leaf: bytes,
+                        aunts: List[bytes]) -> Optional[bytes]:
+    if total == 0 or index >= total:
+        return None
+    if total == 1:
+        return leaf if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, leaf, aunts[:-1])
+        return None if left is None else inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    return None if right is None else inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: List[bytes]):
+    """(root, [Proof]) for every item (reference crypto/merkle/proof.go:52)."""
+    trails, root_node = _trails_from_byte_slices(items)
+    root = root_node.hash if root_node else _sha256(b"")
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(Proof(total=len(items), index=i,
+                            leaf_hash=trail.hash,
+                            aunts=trail.flatten_aunts()))
+    return root, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h):
+        self.hash = h
+        self.parent = None
+        self.left = None   # sibling hash on the left
+        self.right = None  # sibling hash on the right
+
+    def flatten_aunts(self) -> List[bytes]:
+        out = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                out.append(node.left)
+            elif node.right is not None:
+                out.append(node.right)
+            node = node.parent
+        return out
+
+
+def _trails_from_byte_slices(items):
+    n = len(items)
+    if n == 0:
+        return [], None
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root.hash
+    right_root.parent = root
+    right_root.left = left_root.hash
+    return lefts + rights, root

@@ -1,0 +1,100 @@
+"""Minimal deterministic protobuf (proto3 + gogoproto) wire encoder.
+
+The framework does not need a general protobuf stack; it needs *bit-exact*
+canonical serialization for sign bytes and hashing (reference
+types/canonical.go:56, types/vote.go:93-96, spec/core/encoding.md).  This
+module provides the handful of wire primitives those encodings use, with
+proto3 zero-omission semantics matching the reference's generated gogo
+marshalers (proto/tendermint/types/canonical.pb.go:517-567):
+
+  * varint / sfixed64 / length-delimited wire types
+  * fields omitted when zero, except gogoproto non-nullable embedded
+    messages which are always emitted (callers use *_always variants)
+  * fields emitted in ascending field-number order (callers' duty)
+
+Also the uvarint length-delimited framing used by sign bytes and the WAL
+(reference libs/protoio/writer.go).
+"""
+from __future__ import annotations
+
+
+def uvarint(v: int) -> bytes:
+    if v < 0:
+        raise ValueError("uvarint needs v >= 0")
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def varint(v: int) -> bytes:
+    """Protobuf varint of an int64 (negative -> 10-byte two's complement)."""
+    if v < 0:
+        v += 1 << 64
+    return uvarint(v)
+
+
+def tag(field_num: int, wire_type: int) -> bytes:
+    return uvarint((field_num << 3) | wire_type)
+
+
+# wire types
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_BYTES = 2
+WT_FIXED32 = 5
+
+
+def varint_field(field_num: int, v: int) -> bytes:
+    """int32/int64/uint64/enum field; omitted when zero (proto3)."""
+    if v == 0:
+        return b""
+    return tag(field_num, WT_VARINT) + varint(v)
+
+
+def sfixed64_field(field_num: int, v: int) -> bytes:
+    if v == 0:
+        return b""
+    if v < 0:
+        v += 1 << 64
+    return tag(field_num, WT_FIXED64) + v.to_bytes(8, "little")
+
+
+def bytes_field(field_num: int, data: bytes) -> bytes:
+    if not data:
+        return b""
+    return tag(field_num, WT_BYTES) + uvarint(len(data)) + data
+
+
+def string_field(field_num: int, s: str) -> bytes:
+    return bytes_field(field_num, s.encode("utf-8"))
+
+
+def message_field(field_num: int, encoded: bytes) -> bytes:
+    """Nullable embedded message: omitted when `encoded` is None (nil
+    pointer in Go).  An *empty but present* message still emits its tag."""
+    if encoded is None:
+        return b""
+    return tag(field_num, WT_BYTES) + uvarint(len(encoded)) + encoded
+
+
+def message_field_always(field_num: int, encoded: bytes) -> bytes:
+    """gogoproto non-nullable embedded message: always emitted."""
+    return tag(field_num, WT_BYTES) + uvarint(len(encoded)) + encoded
+
+
+def timestamp_msg(seconds: int, nanos: int) -> bytes:
+    """google.protobuf.Timestamp body {int64 seconds=1; int32 nanos=2}."""
+    return varint_field(1, seconds) + varint_field(2, nanos)
+
+
+def length_delimited(msg: bytes) -> bytes:
+    """protoio.MarshalDelimited framing: uvarint(len) || msg (reference
+    libs/protoio/writer.go, used for sign bytes at types/vote.go:94-96)."""
+    return uvarint(len(msg)) + msg
+
+
+def repeated_message_field(field_num: int, encoded_list) -> bytes:
+    return b"".join(message_field_always(field_num, e) for e in encoded_list)

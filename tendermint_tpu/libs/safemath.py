@@ -1,0 +1,31 @@
+"""Int64 clip/overflow arithmetic matching Go semantics (reference
+libs/math/safemath.go).  Python ints are unbounded, so the int64 wrap/clip
+behavior the proposer-priority algorithm depends on is made explicit here.
+"""
+
+INT64_MAX = (1 << 63) - 1
+INT64_MIN = -(1 << 63)
+
+
+def safe_add_clip(a: int, b: int) -> int:
+    v = a + b
+    return INT64_MAX if v > INT64_MAX else INT64_MIN if v < INT64_MIN else v
+
+
+def safe_sub_clip(a: int, b: int) -> int:
+    v = a - b
+    return INT64_MAX if v > INT64_MAX else INT64_MIN if v < INT64_MIN else v
+
+
+def safe_mul(a: int, b: int):
+    """(product, overflowed) like the reference's safeMul."""
+    v = a * b
+    if v > INT64_MAX or v < INT64_MIN:
+        return 0, True
+    return v, False
+
+
+def trunc_div(a: int, b: int) -> int:
+    """Go integer division: truncates toward zero (Python // floors)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q

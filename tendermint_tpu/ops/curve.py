@@ -1,0 +1,232 @@
+"""Edwards25519 group operations, batched over the TPU lane axis.
+
+TPU-first design (not a port): the reference verifies signatures one at a
+time through Go's crypto/ed25519 (reference: crypto/ed25519/ed25519.go:148,
+called serially from types/validator_set.go:680-702).  Here every group op
+acts on a *batch* of points — each coordinate is a (NLIMB, *batch) int32 limb
+array (see ops/field.py for the layout rationale) — so one `dbl` is B point
+doublings across the vector lanes.
+
+Representations (standard extended/cached/niels trio for a = -1 twisted
+Edwards, after Hisil-Wong-Carter-Dawson 2008):
+
+  * extended  (X, Y, Z, T)       with x = X/Z, y = Y/Z, T = XY/Z
+  * cached    (Y+X, Y-X, Z, 2dT) — precomputed form for general addition
+  * niels     (y+x, y-x, 2dxy)   — cached with Z = 1, for fixed-base tables
+
+Formula safety: ops/field.py's `mul` accepts operands with |limb| < 2^13
+(one lazy add/sub on top of a carried value).  Sums that can exceed that
+bound (e.g. 2Z^2 + (B - A)) are explicitly `carry`d below; each site notes
+its bound.
+
+Curve constants are computed in Python bignum at import time.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import field as F
+
+P = F.P
+
+# d = -121665/121666 mod p
+D_INT = (-121665 * pow(121666, P - 2, P)) % P
+D2_INT = (2 * D_INT) % P
+# sqrt(-1) = 2^((p-1)/4)
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+
+# base point: y = 4/5, x chosen even (RFC 8032)
+BY_INT = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x_int(y: int, sign: int) -> int:
+    """Python bignum x-recovery (used for import-time table construction)."""
+    xx = (y * y - 1) * pow(D_INT * y * y + 1, P - 2, P) % P
+    x = pow(xx, (P + 3) // 8, P)
+    if (x * x - xx) % P != 0:
+        x = x * SQRT_M1_INT % P
+    if (x * x - xx) % P != 0:
+        raise ValueError("not a square")
+    if x % 2 != sign:
+        x = P - x
+    return x
+
+
+BX_INT = _recover_x_int(BY_INT, 0)
+
+_d = jnp.asarray(F.int_to_limbs(D_INT))
+_d2 = jnp.asarray(F.int_to_limbs(D2_INT))
+_sqrt_m1 = jnp.asarray(F.int_to_limbs(SQRT_M1_INT))
+
+
+class Ext(NamedTuple):
+    """Extended coordinates (X : Y : Z : T), T = XY/Z."""
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+class Cached(NamedTuple):
+    """(Y+X, Y-X, Z, 2dT) — addition-ready form of an extended point."""
+    ypx: jnp.ndarray
+    ymx: jnp.ndarray
+    z: jnp.ndarray
+    t2d: jnp.ndarray
+
+
+class Niels(NamedTuple):
+    """(y+x, y-x, 2dxy) — affine cached form (Z = 1), for static tables."""
+    ypx: jnp.ndarray
+    ymx: jnp.ndarray
+    t2d: jnp.ndarray
+
+
+def identity(batch=()):
+    return Ext(F.zero(batch), F.one(batch), F.one(batch), F.zero(batch))
+
+
+def to_cached(p: Ext) -> Cached:
+    return Cached(
+        F.carry(p.y + p.x),
+        F.carry(p.y - p.x),
+        p.z,
+        F.mul(p.t, _d2),
+    )
+
+
+def point_from_ints(x: int, y: int, batch=()) -> Ext:
+    """Import-time constructor from affine bignum coordinates."""
+    xl = jnp.broadcast_to(
+        jnp.asarray(F.int_to_limbs(x)).reshape((F.NLIMB,) + (1,) * len(batch)),
+        (F.NLIMB,) + batch)
+    yl = jnp.broadcast_to(
+        jnp.asarray(F.int_to_limbs(y)).reshape((F.NLIMB,) + (1,) * len(batch)),
+        (F.NLIMB,) + batch)
+    t = jnp.asarray(F.int_to_limbs(x * y % P))
+    tl = jnp.broadcast_to(
+        t.reshape((F.NLIMB,) + (1,) * len(batch)), (F.NLIMB,) + batch)
+    return Ext(xl, yl, jnp.ones_like(xl).at[1:].set(0), tl)
+
+
+# ---------------------------------------------------------------------------
+# group law
+# ---------------------------------------------------------------------------
+
+def dbl(p: Ext) -> Ext:
+    """Point doubling (dbl-2008-hwcd, a = -1); ignores T of the input."""
+    a = F.sqr(p.x)
+    b = F.sqr(p.y)
+    zsq = F.sqr(p.z)
+    c = zsq + zsq                        # lazy: |limb| < 2^13
+    aa = F.sqr(p.x + p.y)                # (X+Y)^2, operand lazy-add: ok
+    e = aa - a - b                       # limbs in (-2^13, 2^12): ok as operand
+    g = b - a                            # lazy sub: ok
+    f = F.carry(g - c)                   # |g - c| can reach 2^12 + 2^13: carry
+    h = -a - b                           # limbs in (-2^13, 0]: ok
+    return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def add_cached(p: Ext, q: Cached) -> Ext:
+    """Unified addition p + q (add-2008-hwcd-3, a = -1).  Handles doubling
+    and the identity correctly (complete for odd-order inputs)."""
+    a = F.mul(p.y + p.x, q.ypx)
+    b = F.mul(p.y - p.x, q.ymx)
+    c = F.mul(p.t, q.t2d)
+    d = F.mul(p.z, q.z)
+    d2 = d + d                           # lazy: |limb| < 2^13
+    e = a - b                            # lazy: ok
+    f = d2 - c                           # limbs in (-2^12, 2^13): ok
+    g = F.carry(d2 + c)                  # can reach 2^13 + 2^12: carry
+    h = a + b                            # lazy: ok
+    return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def madd_niels(p: Ext, q: Niels) -> Ext:
+    """p + q with q in niels form (Z2 = 1): saves the Z1*Z2 multiply."""
+    a = F.mul(p.y + p.x, q.ypx)
+    b = F.mul(p.y - p.x, q.ymx)
+    c = F.mul(p.t, q.t2d)
+    d2 = p.z + p.z                       # lazy
+    e = a - b
+    f = d2 - c
+    g = F.carry(d2 + c)
+    h = a + b
+    return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def neg_cached(q: Cached) -> Cached:
+    """-q: swap (Y+X, Y-X), negate 2dT (negated carried limbs stay in
+    (-2^12, 0], a valid lazy operand)."""
+    return Cached(q.ymx, q.ypx, q.z, -q.t2d)
+
+
+def cond_neg_cached(q: Cached, neg) -> Cached:
+    """Elementwise: -q where `neg` (batch-shaped bool), else q."""
+    return Cached(
+        F.select(neg, q.ymx, q.ypx),
+        F.select(neg, q.ypx, q.ymx),
+        q.z,
+        F.select(neg, -q.t2d, q.t2d),
+    )
+
+
+def cond_neg_niels(q: Niels, neg) -> Niels:
+    return Niels(
+        F.select(neg, q.ymx, q.ypx),
+        F.select(neg, q.ypx, q.ymx),
+        F.select(neg, -q.t2d, q.t2d),
+    )
+
+
+# ---------------------------------------------------------------------------
+# decompress / encode
+# ---------------------------------------------------------------------------
+
+def decompress(y_limbs, sign_bit):
+    """RFC 8032 §5.1.3 point decompression, batched.
+
+    y_limbs: (NLIMB, *batch) limbs of the y encoding with the sign bit
+    already masked off; sign_bit: batch-shaped int32/bool (bit 255 of the
+    encoding).  Returns (Ext point, ok: batch bool).
+
+    Semantics match Go crypto/ed25519 (the reference's verifier,
+    crypto/ed25519/ed25519.go:148 → filippo.io/edwards25519 SetBytes):
+    non-canonical y (y >= p) is accepted and reduced; x == 0 with sign = 1
+    ("negative zero") is rejected; non-square x^2 is rejected.
+    """
+    sign_bit = jnp.asarray(sign_bit, dtype=jnp.bool_)
+    y = F.carry(y_limbs)
+    yy = F.sqr(y)
+    one = F.one(yy.shape[1:])
+    u = yy - one                         # lazy
+    v = F.carry(F.mul(yy, _d) + one)     # d*y^2 + 1 (carry the lazy add)
+    v3 = F.mul(F.sqr(v), v)
+    v7 = F.mul(F.sqr(v3), v)
+    uv7 = F.mul(u, v7)
+    # x = u * v^3 * (u * v^7)^((p-5)/8)
+    x = F.mul(F.mul(u, v3), F.pow_p58(uv7))
+    vxx = F.mul(v, F.sqr(x))
+    ok_plus = F.eq(vxx, F.carry(u))          # v*x^2 == u
+    ok_minus = F.eq(vxx, F.carry(-u))        # v*x^2 == -u  -> x *= sqrt(-1)
+    x = F.select(ok_minus, F.mul(x, _sqrt_m1), x)
+    ok = ok_plus | ok_minus
+    x_is_zero = F.is_zero(x)
+    ok = ok & ~(x_is_zero & sign_bit)        # reject "negative zero"
+    # match requested sign
+    x = F.select(F.is_neg(x) != sign_bit, F.carry(-x), x)
+    t = F.mul(x, y)
+    return Ext(x, y, F.one(y.shape[1:]), t), ok
+
+
+def encode_bits(p: Ext):
+    """Canonical 256-bit little-endian encoding of an extended point as a
+    (256, *batch) int32 0/1 array: bits 0..254 = y, bit 255 = sign(x)."""
+    zinv = F.invert(p.z)
+    x = F.mul(p.x, zinv)
+    y = F.mul(p.y, zinv)
+    bits = F.to_bytes_bits(y)
+    return bits.at[255].set(F.is_neg(x).astype(bits.dtype))

@@ -263,7 +263,8 @@ def freeze(a):
 
 def eq(a, b):
     """Exact field equality (handles non-canonical inputs)."""
-    return jnp.all(freeze(a) == freeze(b), axis=0)
+    B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    return jnp.all(_bcast(freeze(a), B) == _bcast(freeze(b), B), axis=0)
 
 def is_zero(a):
     return jnp.all(freeze(a) == 0, axis=0)
@@ -274,7 +275,9 @@ def is_neg(a):
 
 def select(cond, a, b):
     """Elementwise select over the batch: cond has the batch shape."""
-    return jnp.where(cond[None, ...], a, b)
+    B = jnp.broadcast_shapes(jnp.shape(cond), a.shape[1:], b.shape[1:])
+    return jnp.where(jnp.broadcast_to(cond, B)[None, ...],
+                     _bcast(a, B), _bcast(b, B))
 
 def to_bytes_bits(a):
     """Canonical little-endian 255-bit encoding as (256, ...) bits (jnp).

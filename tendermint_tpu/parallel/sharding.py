@@ -1,0 +1,67 @@
+"""Mesh sharding for the verification data plane.
+
+The reference scales by *process-level* state-machine replication over a
+gossip network (SURVEY.md §2.6); it has no accelerator collectives.  The TPU
+build adds a true data-parallel axis the reference lacks: a verification
+batch (pubkey/sig/digit arrays) sharded across a `jax.sharding.Mesh`, with
+XLA inserting the collectives — an all-gather of the per-lane bitmap and a
+`psum`-style reduction for the commit-level all-valid bit — over ICI
+(intra-pod) or DCN (multi-host).  This is the analog of the reference's
+blocksync fan-out (blocksync/pool.go:374), but over chips instead of peers.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tendermint_tpu.ops import ed25519 as edops
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(devices=None, axis: str = BATCH_AXIS) -> Mesh:
+    import numpy as np
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
+    """Returns a jitted verify over `mesh`: inputs batch-sharded on their
+    last axis, output (bitmap, all_valid) with the bitmap batch-sharded and
+    the all-valid bit replicated (XLA lowers the jnp.all to a psum over the
+    mesh axis)."""
+    shard_last = {
+        "a_y": NamedSharding(mesh, P(None, axis)),
+        "a_sign": NamedSharding(mesh, P(axis)),
+        "r_bits": NamedSharding(mesh, P(None, axis)),
+        "s_digits": NamedSharding(mesh, P(None, axis)),
+        "k_digits": NamedSharding(mesh, P(None, axis)),
+    }
+
+    def step(a_y, a_sign, r_bits, s_digits, k_digits):
+        bitmap = edops.verify_impl(a_y, a_sign, r_bits, s_digits, k_digits)
+        return bitmap, jnp.all(bitmap)
+
+    jitted = jax.jit(
+        step,
+        in_shardings=tuple(shard_last[k] for k in (
+            "a_y", "a_sign", "r_bits", "s_digits", "k_digits")),
+        out_shardings=(NamedSharding(mesh, P(axis)),
+                       NamedSharding(mesh, P())),
+    )
+
+    def run(dev_arrays: dict):
+        n = dev_arrays["a_sign"].shape[0]
+        nshard = mesh.devices.size
+        nb = -(-n // nshard) * nshard
+        nb = max(nb, nshard)
+        padded = edops._pad_dev(dict(dev_arrays), n, nb)
+        bitmap, _ = jitted(padded["a_y"], padded["a_sign"], padded["r_bits"],
+                           padded["s_digits"], padded["k_digits"])
+        import numpy as np
+        return np.asarray(bitmap)[:n]
+
+    return jitted, run

@@ -1,0 +1,130 @@
+"""Core wire-level types: timestamps, block IDs, part-set headers, enums.
+
+Wire formats are bit-exact with the reference's protobuf encodings
+(proto/tendermint/types/types.proto, canonical.pb.go) — signatures and
+hashes must reproduce identically or consensus forks (SURVEY.md §7 hard
+part 4).
+
+Time is kept as raw (seconds, nanos) integers — no Go time.Time semantics,
+no Python datetime in the hot path.  The Go zero time (year 1) marshals to
+seconds = -62135596800, which matters for hashing commits containing absent
+signatures.
+"""
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from tendermint_tpu.libs import protoenc as pe
+
+# Go time.Time{}.Unix()
+GO_ZERO_TIME_SECONDS = -62135596800
+
+
+class SignedMsgType(IntEnum):
+    UNKNOWN = 0
+    PREVOTE = 1
+    PRECOMMIT = 2
+    PROPOSAL = 32
+
+
+class BlockIDFlag(IntEnum):
+    UNKNOWN = 0
+    ABSENT = 1
+    COMMIT = 2
+    NIL = 3
+
+
+@dataclass(frozen=True)
+class Timestamp:
+    seconds: int = GO_ZERO_TIME_SECONDS
+    nanos: int = 0
+
+    @classmethod
+    def now(cls) -> "Timestamp":
+        ns = _time.time_ns()
+        return cls(ns // 1_000_000_000, ns % 1_000_000_000)
+
+    @classmethod
+    def zero(cls) -> "Timestamp":
+        """Go zero time (time.Time{})."""
+        return cls(GO_ZERO_TIME_SECONDS, 0)
+
+    def is_zero(self) -> bool:
+        return self.seconds == GO_ZERO_TIME_SECONDS and self.nanos == 0
+
+    def proto(self) -> bytes:
+        """google.protobuf.Timestamp message body."""
+        return pe.timestamp_msg(self.seconds, self.nanos)
+
+    def __le__(self, other):
+        return (self.seconds, self.nanos) <= (other.seconds, other.nanos)
+
+    def __lt__(self, other):
+        return (self.seconds, self.nanos) < (other.seconds, other.nanos)
+
+    def add_ms(self, ms: int) -> "Timestamp":
+        ns = self.seconds * 1_000_000_000 + self.nanos + ms * 1_000_000
+        return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def proto(self) -> bytes:
+        """{uint32 total = 1; bytes hash = 2} — same layout for
+        PartSetHeader and CanonicalPartSetHeader."""
+        return pe.varint_field(1, self.total) + pe.bytes_field(2, self.hash)
+
+    def validate_basic(self):
+        if self.total < 0:
+            raise ValueError("negative part-set total")
+        if self.hash and len(self.hash) != 32:
+            raise ValueError("part-set hash must be 32 bytes or empty")
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        return len(self.hash) == 0 and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        """Non-nil and fully specified (reference types/block.go IsComplete)."""
+        return (len(self.hash) == 32
+                and self.part_set_header.total > 0
+                and len(self.part_set_header.hash) == 32)
+
+    def proto(self) -> bytes:
+        """BlockID message body {bytes hash=1; PartSetHeader psh=2
+        (non-nullable, always emitted)}."""
+        return (pe.bytes_field(1, self.hash)
+                + pe.message_field_always(2, self.part_set_header.proto()))
+
+    def canonical_proto(self) -> bytes | None:
+        """CanonicalBlockID body, or None when zero (reference
+        types/canonical.go CanonicalizeBlockID returns nil)."""
+        if self.is_zero():
+            return None
+        return (pe.bytes_field(1, self.hash)
+                + pe.message_field_always(2, self.part_set_header.proto()))
+
+    def validate_basic(self):
+        if self.hash and len(self.hash) != 32:
+            raise ValueError("block-id hash must be 32 bytes or empty")
+        self.part_set_header.validate_basic()
+
+    def key(self) -> bytes:
+        return self.hash + self.part_set_header.hash + bytes(
+            [self.part_set_header.total & 0xFF,
+             (self.part_set_header.total >> 8) & 0xFF,
+             (self.part_set_header.total >> 16) & 0xFF,
+             (self.part_set_header.total >> 24) & 0xFF])

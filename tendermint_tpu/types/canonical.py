@@ -1,0 +1,47 @@
+"""Canonical sign-bytes encoders (reference types/canonical.go:42-66,
+proto/tendermint/types/canonical.proto, canonical.pb.go:517-567).
+
+These byte layouts are the *messages the TPU kernel verifies* — every
+(pubkey, msg, sig) triple's msg comes from here, so they must match the
+reference bit-for-bit.  Per-validator commit messages differ only in the
+Timestamp field (reference types/block.go:799-804), which is what makes
+commit batches near-constant-length.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.libs import protoenc as pe
+
+from .basic import BlockID, SignedMsgType, Timestamp
+
+
+def canonical_vote_bytes(chain_id: str, vtype: SignedMsgType, height: int,
+                         round_: int, block_id: BlockID,
+                         timestamp: Timestamp) -> bytes:
+    """Length-delimited CanonicalVote encoding = Vote/Precommit sign bytes
+    (reference types/vote.go:93, canonical.pb.go CanonicalVote)."""
+    body = (
+        pe.varint_field(1, int(vtype))
+        + pe.sfixed64_field(2, height)
+        + pe.sfixed64_field(3, round_)
+        + pe.message_field(4, block_id.canonical_proto())
+        + pe.message_field_always(5, timestamp.proto())
+        + pe.string_field(6, chain_id)
+    )
+    return pe.length_delimited(body)
+
+
+def canonical_proposal_bytes(chain_id: str, height: int, round_: int,
+                             pol_round: int, block_id: BlockID,
+                             timestamp: Timestamp) -> bytes:
+    """Length-delimited CanonicalProposal encoding = Proposal sign bytes
+    (reference types/proposal.go SignBytes, canonical.pb.go)."""
+    body = (
+        pe.varint_field(1, int(SignedMsgType.PROPOSAL))
+        + pe.sfixed64_field(2, height)
+        + pe.sfixed64_field(3, round_)
+        + pe.varint_field(4, pol_round)
+        + pe.message_field(5, block_id.canonical_proto())
+        + pe.message_field_always(6, timestamp.proto())
+        + pe.string_field(7, chain_id)
+    )
+    return pe.length_delimited(body)

@@ -1,0 +1,119 @@
+"""Commit and CommitSig (reference types/block.go:556-830).
+
+Commit.Signatures[i] corresponds 1:1 with ValidatorSet.Validators[i]; the
+per-validator sign bytes differ only in Timestamp (reference
+types/block.go:799-804), which makes whole-commit verification a natural
+fixed-shape TPU batch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs import protoenc as pe
+
+from .basic import BlockID, BlockIDFlag, SignedMsgType, Timestamp
+from .canonical import canonical_vote_bytes
+
+
+@dataclass
+class CommitSig:
+    block_id_flag: BlockIDFlag
+    validator_address: bytes = b""
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        """No vote received from this validator (reference
+        types/block.go:628)."""
+        return cls(block_id_flag=BlockIDFlag.ABSENT)
+
+    def is_absent(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.ABSENT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this sig voted for (reference types/block.go:722)."""
+        if self.block_id_flag == BlockIDFlag.COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def proto(self) -> bytes:
+        return (
+            pe.varint_field(1, int(self.block_id_flag))
+            + pe.bytes_field(2, self.validator_address)
+            + pe.message_field_always(3, self.timestamp.proto())
+            + pe.bytes_field(4, self.signature)
+        )
+
+    def validate_basic(self):
+        if self.block_id_flag not in (BlockIDFlag.ABSENT, BlockIDFlag.COMMIT,
+                                      BlockIDFlag.NIL):
+            raise ValueError(f"unknown BlockIDFlag {self.block_id_flag}")
+        if self.block_id_flag == BlockIDFlag.ABSENT:
+            if self.validator_address:
+                raise ValueError("absent sig has validator address")
+            if not self.timestamp.is_zero():
+                raise ValueError("absent sig has non-zero timestamp")
+            if self.signature:
+                raise ValueError("absent sig has signature")
+        else:
+            if len(self.validator_address) != 20:
+                raise ValueError("wrong validator address size")
+            if not self.signature:
+                raise ValueError("signature is missing")
+            if len(self.signature) > 64:
+                raise ValueError("signature too big")
+
+
+@dataclass
+class Commit:
+    height: int
+    round: int
+    block_id: BlockID
+    signatures: List[CommitSig]
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Sign bytes of the precommit at idx (reference
+        types/block.go:808-811)."""
+        cs = self.signatures[idx]
+        return canonical_vote_bytes(
+            chain_id, SignedMsgType.PRECOMMIT, self.height, self.round,
+            cs.block_id(self.block_id), cs.timestamp)
+
+    def proto(self) -> bytes:
+        return (
+            pe.varint_field(1, self.height)
+            + pe.varint_field(2, self.round)
+            + pe.message_field_always(3, self.block_id.proto())
+            + pe.repeated_message_field(4, [s.proto() for s in self.signatures])
+        )
+
+    def hash(self) -> bytes:
+        """Merkle root of the proto-encoded signatures (reference
+        types/block.go:700-711)."""
+        return merkle.hash_from_byte_slices(
+            [s.proto() for s in self.signatures])
+
+    def validate_basic(self):
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for i, sig in enumerate(self.signatures):
+                try:
+                    sig.validate_basic()
+                except ValueError as e:
+                    raise ValueError(f"wrong CommitSig #{i}: {e}") from e

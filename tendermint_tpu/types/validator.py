@@ -1,0 +1,69 @@
+"""Validator (reference types/validator.go).
+
+Bytes() — the merkle leaf for ValidatorSet.Hash — is the SimpleValidator
+proto {PublicKey pub_key = 1; int64 voting_power = 2} with PublicKey the
+oneof {ed25519 = 1 | secp256k1 = 2} (proto/tendermint/crypto/keys.proto),
+reproduced bit-exactly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.libs import protoenc as pe
+
+_PUBKEY_ONEOF_FIELD = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}
+
+
+def pubkey_proto(pub: PubKey) -> bytes:
+    """tendermint.crypto.PublicKey message body."""
+    num = _PUBKEY_ONEOF_FIELD.get(pub.type_name)
+    if num is None:
+        raise ValueError(f"unsupported key type {pub.type_name}")
+    data = pub.bytes()
+    # oneof: always emitted once set, even if empty
+    return pe.tag(num, pe.WT_BYTES) + pe.uvarint(len(data)) + data
+
+
+@dataclass
+class Validator:
+    address: bytes
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+
+    @classmethod
+    def new(cls, pub_key: PubKey, voting_power: int) -> "Validator":
+        return cls(address=pub_key.address(), pub_key=pub_key,
+                   voting_power=voting_power, proposer_priority=0)
+
+    def copy(self) -> "Validator":
+        return replace(self)
+
+    def bytes(self) -> bytes:
+        """SimpleValidator proto (reference types/validator.go:117-133)."""
+        return (pe.message_field_always(1, pubkey_proto(self.pub_key))
+                + pe.varint_field(2, self.voting_power))
+
+    def validate_basic(self):
+        if self.pub_key is None:
+            raise ValueError("validator has nil pubkey")
+        if self.voting_power < 0:
+            raise ValueError("validator has negative voting power")
+        if len(self.address) != 20:
+            raise ValueError("validator address is wrong size")
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Higher priority wins; ties broken by lower address (reference
+        types/validator.go:64-84)."""
+        if other is None:
+            return self
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        if self.address < other.address:
+            return self
+        if self.address > other.address:
+            return other
+        raise ValueError("cannot compare identical validators")

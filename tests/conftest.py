@@ -3,6 +3,10 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual CPU mesh (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Also enables the persistent compilation cache: the ed25519 verify kernel
+takes minutes to compile per (shape, platform) and every pytest process
+would otherwise recompile from scratch.
 """
 import os
 
@@ -13,3 +17,5 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import tendermint_tpu  # noqa: E402  (sets compilation-cache env defaults)

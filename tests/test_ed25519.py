@@ -1,0 +1,154 @@
+"""Batched ed25519 verify kernel vs OpenSSL + pure-Python oracles.
+
+Runs on the CPU backend (see conftest.py); the same jitted code path runs
+on TPU (driven separately by bench.py / __graft_entry__.py).
+"""
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import _edref
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.ops import ed25519 as edops
+
+rng = random.Random(42)
+
+
+def _rand_seed():
+    return bytes(rng.randrange(256) for _ in range(32))
+
+
+def make_batch(n, msg_len=64):
+    seeds = [_rand_seed() for _ in range(n)]
+    msgs = [bytes(rng.randrange(256) for _ in range(msg_len)) for _ in range(n)]
+    pubs = [_edref.pubkey_from_seed(s) for s in seeds]
+    sigs = [_edref.sign(s, m) for s, m in zip(seeds, msgs)]
+    return pubs, msgs, sigs
+
+
+def test_pyref_matches_openssl():
+    """The pure-Python reference itself must agree with OpenSSL."""
+    pubs, msgs, sigs = make_batch(8)
+    for p, m, s in zip(pubs, msgs, sigs):
+        assert edkeys.PubKey(p).verify_signature(m, s)
+        assert _edref.verify(p, m, s)
+        assert not _edref.verify(p, m + b"x", s)
+
+
+def test_kernel_all_valid():
+    pubs, msgs, sigs = make_batch(32)
+    out = edops.verify_batch(pubs, msgs, sigs)
+    assert out.shape == (32,)
+    assert out.all()
+
+
+def test_kernel_rejects_corruption():
+    """Flip one bit somewhere in (pub, msg, sig) per lane; all must fail."""
+    n = 24
+    pubs, msgs, sigs = make_batch(n)
+    pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    for i in range(n):
+        which = i % 3
+        if which == 0:
+            b = bytearray(sigs[i]); b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sigs[i] = bytes(b)
+        elif which == 1:
+            b = bytearray(msgs[i]); b[rng.randrange(len(b))] ^= 1
+            msgs[i] = bytes(b)
+        else:
+            b = bytearray(pubs[i]); b[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            pubs[i] = bytes(b)
+    out = edops.verify_batch(pubs, msgs, sigs)
+    # oracle: per-lane OpenSSL result (a corrupted pubkey may still decode to
+    # a different valid key, but then the sig must not verify under it)
+    oracle = np.array([
+        edkeys.PubKey(p).verify_signature(m, s)
+        for p, m, s in zip(pubs, msgs, sigs)
+    ])
+    assert not oracle.any()
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+
+
+def test_kernel_mixed_validity_bitmap():
+    n = 40
+    pubs, msgs, sigs = make_batch(n)
+    sigs = list(sigs)
+    bad = set(rng.sample(range(n), 13))
+    for i in bad:
+        b = bytearray(sigs[i]); b[5] ^= 0x40
+        sigs[i] = bytes(b)
+    out = np.asarray(edops.verify_batch(pubs, msgs, sigs))
+    for i in range(n):
+        assert out[i] == (i not in bad)
+
+
+def test_kernel_noncanonical_s_rejected():
+    """s >= L must be rejected even when the point equation would hold."""
+    pubs, msgs, sigs = make_batch(4)
+    sigs = list(sigs)
+    s0 = int.from_bytes(sigs[0][32:], "little")
+    s_bad = s0 + _edref.L  # same value mod L
+    if s_bad < (1 << 256):
+        sigs[0] = sigs[0][:32] + s_bad.to_bytes(32, "little")
+        out = np.asarray(edops.verify_batch(pubs, msgs, sigs))
+        assert not out[0]
+        assert out[1:].all()
+        # Go/OpenSSL agree
+        assert not edkeys.PubKey(pubs[0]).verify_signature(msgs[0], sigs[0])
+
+
+def test_kernel_bad_pubkey_encoding():
+    """A y-coordinate with no valid x (non-square) must be rejected."""
+    pubs, msgs, sigs = make_batch(6)
+    pubs = list(pubs)
+    # find a y that is not on the curve
+    y = 2
+    while _edref._recover_x(y, 0) is not None:
+        y += 1
+    pubs[2] = y.to_bytes(32, "little")
+    out = np.asarray(edops.verify_batch(pubs, msgs, sigs))
+    assert not out[2]
+    assert out[0] and out[1] and out[3] and out[4] and out[5]
+
+
+def test_kernel_zero_and_smallorder():
+    """Identity pubkey (y=1) and torsion points must not crash; result must
+    match the oracle."""
+    pubs, msgs, sigs = make_batch(3)
+    pubs, sigs = list(pubs), list(sigs)
+    ident = (1).to_bytes(32, "little")  # point (0, 1) = identity
+    pubs[0] = ident
+    out = np.asarray(edops.verify_batch(pubs, msgs, sigs))
+    oracle = np.array([
+        _edref.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+    ])
+    np.testing.assert_array_equal(out, oracle)
+
+
+def test_sign_verify_roundtrip_keys_api():
+    priv = edkeys.PrivKey.generate()
+    msg = b"tendermint_tpu vote"
+    sig = priv.sign(msg)
+    assert priv.pub_key().verify_signature(msg, sig)
+    assert not priv.pub_key().verify_signature(msg + b"!", sig)
+    assert len(priv.pub_key().address()) == 20
+    # Go 64-byte privkey layout roundtrip
+    priv2 = edkeys.PrivKey(priv.bytes())
+    assert priv2.pub_key().bytes() == priv.pub_key().bytes()
+
+
+def test_digit_decomposition():
+    """Signed radix-16 digits must recompose to the scalar."""
+    scalars = [rng.randrange(edops.L) for _ in range(16)]
+    b = np.stack([
+        np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
+        for s in scalars
+    ])
+    digits = edops.scalars_to_digits(b)  # (64, B)
+    assert digits.min() >= -8 and digits.max() <= 8
+    for i, s in enumerate(scalars):
+        val = sum(int(digits[j, i]) << (4 * j) for j in range(64))
+        assert val == s

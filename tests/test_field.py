@@ -160,3 +160,14 @@ def test_scalar_times_batch_broadcast(bsize):
         assert out.shape == (F.NLIMB, bsize)
         for i, x in enumerate(xs):
             assert F.limbs_to_int(col(out, i)) % P == (c * x) % P
+    # eq and select must follow the same limb-axis-aligned broadcasting
+    eqs = np.asarray(F.eq(a, b))
+    assert eqs.shape == (bsize,)
+    for i, x in enumerate(xs):
+        assert bool(eqs[i]) == (x % P == c % P)
+    cond = np.zeros(bsize, dtype=bool); cond[0] = True
+    sel = np.asarray(F.select(jnp.asarray(cond), a, b))
+    assert sel.shape == (F.NLIMB, bsize)
+    assert F.limbs_to_int(col(sel, 0)) % P == c % P
+    if bsize > 1:
+        assert F.limbs_to_int(col(sel, 1)) % P == xs[1] % P

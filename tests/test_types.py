@@ -1,0 +1,278 @@
+"""Canonical encodings and validator-set semantics.
+
+Sign-bytes golden vectors are copied from the reference's own test suite
+(reference types/vote_test.go:60-131 TestVoteSignBytesTestVectors) — the
+encodings must match the Go implementation byte-for-byte.
+"""
+import hashlib
+import random
+from fractions import Fraction
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.types.basic import (
+    BlockID, BlockIDFlag, PartSetHeader, SignedMsgType, Timestamp)
+from tendermint_tpu.types.canonical import (
+    canonical_proposal_bytes, canonical_vote_bytes)
+from tendermint_tpu.types.commit import Commit, CommitSig
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import (
+    CommitVerifyError, NotEnoughVotingPowerError, ValidatorSet)
+
+rng = random.Random(99)
+
+
+# --- sign bytes golden vectors (reference types/vote_test.go:60-131) -------
+
+ZERO_TS_BYTES = bytes([0xb, 0x8, 0x80, 0x92, 0xb8, 0xc3, 0x98, 0xfe,
+                       0xff, 0xff, 0xff, 0x1])
+
+
+def test_vote_sign_bytes_vector_0():
+    # ("", &Vote{}) — zero vote
+    got = canonical_vote_bytes("", SignedMsgType.UNKNOWN, 0, 0, BlockID(),
+                               Timestamp.zero())
+    want = bytes([0xd, 0x2a]) + ZERO_TS_BYTES
+    assert got == want
+
+
+def test_vote_sign_bytes_vector_precommit():
+    got = canonical_vote_bytes("", SignedMsgType.PRECOMMIT, 1, 1, BlockID(),
+                               Timestamp.zero())
+    want = (bytes([0x21, 0x8, 0x2,
+                   0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x2a]) + ZERO_TS_BYTES)
+    assert got == want
+
+
+def test_vote_sign_bytes_vector_prevote():
+    got = canonical_vote_bytes("", SignedMsgType.PREVOTE, 1, 1, BlockID(),
+                               Timestamp.zero())
+    want = (bytes([0x21, 0x8, 0x1,
+                   0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x2a]) + ZERO_TS_BYTES)
+    assert got == want
+
+
+def test_vote_sign_bytes_vector_no_type():
+    got = canonical_vote_bytes("", SignedMsgType.UNKNOWN, 1, 1, BlockID(),
+                               Timestamp.zero())
+    want = (bytes([0x1f,
+                   0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x2a]) + ZERO_TS_BYTES)
+    assert got == want
+
+
+def test_vote_sign_bytes_vector_chain_id():
+    got = canonical_vote_bytes("test_chain_id", SignedMsgType.UNKNOWN, 1, 1,
+                               BlockID(), Timestamp.zero())
+    want = (bytes([0x2e,
+                   0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+                   0x2a]) + ZERO_TS_BYTES
+            + bytes([0x32, 0xd]) + b"test_chain_id")
+    assert got == want
+
+
+def test_proposal_vs_vote_sign_bytes_differ():
+    v = canonical_vote_bytes("", SignedMsgType.UNKNOWN, 1, 1, BlockID(),
+                             Timestamp.zero())
+    p = canonical_proposal_bytes("", 1, 1, 0, BlockID(), Timestamp.zero())
+    assert v != p  # reference TestVoteProposalNotEq
+
+
+def test_sign_bytes_with_block_id_roundtrip_sig():
+    """A signature over our sign bytes must verify through the key API."""
+    priv = edkeys.PrivKey(bytes(range(32)))
+    bid = BlockID(hash=bytes(32), part_set_header=PartSetHeader(1, bytes(32)))
+    sb = canonical_vote_bytes("chain", SignedMsgType.PRECOMMIT, 5, 2, bid,
+                              Timestamp(1700000000, 123456789))
+    sig = priv.sign(sb)
+    assert priv.pub_key().verify_signature(sb, sig)
+
+
+# --- merkle ---------------------------------------------------------------
+
+def test_merkle_empty_and_single():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    leaf = b"hello"
+    assert (merkle.hash_from_byte_slices([leaf])
+            == hashlib.sha256(b"\x00" + leaf).digest())
+
+
+def test_merkle_inner_structure():
+    items = [b"a", b"b", b"c"]
+    l0 = hashlib.sha256(b"\x00a").digest()
+    l1 = hashlib.sha256(b"\x00b").digest()
+    l2 = hashlib.sha256(b"\x00c").digest()
+    left = hashlib.sha256(b"\x01" + l0 + l1).digest()
+    want = hashlib.sha256(b"\x01" + left + l2).digest()
+    assert merkle.hash_from_byte_slices(items) == want
+
+
+def test_merkle_proofs():
+    items = [f"item{i}".encode() for i in range(11)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, p in enumerate(proofs):
+        assert p.verify(root, items[i]), i
+        assert not p.verify(root, items[(i + 1) % len(items)])
+
+
+# --- validator set --------------------------------------------------------
+
+def _mkvals(n, power=lambda i: 10):
+    out = []
+    for i in range(n):
+        priv = edkeys.PrivKey(i.to_bytes(32, "big"))
+        out.append((priv, Validator.new(priv.pub_key(), power(i))))
+    return out
+
+
+def test_valset_sorted_and_total_power():
+    pairs = _mkvals(7, power=lambda i: (i + 1) * 5)
+    vs = ValidatorSet([v for _, v in pairs])
+    assert vs.total_voting_power() == sum((i + 1) * 5 for i in range(7))
+    powers = [v.voting_power for v in vs.validators]
+    assert powers == sorted(powers, reverse=True)
+
+
+def test_proposer_rotation_weighted():
+    """Over one full cycle, each validator proposes proportionally to its
+    power (the proposer-selection contract, reference
+    spec/consensus/proposer-selection.md)."""
+    pairs = _mkvals(3, power=lambda i: [1, 2, 3][i])
+    vs = ValidatorSet([v for _, v in pairs])
+    counts = {}
+    for _ in range(60):
+        p = vs.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+        vs.increment_proposer_priority(1)
+    by_power = {v.address: v.voting_power for _, v in pairs}
+    got = sorted(counts.values())
+    assert got == [10, 20, 30], (got, counts, by_power)
+
+
+def test_valset_update_and_remove():
+    pairs = _mkvals(4, power=lambda i: 10)
+    vs = ValidatorSet([v for _, v in pairs])
+    # raise one validator's power
+    target = pairs[0][1]
+    vs.update_with_change_set(
+        [Validator.new(pairs[0][0].pub_key(), 100)])
+    assert vs.total_voting_power() == 130
+    # remove it (power 0)
+    vs.update_with_change_set([Validator.new(pairs[0][0].pub_key(), 0)])
+    assert vs.total_voting_power() == 30
+    assert not vs.has_address(target.address)
+
+
+def test_valset_hash_changes_with_membership():
+    pairs = _mkvals(4)
+    vs = ValidatorSet([v for _, v in pairs])
+    h1 = vs.hash()
+    vs.update_with_change_set([Validator.new(pairs[0][0].pub_key(), 99)])
+    assert vs.hash() != h1
+
+
+# --- commit verification over the batch data plane ------------------------
+
+CHAIN = "test-chain"
+
+
+def _make_commit(pairs, height=3, round_=0, absent=(), nil=(), bad=()):
+    bid = BlockID(hash=bytes([7] * 32),
+                  part_set_header=PartSetHeader(1, bytes([8] * 32)))
+    vs = ValidatorSet([v for _, v in pairs])
+    sigs = []
+    # commit order must match validator-set order; map address -> priv
+    by_addr = {v.address: priv for priv, v in pairs}
+    for idx, val in enumerate(vs.validators):
+        priv = by_addr[val.address]
+        if idx in absent:
+            sigs.append(CommitSig.absent())
+            continue
+        flag = BlockIDFlag.NIL if idx in nil else BlockIDFlag.COMMIT
+        voted = BlockID() if idx in nil else bid
+        ts = Timestamp(1700000000 + idx, idx)
+        from tendermint_tpu.types.canonical import canonical_vote_bytes
+        sb = canonical_vote_bytes(CHAIN, SignedMsgType.PRECOMMIT, height,
+                                  round_, voted, ts)
+        sig = priv.sign(sb)
+        if idx in bad:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        sigs.append(CommitSig(flag, val.address, ts, sig))
+    return vs, bid, Commit(height, round_, bid, sigs)
+
+
+def test_verify_commit_all_good():
+    pairs = _mkvals(6)
+    vs, bid, commit = _make_commit(pairs)
+    vs.verify_commit(CHAIN, bid, 3, commit)          # must not raise
+    vs.verify_commit_light(CHAIN, bid, 3, commit)
+    vs.verify_commit_light_trusting(CHAIN, commit, Fraction(1, 3))
+
+
+def test_verify_commit_with_absent_and_nil():
+    pairs = _mkvals(7)
+    vs, bid, commit = _make_commit(pairs, absent={2}, nil={4})
+    vs.verify_commit(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_bad_sig_identified():
+    pairs = _mkvals(6)
+    vs, bid, commit = _make_commit(pairs, bad={3})
+    with pytest.raises(CommitVerifyError, match=r"wrong signature \(#3\)"):
+        vs.verify_commit(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_insufficient_power():
+    pairs = _mkvals(6)
+    vs, bid, commit = _make_commit(pairs, absent={0, 1, 2, 3})
+    with pytest.raises(NotEnoughVotingPowerError):
+        vs.verify_commit(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_light_ignores_bad_sig_after_twothirds():
+    """The serial reference exits at 2/3 and never sees later signatures; the
+    batched implementation must preserve that acceptance."""
+    pairs = _mkvals(6)
+    vs, bid, commit = _make_commit(pairs, bad={5})
+    # full check rejects...
+    with pytest.raises(CommitVerifyError):
+        vs.verify_commit(CHAIN, bid, 3, commit)
+    # ...light check (prefix crosses 2/3 before index 5) accepts
+    vs.verify_commit_light(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_wrong_height_and_blockid():
+    pairs = _mkvals(4)
+    vs, bid, commit = _make_commit(pairs)
+    with pytest.raises(CommitVerifyError, match="wrong height"):
+        vs.verify_commit(CHAIN, bid, 4, commit)
+    other = BlockID(hash=bytes([9] * 32),
+                    part_set_header=PartSetHeader(1, bytes([8] * 32)))
+    with pytest.raises(CommitVerifyError, match="wrong block ID"):
+        vs.verify_commit(CHAIN, other, 3, commit)
+
+
+def test_light_trusting_different_valset():
+    """Commit from a 6-val set verified against a 4-val overlapping set."""
+    pairs = _mkvals(6)
+    vs, bid, commit = _make_commit(pairs)
+    # trusted set = subset of 4 validators (by the same keys)
+    sub = ValidatorSet([v for _, v in pairs[:4]])
+    sub.verify_commit_light_trusting(CHAIN, commit, Fraction(1, 3))
+
+
+def test_commit_hash_covers_signatures():
+    pairs = _mkvals(4)
+    _, _, c1 = _make_commit(pairs)
+    _, _, c2 = _make_commit(pairs, nil={1})
+    assert c1.hash() != c2.hash()
+    assert len(c1.hash()) == 32

@@ -1,0 +1,151 @@
+"""VoteSet semantics: majorities, conflicts, commits (modeled on reference
+types/vote_set_test.go)."""
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.types.basic import (
+    BlockID, PartSetHeader, SignedMsgType, Timestamp)
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import (
+    ConflictingVoteError, VoteSet, VoteSetError)
+
+CHAIN = "test-chain"
+
+
+def make_fixture(n=4, power=10):
+    pairs = []
+    for i in range(n):
+        priv = edkeys.PrivKey((1000 + i).to_bytes(32, "big"))
+        pairs.append((priv, Validator.new(priv.pub_key(), power)))
+    vs = ValidatorSet([v for _, v in pairs])
+    by_addr = {v.address: p for p, v in pairs}
+    privs_in_order = [by_addr[v.address] for v in vs.validators]
+    return vs, privs_in_order
+
+
+def mkvote(priv, idx, vs, block_id, height=1, round_=0,
+           vtype=SignedMsgType.PRECOMMIT, ts=None):
+    v = Vote(
+        type=vtype, height=height, round=round_, block_id=block_id,
+        timestamp=ts or Timestamp(1700000000 + idx, 0),
+        validator_address=vs.validators[idx].address,
+        validator_index=idx)
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+BID = BlockID(bytes([1] * 32), PartSetHeader(2, bytes([2] * 32)))
+NIL = BlockID()
+
+
+def test_add_votes_to_majority():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    assert not vset.has_two_thirds_majority()
+    for i in range(3):
+        assert vset.add_vote(mkvote(privs[i], i, vs, BID))
+        if i < 2:
+            assert not vset.has_two_thirds_majority(), i
+    bid, ok = vset.two_thirds_majority()
+    assert ok and bid == BID
+    assert vset.has_two_thirds_any()
+
+
+def test_duplicate_vote_not_added():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    v = mkvote(privs[0], 0, vs, BID)
+    assert vset.add_vote(v)
+    assert vset.add_vote(v) is False  # same vote: no-op
+
+
+def test_wrong_height_round_type_rejected():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    with pytest.raises(VoteSetError):
+        vset.add_vote(mkvote(privs[0], 0, vs, BID, height=2))
+    with pytest.raises(VoteSetError):
+        vset.add_vote(mkvote(privs[0], 0, vs, BID, round_=1))
+    with pytest.raises(VoteSetError):
+        vset.add_vote(mkvote(privs[0], 0, vs, BID,
+                             vtype=SignedMsgType.PREVOTE))
+
+
+def test_bad_signature_rejected():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    v = mkvote(privs[0], 0, vs, BID)
+    v.signature = bytes([v.signature[0] ^ 1]) + v.signature[1:]
+    with pytest.raises(VoteSetError, match="invalid signature"):
+        vset.add_vote(v)
+
+
+def test_conflicting_votes_raise_evidence():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    assert vset.add_vote(mkvote(privs[0], 0, vs, BID))
+    other = BlockID(bytes([9] * 32), PartSetHeader(2, bytes([9] * 32)))
+    with pytest.raises(ConflictingVoteError) as ei:
+        vset.add_vote(mkvote(privs[0], 0, vs, other))
+    assert ei.value.vote_a.block_id == BID
+    assert ei.value.vote_b.block_id == other
+
+
+def test_nil_votes_count_toward_any_but_not_block():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    for i in range(3):
+        vset.add_vote(mkvote(privs[i], i, vs, NIL))
+    assert vset.has_two_thirds_any()
+    bid, ok = vset.two_thirds_majority()
+    assert ok and bid == NIL  # 2/3 for nil is a valid majority (nil block)
+
+
+def test_make_commit():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    for i in range(3):
+        vset.add_vote(mkvote(privs[i], i, vs, BID))
+    # validator 3 votes nil -> included as NIL sig
+    vset.add_vote(mkvote(privs[3], 3, vs, NIL))
+    commit = vset.make_commit()
+    assert commit.height == 1 and commit.block_id == BID
+    assert len(commit.signatures) == 4
+    flags = [s.block_id_flag for s in commit.signatures]
+    from tendermint_tpu.types.basic import BlockIDFlag
+    assert flags.count(BlockIDFlag.COMMIT) == 3
+    assert flags.count(BlockIDFlag.NIL) == 1
+    # the produced commit verifies through the batch plane
+    vs.verify_commit(CHAIN, BID, 1, commit)
+
+
+def test_peer_maj23_tracking():
+    vs, privs = make_fixture(4)
+    vset = VoteSet(CHAIN, 1, 0, SignedMsgType.PREVOTE, vs)
+    other = BlockID(bytes([9] * 32), PartSetHeader(2, bytes([9] * 32)))
+    vset.set_peer_maj23("peer1", other)
+    # conflicting vote for tracked block is recorded (then raises evidence)
+    assert vset.add_vote(mkvote(privs[0], 0, vs, BID,
+                                vtype=SignedMsgType.PREVOTE))
+    with pytest.raises(ConflictingVoteError):
+        vset.add_vote(mkvote(privs[0], 0, vs, other,
+                             vtype=SignedMsgType.PREVOTE))
+    ba = vset.bit_array_by_block_id(other)
+    assert ba is not None and ba.get_index(0)
+
+
+def test_bitarray():
+    from tendermint_tpu.libs.bits import BitArray
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    for i in (0, 3, 9):
+        ba.set_index(i, True)
+    assert ba.get_true_indices() == [0, 3, 9]
+    assert ba.num_true_bits() == 3
+    nb = ba.not_()
+    assert nb.get_true_indices() == [1, 2, 4, 5, 6, 7, 8]
+    full = BitArray.from_indices(4, range(4))
+    assert full.is_full()
+    assert BitArray.from_bytes(10, ba.to_bytes()) == ba
