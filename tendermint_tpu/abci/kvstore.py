@@ -1,0 +1,103 @@
+"""In-process kvstore application — the standard test fixture (reference
+abci/example/kvstore/kvstore.go and persistent_kvstore.go).
+
+Tx format: "key=value" sets key; any other tx sets tx as both key and value.
+Validator-update txs: "val:<pubkey_b64>!<power>" (reference
+persistent_kvstore.go:53 uses "val:pubkey!power").
+AppHash: big-endian 8-byte tx count (reference kvstore.go:83-90 uses the
+size as the deterministic state digest).
+"""
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Dict, List, Optional
+
+from . import types as abci
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self):
+        self.data: Dict[bytes, bytes] = {}
+        self.size = 0
+        self.height = 0
+        self.app_hash = b""
+        self.val_updates: List[abci.ValidatorUpdate] = []
+        self.validators: Dict[bytes, int] = {}  # pubkey -> power
+        self._staged: Optional[Dict[bytes, bytes]] = None
+
+    # -- info/query --------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"{{\"size\":{self.size}}}",
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        value = self.data.get(req.data, b"")
+        return abci.ResponseQuery(
+            code=abci.CODE_TYPE_OK, key=req.data, value=value,
+            log="exists" if value else "does not exist",
+            height=self.height)
+
+    # -- mempool -----------------------------------------------------------
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if len(req.tx) == 0:
+            return abci.ResponseCheckTx(code=1, log="empty tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    # -- consensus ---------------------------------------------------------
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self.validators[vu.pub_key_bytes] = vu.power
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self.val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            return self._deliver_validator_tx(tx)
+        if b"=" in tx:
+            key, value = tx.split(b"=", 1)
+        else:
+            key, value = tx, tx
+        self.data[key] = value
+        self.size += 1
+        return abci.ResponseDeliverTx(
+            code=abci.CODE_TYPE_OK,
+            events=[abci.Event("app", {"key": key.decode("utf-8", "replace"),
+                                       "creator": "kvstore"})])
+
+    def _deliver_validator_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
+        body = tx[len(VALIDATOR_TX_PREFIX):]
+        try:
+            pubkey_b64, power_s = body.split(b"!", 1)
+            pubkey = base64.b64decode(pubkey_b64)
+            power = int(power_s)
+            if len(pubkey) != 32 or power < 0:
+                raise ValueError
+        except (ValueError, TypeError):
+            return abci.ResponseDeliverTx(
+                code=1, log="invalid validator tx format, want "
+                            "val:<pubkey_b64>!<power>")
+        self.validators[pubkey] = power
+        self.val_updates.append(
+            abci.ValidatorUpdate("ed25519", pubkey, power))
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def end_block(self, height: int) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock(validator_updates=self.val_updates)
+
+    def commit(self) -> abci.ResponseCommit:
+        self.app_hash = struct.pack(">Q", self.size)
+        self.height += 1
+        return abci.ResponseCommit(data=self.app_hash)

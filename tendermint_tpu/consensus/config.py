@@ -1,0 +1,49 @@
+"""Consensus timing/behavior config (reference config/config.go:900-1011).
+
+Durations in float seconds; per-round escalation mirrors the reference's
+Propose(round) etc. accessors.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    double_sign_check_height: int = 0
+
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit(self) -> float:
+        return self.timeout_commit
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks \
+            or self.create_empty_blocks_interval > 0
+
+
+def test_config() -> ConsensusConfig:
+    """Scaled-down timeouts for in-process tests (reference
+    config/config.go TestConsensusConfig)."""
+    return ConsensusConfig(
+        timeout_propose=0.4, timeout_propose_delta=0.2,
+        timeout_prevote=0.2, timeout_prevote_delta=0.1,
+        timeout_precommit=0.2, timeout_precommit_delta=0.1,
+        timeout_commit=0.05, skip_timeout_commit=True)

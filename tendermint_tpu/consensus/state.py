@@ -1,0 +1,824 @@
+"""The BFT consensus state machine (reference consensus/state.go).
+
+One serializing receive thread consumes peer/internal/timeout queues,
+WAL-logs every input before processing, and drives the round state through
+NewRound -> Propose -> Prevote(+Wait) -> Precommit(+Wait) -> Commit
+(reference receiveRoutine :718, handleMsg :810, enter* :988-1615).
+
+Differences from the reference are deliberate host-plane design choices,
+not semantic changes:
+  * Python threads + queue.Queue instead of goroutines/channels.
+  * Gossip is a set of injected broadcast callbacks (the p2p reactor wires
+    them; in-process tests wire nodes directly).
+  * `decide_proposal` / `do_prevote` are overridable attributes for
+    Byzantine tests, like the reference's function pointers
+    (consensus/state.go:130-132).
+Safety-critical semantics (locking rules, POL unlock bounds, WAL-then-act
+ordering, fsync points, proposer selection) follow the reference exactly.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+from tendermint_tpu.libs.fail import fail_point
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State as SMState
+from tendermint_tpu.types.basic import (
+    BlockID, PartSetHeader, SignedMsgType, Timestamp)
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.commit import Commit
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import (
+    ConflictingVoteError, VoteSet, VoteSetError)
+
+from .config import ConsensusConfig
+from .round_types import (
+    BlockPartMessage, HeightVoteSet, ProposalMessage, RoundState, Step,
+    TimeoutInfo, VoteMessage)
+from .ticker import TimeoutTicker
+from .wal import WAL, EndHeightMessage
+
+import pickle
+
+
+class ConsensusState:
+    def __init__(self, config: ConsensusConfig, state: SMState,
+                 block_exec: BlockExecutor, block_store, mempool=None,
+                 evidence_pool=None, priv_validator=None, wal_path=None,
+                 event_bus=None, name: str = ""):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.priv_validator = priv_validator
+        self.priv_pub_key = (priv_validator.get_pub_key()
+                             if priv_validator else None)
+        self.event_bus = event_bus
+        self.name = name
+
+        self.rs = RoundState()
+        self.state: Optional[SMState] = None
+
+        self._peer_queue: "queue.Queue" = queue.Queue(maxsize=5000)
+        self._internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self._ticker = TimeoutTicker(self._on_ticker_timeout)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._mtx = threading.RLock()
+
+        self.wal = WAL(wal_path) if wal_path else None
+        if self.wal is not None and os.path.getsize(self.wal.path) == 0:
+            # fresh WAL: mark the height boundary we are starting from
+            # (reference consensus/wal.go writes #ENDHEIGHT 0 on creation)
+            self.wal.write_sync(EndHeightMessage(state.last_block_height))
+
+        # broadcast hooks (wired by the reactor / test harness)
+        self.broadcast_vote: List[Callable[[Vote], None]] = []
+        self.broadcast_proposal: List[Callable[[Proposal], None]] = []
+        self.broadcast_block_part: List[Callable[[int, int, Part], None]] = []
+        self.on_committed: List[Callable[[Block], None]] = []
+
+        # overridable for Byzantine tests (reference consensus/state.go:130)
+        self.decide_proposal = self._default_decide_proposal
+        self.do_prevote = self._default_do_prevote
+
+        self._update_to_state(state)
+        if state.last_block_height > 0:
+            self._reconstruct_last_commit(state)
+
+    def _reconstruct_last_commit(self, state: SMState):
+        """Rebuild rs.last_commit as a VoteSet from the stored seen commit
+        (reference consensus/state.go reconstructLastCommit +
+        types/block.go:768 CommitToVoteSet) so a restarted node can propose
+        at the next height."""
+        seen = self.block_store.load_seen_commit(state.last_block_height)
+        if seen is None or state.last_validators is None:
+            return
+        vs = VoteSet(state.chain_id, seen.height, seen.round,
+                     SignedMsgType.PRECOMMIT, state.last_validators)
+        for idx, cs_sig in enumerate(seen.signatures):
+            if cs_sig.is_absent():
+                continue
+            vote = Vote(
+                type=SignedMsgType.PRECOMMIT, height=seen.height,
+                round=seen.round, block_id=cs_sig.block_id(seen.block_id),
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=idx, signature=cs_sig.signature)
+            vs.add_vote(vote)
+        if not vs.has_two_thirds_majority():
+            raise RuntimeError("failed to reconstruct last commit")
+        self.rs.last_commit = vs
+
+    # ------------------------------------------------------------------ API
+
+    def start(self):
+        if self.wal is not None:
+            self._catchup_replay()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._receive_routine,
+                                        name=f"consensus-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+        self._schedule_round0()
+
+    def stop(self):
+        self._stop.set()
+        self._ticker.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.wal is not None:
+            self.wal.close()
+
+    def add_vote(self, vote: Vote, peer_id: str = ""):
+        """Thread-safe external entry (reactor/gossip)."""
+        self._enqueue(VoteMessage(vote), peer_id)
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = ""):
+        self._enqueue(ProposalMessage(proposal), peer_id)
+
+    def add_block_part(self, height: int, round_: int, part: Part,
+                       peer_id: str = ""):
+        self._enqueue(BlockPartMessage(height, round_, part), peer_id)
+
+    def get_round_state(self) -> RoundState:
+        with self._mtx:
+            return self.rs
+
+    def is_running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _enqueue(self, msg, peer_id: str):
+        if peer_id == "":
+            self._internal_queue.put((msg, ""))
+        else:
+            try:
+                self._peer_queue.put_nowait((msg, peer_id))
+            except queue.Full:
+                pass  # drop under backpressure (reference behavior)
+
+    # --------------------------------------------------- receive routine
+
+    def _receive_routine(self):
+        while not self._stop.is_set():
+            try:
+                msg, peer_id = None, ""
+                # prioritize internal messages (own votes/proposals)
+                try:
+                    msg, peer_id = self._internal_queue.get_nowait()
+                except queue.Empty:
+                    try:
+                        msg, peer_id = self._peer_queue.get(timeout=0.02)
+                    except queue.Empty:
+                        continue
+                with self._mtx:
+                    self._handle_msg(msg, peer_id)
+            except Exception:  # noqa: BLE001 - consensus failure is fatal
+                traceback.print_exc()
+                # reference panics with "CONSENSUS FAILURE!!!"
+                # (consensus/state.go:735): safety over availability.
+                self._stop.set()
+                return
+
+    def _handle_msg(self, msg, peer_id: str):
+        if self.wal is not None:
+            if peer_id == "":
+                self.wal.write_sync((msg, peer_id))  # :774 own msgs fsync
+            else:
+                self.wal.write((msg, peer_id))
+        self._apply_msg(msg, peer_id)
+
+    def _apply_msg(self, msg, peer_id: str):
+        if isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+        elif isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            self._add_proposal_block_part(msg, peer_id)
+        elif isinstance(msg, TimeoutInfo):
+            self._handle_timeout(msg)
+        else:
+            raise ValueError(f"unknown msg type {type(msg)}")
+
+    def _on_ticker_timeout(self, ti: TimeoutInfo):
+        self._internal_queue.put((ti, ""))
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int,
+                          step: Step):
+        self._ticker.schedule(TimeoutInfo(duration, height, round_, step))
+
+    def _schedule_round0(self):
+        sleep = max(self.rs.start_time - time.time(), 0.0)
+        self._schedule_timeout(sleep, self.rs.height, 0, Step.NEW_HEIGHT)
+
+    def _handle_timeout(self, ti: TimeoutInfo):
+        rs = self.rs
+        if (ti.height != rs.height or ti.round < rs.round
+                or (ti.round == rs.round and ti.step < rs.step)):
+            return  # stale timeout
+        if ti.step == Step.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == Step.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == Step.PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == Step.PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == Step.PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # --------------------------------------------------- state transitions
+
+    def _update_to_state(self, state: SMState):
+        """Prepare RoundState for the next height (reference
+        updateToState :518-608)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height \
+                and rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState expected state height {rs.height}, got "
+                f"{state.last_block_height}")
+
+        # next desired block height
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        last_precommits = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if not precommits.has_two_thirds_majority():
+                raise RuntimeError("wanted to form a commit, but precommits "
+                                   "lack majority")
+            last_precommits = precommits
+
+        validators = state.validators
+
+        new_rs = RoundState()
+        new_rs.height = height
+        new_rs.round = 0
+        new_rs.step = Step.NEW_HEIGHT
+        if rs.commit_time:
+            new_rs.start_time = rs.commit_time + self.config.commit()
+        else:
+            new_rs.start_time = time.time() + self.config.commit()
+        new_rs.validators = validators
+        new_rs.locked_round = -1
+        new_rs.valid_round = -1
+        new_rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        new_rs.commit_round = -1
+        new_rs.last_commit = last_precommits
+        self.rs = new_rs
+        self.state = state
+
+    def _enter_new_round(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step != Step.NEW_HEIGHT)):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = Step.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)
+        rs.triggered_timeout_precommit = False
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round_step(height, round_, "NewRound")
+        wait_for_txs = (self.config.wait_for_txs() and round_ == 0
+                        and not self._need_proof_block(height))
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval, height, round_,
+                    Step.NEW_ROUND)
+            self._maybe_wait_for_txs(height, round_)
+        else:
+            self._enter_propose(height, round_)
+
+    def _maybe_wait_for_txs(self, height, round_):
+        if self.mempool is not None and not self.mempool.is_empty():
+            self._enter_propose(height, round_)
+
+    def notify_txs_available(self):
+        """Mempool callback: txs arrived while waiting (reference
+        txNotifier)."""
+        with self._mtx:
+            rs = self.rs
+            if rs.step == Step.NEW_ROUND:
+                self._enter_propose(rs.height, rs.round)
+
+    def _need_proof_block(self, height: int) -> bool:
+        if height == self.state.initial_height:
+            return True
+        meta = self.block_store.load_block_meta(height - 1)
+        return meta is None or self.state.app_hash != meta.header.app_hash
+
+    def _enter_propose(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= Step.PROPOSE)):
+            return
+        rs.round = round_
+        rs.step = Step.PROPOSE
+        self._new_step()
+        self._schedule_timeout(self.config.propose(round_), height, round_,
+                               Step.PROPOSE)
+        if self.priv_validator is None or self.priv_pub_key is None:
+            self._maybe_finish_propose(height, round_)
+            return
+        addr = self.priv_pub_key.address()
+        if not rs.validators.has_address(addr):
+            self._maybe_finish_propose(height, round_)
+            return
+        if rs.validators.get_proposer().address == addr:
+            self.decide_proposal(height, round_)
+        self._maybe_finish_propose(height, round_)
+
+    def _maybe_finish_propose(self, height, round_):
+        # If we already have a complete proposal, move on.
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _default_decide_proposal(self, height: int, round_: int):
+        """Reference defaultDecideProposal :1133."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit = self._commit_for_proposal(height)
+            if commit is None:
+                return
+            block = self.block_exec.create_proposal_block(
+                height, self.state, commit, self.priv_pub_key.address())
+            parts = PartSet.from_data(pickle.dumps(block))
+        block_id = BlockID(block.hash(), parts.header())
+        proposal = Proposal(height=height, round=round_,
+                            pol_round=rs.valid_round, block_id=block_id,
+                            timestamp=Timestamp.now())
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            return
+        # send to ourselves via internal queue, then gossip
+        self._internal_queue.put((ProposalMessage(proposal), ""))
+        for i in range(parts.header().total):
+            self._internal_queue.put(
+                (BlockPartMessage(height, round_, parts.get_part(i)), ""))
+        for fn in self.broadcast_proposal:
+            fn(proposal)
+        for fn in self.broadcast_block_part:
+            for i in range(parts.header().total):
+                fn(height, round_, parts.get_part(i))
+
+    def _commit_for_proposal(self, height: int) -> Optional[Commit]:
+        if height == self.state.initial_height:
+            return Commit(0, 0, BlockID(), [])
+        if (self.rs.last_commit is not None
+                and self.rs.last_commit.has_two_thirds_majority()):
+            return self.rs.last_commit.make_commit()
+        return None
+
+    def _is_proposal_complete(self) -> bool:
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        return rs.votes.prevotes(rs.proposal.pol_round).has_two_thirds_any()
+
+    # -- proposal handling (reference :1833-1998) --------------------------
+
+    def _set_proposal(self, proposal: Proposal):
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+                proposal.pol_round >= 0
+                and proposal.pol_round >= proposal.round):
+            raise VoteSetError("invalid proposal POLRound")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+                proposal.sign_bytes(self.state.chain_id), proposal.signature):
+            raise VoteSetError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str):
+        rs = self.rs
+        if msg.height != rs.height:
+            return
+        if rs.proposal_block_parts is None:
+            return
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except ValueError:
+            if peer_id == "":
+                raise
+            return
+        if not added:
+            return
+        if rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            block = pickle.loads(data)
+            if not isinstance(block, Block):
+                raise ValueError("proposal parts decode to non-Block")
+            if (rs.proposal is not None
+                    and block.hash() != rs.proposal.block_id.hash):
+                raise ValueError("proposal block hash mismatch")
+            rs.proposal_block = block
+            if self.event_bus is not None:
+                self.event_bus.publish_complete_proposal(
+                    rs.height, rs.round, rs.proposal.block_id
+                    if rs.proposal else None)
+            self._handle_complete_proposal(rs.height)
+
+    def _handle_complete_proposal(self, height: int):
+        """Reference handleCompleteProposal :1967."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_maj = prevotes.two_thirds_majority()
+        if (has_maj and not rs.proposal_block.hash() is None
+                and rs.valid_round < rs.round
+                and block_id is not None and not block_id.is_zero()
+                and rs.proposal_block.hash() == block_id.hash):
+            rs.valid_round = rs.round
+            rs.valid_block = rs.proposal_block
+            rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= Step.PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(height, rs.round)
+            if has_maj:
+                self._enter_precommit(height, rs.round)
+        elif rs.step == Step.COMMIT:
+            self._try_finalize_commit(height)
+
+    # -- prevote (reference :1248-1346) ------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= Step.PREVOTE)):
+            return
+        self.do_prevote(height, round_)
+        rs.round = round_
+        rs.step = Step.PREVOTE
+        self._new_step()
+
+    def _default_do_prevote(self, height: int, round_: int):
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(SignedMsgType.PREVOTE,
+                                rs.locked_block.hash(),
+                                rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception:
+            self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
+            return
+        if not self.block_exec.process_proposal(rs.proposal_block, self.state):
+            self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
+            return
+        self._sign_add_vote(SignedMsgType.PREVOTE, rs.proposal_block.hash(),
+                            rs.proposal_block_parts.header())
+
+    def _enter_prevote_wait(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= Step.PREVOTE_WAIT)):
+            return
+        if not rs.votes.prevotes(round_).has_two_thirds_any():
+            raise RuntimeError("enter_prevote_wait without 2/3 any prevotes")
+        rs.round = round_
+        rs.step = Step.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_,
+                               Step.PREVOTE_WAIT)
+
+    # -- precommit (reference :1370-1530) ----------------------------------
+
+    def _enter_precommit(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= Step.PRECOMMIT)):
+            return
+
+        block_id, has_maj = rs.votes.prevotes(round_).two_thirds_majority()
+
+        def finish():
+            rs.round = round_
+            rs.step = Step.PRECOMMIT
+            self._new_step()
+
+        if not has_maj:
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+            finish()
+            return
+
+        # +2/3 prevoted nil: unlock and precommit nil
+        if block_id.is_zero():
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+            finish()
+            return
+
+        # already locked on this block: relock
+        if (rs.locked_block is not None
+                and rs.locked_block.hash() == block_id.hash):
+            rs.locked_round = round_
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                block_id.part_set_header)
+            finish()
+            return
+
+        # polka for our proposal block: lock and precommit
+        if (rs.proposal_block is not None
+                and rs.proposal_block.hash() == block_id.hash):
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                block_id.part_set_header)
+            finish()
+            return
+
+        # polka for a block we don't have: unlock, fetch, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if (rs.proposal_block_parts is None or
+                not rs.proposal_block_parts.has_header(
+                    block_id.part_set_header)):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(block_id.part_set_header)
+        self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+        finish()
+
+    def _enter_precommit_wait(self, height: int, round_: int):
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.triggered_timeout_precommit)):
+            return
+        if not rs.votes.precommits(round_).has_two_thirds_any():
+            raise RuntimeError(
+                "enter_precommit_wait without 2/3 any precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.config.precommit(round_), height, round_,
+                               Step.PRECOMMIT_WAIT)
+
+    # -- commit (reference :1524-1733) -------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int):
+        rs = self.rs
+        if rs.height != height or rs.step >= Step.COMMIT:
+            return
+        block_id, has_maj = rs.votes.precommits(
+            commit_round).two_thirds_majority()
+        if not has_maj or block_id.is_zero():
+            raise RuntimeError("enter_commit without +2/3 block precommits")
+        rs.step = Step.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time = time.time()
+        self._new_step()
+
+        if rs.locked_block is not None \
+                and rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if (rs.proposal_block is None
+                or rs.proposal_block.hash() != block_id.hash):
+            if (rs.proposal_block_parts is None
+                    or not rs.proposal_block_parts.has_header(
+                        block_id.part_set_header)):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                return  # wait for parts
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int):
+        rs = self.rs
+        if rs.height != height:
+            return
+        block_id, has_maj = rs.votes.precommits(
+            rs.commit_round).two_thirds_majority()
+        if not has_maj or block_id is None or block_id.is_zero():
+            return
+        if (rs.proposal_block is None
+                or rs.proposal_block.hash() != block_id.hash):
+            return  # don't have the block yet
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int):
+        rs = self.rs
+        if rs.height != height or rs.step != Step.COMMIT:
+            return
+        block_id, _ = rs.votes.precommits(rs.commit_round) \
+            .two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        self.block_exec.validate_block(self.state, block)
+        fail_point(10)
+
+        # save block with seen commit
+        if self.block_store.height() < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        fail_point(11)
+
+        if self.wal is not None:
+            self.wal.write_sync(EndHeightMessage(height))  # :1683 fsync
+        fail_point(12)
+
+        state_copy = self.state.copy()
+        new_state, _ = self.block_exec.apply_block(
+            state_copy, block_id, block)
+
+        for fn in self.on_committed:
+            fn(block)
+
+        # next height
+        self._update_to_state(new_state)
+        self._schedule_round0()
+
+    # -- votes (reference :2003-2293) --------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str):
+        try:
+            self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if self.evidence_pool is not None and peer_id != "":
+                self.evidence_pool.report_conflicting_votes(e.vote_a, e.vote_b)
+            if vote.height == self.rs.height:
+                return  # evidence reported; carry on
+            raise
+        except (VoteSetError, ValueError):
+            if peer_id == "":
+                raise  # own vote must never fail
+            # bad peer vote: ignore (reactor handles punishment)
+
+    def _add_vote(self, vote: Vote, peer_id: str):
+        rs = self.rs
+        # late precommit from previous height while in NewHeight step
+        if (vote.height + 1 == rs.height
+                and vote.type == SignedMsgType.PRECOMMIT):
+            if rs.step != Step.NEW_HEIGHT:
+                return
+            if rs.last_commit is not None:
+                added = rs.last_commit.add_vote(vote)
+                if added and self.config.skip_timeout_commit \
+                        and rs.last_commit.has_all():
+                    self._enter_new_round(rs.height, 0)
+            return
+        if vote.height != rs.height:
+            return
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return
+        if self.event_bus is not None:
+            self.event_bus.publish_vote(vote)
+
+        height = rs.height
+        if vote.type == SignedMsgType.PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, has_maj = prevotes.two_thirds_majority()
+            if has_maj:
+                # POL unlock (reference :2130-2147)
+                if (rs.locked_block is not None
+                        and rs.locked_round < vote.round <= rs.round
+                        and rs.locked_block.hash() != block_id.hash):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                # update valid block (reference :2149-2177)
+                if (not block_id.is_zero() and rs.valid_round < vote.round
+                        and vote.round == rs.round):
+                    if (rs.proposal_block is not None
+                            and rs.proposal_block.hash() == block_id.hash):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if (rs.proposal_block_parts is None
+                            or not rs.proposal_block_parts.has_header(
+                                block_id.part_set_header)):
+                        rs.proposal_block_parts = PartSet(
+                            block_id.part_set_header)
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and rs.step >= Step.PREVOTE:
+                block_id, has_maj = prevotes.two_thirds_majority()
+                if has_maj and (self._is_proposal_complete()
+                                or block_id.is_zero()):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif (rs.proposal is not None
+                  and 0 <= rs.proposal.pol_round == vote.round):
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
+
+        elif vote.type == SignedMsgType.PRECOMMIT:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, has_maj = precommits.two_thirds_majority()
+            if has_maj:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if not block_id.is_zero():
+                    self._enter_commit(height, vote.round)
+                    if self.config.skip_timeout_commit \
+                            and precommits.has_all():
+                        self._enter_new_round(self.rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        else:
+            raise ValueError(f"unexpected vote type {vote.type}")
+
+    def _sign_add_vote(self, msg_type: SignedMsgType, hash_: bytes,
+                       header: PartSetHeader):
+        if self.priv_validator is None or self.priv_pub_key is None:
+            return
+        rs = self.rs
+        addr = self.priv_pub_key.address()
+        if not rs.validators.has_address(addr):
+            return
+        if self.wal is not None:
+            self.wal.flush_and_sync()
+        idx, _ = rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type, height=rs.height, round=rs.round,
+            block_id=BlockID(hash_, header),
+            timestamp=self._vote_time(),
+            validator_address=addr, validator_index=idx)
+        try:
+            self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except Exception:
+            return
+        self._internal_queue.put((VoteMessage(vote), ""))
+        for fn in self.broadcast_vote:
+            fn(vote)
+
+    def _vote_time(self) -> Timestamp:
+        now = Timestamp.now()
+        rs = self.rs
+        min_time = None
+        if rs.locked_block is not None:
+            min_time = rs.locked_block.header.time.add_ms(1)
+        elif rs.proposal_block is not None:
+            min_time = rs.proposal_block.header.time.add_ms(1)
+        if min_time is not None and now < min_time:
+            return min_time
+        return now
+
+    def _new_step(self):
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round_step(
+                self.rs.height, self.rs.round, self.rs.step.name)
+
+    # -- WAL replay (reference :299-368, catchupReplay) --------------------
+
+    def _catchup_replay(self):
+        height = self.rs.height
+        if WAL.search_for_end_height(self.wal.path, height):
+            # we already fully processed this height?! corrupted state
+            raise RuntimeError(
+                f"WAL should not contain EndHeight {height}")
+        msgs, found = WAL.messages_after_end_height(self.wal.path, height - 1)
+        if not found:
+            raise RuntimeError(
+                f"cannot replay height {height}: WAL does not contain "
+                f"EndHeight for {height - 1}")
+        for msg, peer_id in msgs:
+            if isinstance(msg, TimeoutInfo):
+                continue  # timeouts are not replayed (reference behavior)
+            self._apply_msg(msg, peer_id or "replay")

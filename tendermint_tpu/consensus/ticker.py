@@ -1,0 +1,41 @@
+"""Timeout ticker (reference consensus/ticker.go): schedules one pending
+timeout at a time; a newer schedule replaces the old one (the state machine
+only ever waits for its current (H,R,S))."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .round_types import Step, TimeoutInfo
+
+
+class TimeoutTicker:
+    def __init__(self, on_timeout: Callable[[TimeoutInfo], None]):
+        self._on_timeout = on_timeout
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def schedule(self, ti: TimeoutInfo):
+        with self._lock:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(
+                ti.duration, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo):
+        with self._lock:
+            if self._stopped:
+                return
+        self._on_timeout(ti)
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

@@ -1,0 +1,124 @@
+"""Consensus write-ahead log (reference consensus/wal.go).
+
+Every message the consensus state machine processes is logged BEFORE it is
+processed (WAL-then-act discipline); on crash, replay from the last height
+boundary reproduces the exact state.  Framing: 4-byte CRC32c | 4-byte
+length | pickle(msg), matching the reference's crc/length framing
+(consensus/wal.go:288-355); EndHeightMessage marks height boundaries.
+
+fsync policy mirrors the reference: WriteSync on own votes/timeouts and on
+EndHeight (consensus/state.go:765,774,1683).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+MAX_MSG_SIZE = 1 << 20  # 1MB (reference consensus/wal.go:25)
+
+
+@dataclass(frozen=True)
+class EndHeightMessage:
+    """Marks that all messages for `height` have been processed (reference
+    consensus/wal.go:42)."""
+    height: int
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+class WAL:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def write(self, msg) -> None:
+        data = pickle.dumps(msg)
+        if len(data) > MAX_MSG_SIZE:
+            raise ValueError(f"WAL msg too big: {len(data)}")
+        frame = (struct.pack(">I", zlib.crc32(data))
+                 + struct.pack(">I", len(data)) + data)
+        with self._lock:
+            self._f.write(frame)
+
+    def write_sync(self, msg) -> None:
+        self.write(msg)
+        self.flush_and_sync()
+
+    def flush_and_sync(self):
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self):
+        with self._lock:
+            self._f.flush()
+            self._f.close()
+
+    # -- replay ------------------------------------------------------------
+
+    @staticmethod
+    def iter_messages(path: str, allow_corruption_tail: bool = True):
+        """Yield messages; a torn/corrupt tail (crash mid-write) stops
+        iteration cleanly when allow_corruption_tail (reference repairWalFile
+        consensus/state.go:330-366)."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    return
+                crc, length = struct.unpack(">II", hdr)
+                if length > MAX_MSG_SIZE:
+                    if allow_corruption_tail:
+                        return
+                    raise WALCorruptionError("frame length out of range")
+                data = f.read(length)
+                if len(data) < length:
+                    return  # torn write
+                if zlib.crc32(data) != crc:
+                    if allow_corruption_tail:
+                        return
+                    raise WALCorruptionError("crc mismatch")
+                try:
+                    yield pickle.loads(data)
+                except Exception:
+                    if allow_corruption_tail:
+                        return
+                    raise
+
+    @staticmethod
+    def search_for_end_height(path: str, height: int) -> bool:
+        """True if an EndHeightMessage(height) exists (reference
+        consensus/wal.go:221)."""
+        for msg in WAL.iter_messages(path):
+            if isinstance(msg, EndHeightMessage) and msg.height == height:
+                return True
+        return False
+
+    @staticmethod
+    def messages_after_end_height(path: str, height: int):
+        """(messages, found): all messages after EndHeightMessage(height) —
+        the replay set for resuming height+1.  found=False when the marker
+        is absent (callers must fail loudly, reference consensus/replay.go
+        'WAL does not contain #ENDHEIGHT')."""
+        out: List = []
+        seen = False
+        for msg in WAL.iter_messages(path):
+            if isinstance(msg, EndHeightMessage):
+                if msg.height == height:
+                    seen = True
+                    out = []
+                continue
+            if seen:
+                out.append(msg)
+        return out, seen

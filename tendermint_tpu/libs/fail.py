@@ -1,0 +1,38 @@
+"""Indexed fail-point injection (reference libs/fail/fail.go:28-39).
+
+Call sites are numbered in execution order by a process-global counter;
+when the counter reaches $FAIL_TEST_INDEX the process dies immediately.
+Used by crash/recovery tests to die between WAL-fsync, block-save and
+app-commit (reference consensus/state.go:1653-1733, state/execution.go).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_counter = 0
+_lock = threading.Lock()
+
+
+def _target() -> int:
+    v = os.environ.get("FAIL_TEST_INDEX")
+    return int(v) if v else -1
+
+
+def fail_point(_site_id: int = 0):
+    """Die (os._exit) if this is the $FAIL_TEST_INDEX-th fail point hit."""
+    global _counter
+    t = _target()
+    if t < 0:
+        return
+    with _lock:
+        current = _counter
+        _counter += 1
+    if current == t:
+        os._exit(77)
+
+
+def reset():
+    global _counter
+    with _lock:
+        _counter = 0

@@ -1,0 +1,130 @@
+"""Key-value database abstraction (the reference uses tm-db/goleveldb;
+here: in-memory for tests, SQLite for durable single-file storage).
+
+Interface: get/set/delete/has, atomic write batches, sorted prefix
+iteration — the subset the block/state stores and indexers need.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class KVDB:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def set(self, key: bytes, value: bytes):
+        raise NotImplementedError
+
+    def delete(self, key: bytes):
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def write_batch(self, sets: List[Tuple[bytes, bytes]],
+                    deletes: List[bytes] = ()):
+        """Atomic multi-write."""
+        raise NotImplementedError
+
+    def iterate_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        """Sorted ascending iteration over keys with the given prefix."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MemDB(KVDB):
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes):
+        with self._lock:
+            self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def write_batch(self, sets, deletes=()):
+        with self._lock:
+            for k, v in sets:
+                self._data[bytes(k)] = bytes(v)
+            for k in deletes:
+                self._data.pop(k, None)
+
+    def iterate_prefix(self, prefix: bytes):
+        with self._lock:
+            keys = sorted(k for k in self._data if k.startswith(prefix))
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+
+class SQLiteDB(KVDB):
+    """Durable single-file store; WAL mode for crash consistency."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)")
+            self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def set(self, key: bytes, value: bytes):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                (key, value))
+            self._conn.commit()
+
+    def delete(self, key: bytes):
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def write_batch(self, sets, deletes=()):
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                [(bytes(k), bytes(v)) for k, v in sets])
+            if deletes:
+                self._conn.executemany(
+                    "DELETE FROM kv WHERE k = ?", [(bytes(k),) for k in deletes])
+            self._conn.commit()
+
+    def iterate_prefix(self, prefix: bytes):
+        hi = prefix + b"\xff" * 8
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k",
+                (prefix, hi)).fetchall()
+        for k, v in rows:
+            k = bytes(k)
+            if k.startswith(prefix):
+                yield k, bytes(v)
+
+    def close(self):
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()

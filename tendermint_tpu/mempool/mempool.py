@@ -1,0 +1,169 @@
+"""Mempool (reference mempool/v0/clist_mempool.go).
+
+FIFO mempool with CheckTx admission through the app, LRU dedup cache,
+reap-by-bytes/gas for proposals, and post-block update + recheck
+(reference mempool/v0/clist_mempool.go:201,519,577).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.types.block import tx_hash
+
+DEFAULT_CACHE_SIZE = 10000
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int      # height when validated
+    gas_wanted: int
+
+
+class TxCache:
+    """LRU cache of seen tx hashes (reference mempool/cache.go)."""
+
+    def __init__(self, size: int = DEFAULT_CACHE_SIZE):
+        self.size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present."""
+        key = tx_hash(tx)
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self.size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes):
+        with self._lock:
+            self._map.pop(tx_hash(tx), None)
+
+    def reset(self):
+        with self._lock:
+            self._map.clear()
+
+
+class Mempool:
+    def __init__(self, app: abci.Application, max_tx_bytes: int = 1048576,
+                 size_limit: int = 5000, keep_invalid_txs_in_cache=False):
+        self.app = app
+        self.max_tx_bytes = max_tx_bytes
+        self.size_limit = size_limit
+        self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        self.cache = TxCache()
+        self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._height = 0
+        self._notify: List[Callable[[], None]] = []
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def on_new_tx(self, fn: Callable[[], None]):
+        """Register a callback fired when a tx is admitted (reactor
+        broadcast hook)."""
+        self._notify.append(fn)
+
+    # -- CheckTx admission (reference clist_mempool.go:201) ----------------
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        if len(tx) > self.max_tx_bytes:
+            return abci.ResponseCheckTx(code=1, log="tx too large")
+        if not self.cache.push(tx):
+            return abci.ResponseCheckTx(code=1, log="tx already in cache")
+        admitted = False
+        with self._lock:
+            if len(self._txs) >= self.size_limit:
+                self.cache.remove(tx)
+                return abci.ResponseCheckTx(code=1, log="mempool is full")
+            res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
+            if res.is_ok():
+                key = tx_hash(tx)
+                if key not in self._txs:
+                    self._txs[key] = MempoolTx(tx, self._height,
+                                               res.gas_wanted)
+                admitted = True
+            elif not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+        # Notify OUTSIDE the mempool lock: listeners (consensus
+        # notify_txs_available) take the consensus mutex, and the consensus
+        # thread takes the mempool lock during commit — calling out while
+        # holding _lock would be an ABBA deadlock.
+        if admitted:
+            for fn in self._notify:
+                fn()
+        return res
+
+    # -- reap (reference clist_mempool.go:519) -----------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> List[bytes]:
+        with self._lock:
+            out, total_b, total_g = [], 0, 0
+            for mt in self._txs.values():
+                nb = total_b + len(mt.tx) + 20  # amino/proto overhead bound
+                ng = total_g + mt.gas_wanted
+                if max_bytes > -1 and nb > max_bytes:
+                    break
+                if max_gas > -1 and ng > max_gas:
+                    break
+                out.append(mt.tx)
+                total_b, total_g = nb, ng
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._lock:
+            txs = [mt.tx for mt in self._txs.values()]
+            return txs if n < 0 else txs[:n]
+
+    def txs_after(self, n: int) -> List[bytes]:
+        """Txs from position n onward (reactor iteration)."""
+        with self._lock:
+            return [mt.tx for mt in list(self._txs.values())[n:]]
+
+    # -- update after block commit (reference clist_mempool.go:577) --------
+
+    def lock(self):
+        self._lock.acquire()
+
+    def unlock(self):
+        self._lock.release()
+
+    def update(self, height: int, committed_txs: List[bytes]):
+        """Caller must hold lock() (BlockExecutor._commit does)."""
+        self._height = height
+        for tx in committed_txs:
+            self.cache.push(tx)  # committed: never re-admit
+            self._txs.pop(tx_hash(tx), None)
+        self._recheck()
+
+    def _recheck(self):
+        dead = []
+        for key, mt in self._txs.items():
+            res = self.app.check_tx(abci.RequestCheckTx(
+                tx=mt.tx, type=abci.CheckTxType.RECHECK))
+            if not res.is_ok():
+                dead.append(key)
+        for key in dead:
+            mt = self._txs.pop(key)
+            if not self.keep_invalid_txs_in_cache:
+                self.cache.remove(mt.tx)
+
+    def flush(self):
+        with self._lock:
+            self._txs.clear()
+            self.cache.reset()

@@ -14,10 +14,15 @@ Edwards, after Hisil-Wong-Carter-Dawson 2008):
   * cached    (Y+X, Y-X, Z, 2dT) — precomputed form for general addition
   * niels     (y+x, y-x, 2dxy)   — cached with Z = 1, for fixed-base tables
 
-Formula safety: ops/field.py's `mul` accepts operands with |limb| < 2^13
-(one lazy add/sub on top of a carried value).  Sums that can exceed that
-bound (e.g. 2Z^2 + (B - A)) are explicitly `carry`d below; each site notes
-its bound.
+Formula safety (int32 budget): field.py values are loose-carried with
+limbs in (-2^10, L), L = 4608.  One lazy add/sub of such values spans
+(-2L, 2L); a three-term combination like (X+Y)^2 - A - B spans
+(-2L - 2^10, L + 2^11), |limb| < 10240.  mul's contract is
+22 * max|a| * max|b| + 4.6e7 < 2^31; the worst product used below is
+|10240| x |9216| = 2.12e9 total — inside int32 with ~1.2% margin
+(regression-checked by tests/test_field.py::test_mul_extreme_lazy_bound).
+Sums that would exceed that (e.g. 2Z^2 + (D2 + C)) are explicitly
+`carry`d; each site notes its bound.
 
 Curve constants are computed in Python bignum at import time.
 """
@@ -121,12 +126,13 @@ def dbl(p: Ext) -> Ext:
     a = F.sqr(p.x)
     b = F.sqr(p.y)
     zsq = F.sqr(p.z)
-    c = zsq + zsq                        # lazy: |limb| < 2^13
+    c = zsq + zsq                        # lazy: |limb| < 2L
     aa = F.sqr(p.x + p.y)                # (X+Y)^2, operand lazy-add: ok
-    e = aa - a - b                       # limbs in (-2^13, 2^12): ok as operand
-    g = b - a                            # lazy sub: ok
-    f = F.carry(g - c)                   # |g - c| can reach 2^12 + 2^13: carry
-    h = -a - b                           # limbs in (-2^13, 0]: ok
+    e = aa - a - b                       # |limb| < 2L + 2^10 (worst operand)
+    g = b - a                            # |limb| < L + 2^10
+    f = F.carry(g - c)                   # would reach 3L: carry back to loose
+    h = -a - b                           # |limb| < 2L
+    # worst mul: e (10240) x h (9216) = 2.12e9 — inside the mul contract
     return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
@@ -137,11 +143,11 @@ def add_cached(p: Ext, q: Cached) -> Ext:
     b = F.mul(p.y - p.x, q.ymx)
     c = F.mul(p.t, q.t2d)
     d = F.mul(p.z, q.z)
-    d2 = d + d                           # lazy: |limb| < 2^13
-    e = a - b                            # lazy: ok
-    f = d2 - c                           # limbs in (-2^12, 2^13): ok
-    g = F.carry(d2 + c)                  # can reach 2^13 + 2^12: carry
-    h = a + b                            # lazy: ok
+    d2 = d + d                           # lazy: |limb| < 2L
+    e = a - b                            # |limb| < L + 2^10
+    f = d2 - c                           # |limb| < 2L + 2^10
+    g = F.carry(d2 + c)                  # would reach 3L: carry
+    h = a + b                            # |limb| < 2L
     return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 

@@ -100,8 +100,10 @@ def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _carry_chain(c, out_len):
-    """Sequential carry over axis 0; returns (limbs in [0,2^RADIX),
-    carry_out).  Works for signed inputs via arithmetic shifts."""
+    """Sequential exact carry over axis 0; returns (limbs in [0,2^RADIX),
+    carry_out).  Works for signed inputs via arithmetic shifts.  O(NLIMB)
+    serial steps — used only at canonicalization boundaries (freeze); the
+    hot path uses the vectorized `carry` below."""
     outs = []
     carry = jnp.zeros_like(c[0])
     for i in range(c.shape[0]):
@@ -114,24 +116,53 @@ def _carry_chain(c, out_len):
     return jnp.stack(outs, axis=0), carry
 
 
-def carry(c):
-    """Fully reduce a (NLIMB, ...) signed-limb value to limbs in [0, 2^12).
+_TOP_BITS = 255 - RADIX * (NLIMB - 1)  # 3: bits of limb 21 below 2^255
 
-    Folds the carry-out (weight 2^264 ≡ FOLD mod p) back into the low limbs;
-    two passes guarantee termination for |carry_out| up to ~2^18 since
-    FOLD * carry_out is then < 2^31 and the refold carry is tiny.
+
+def _carry_pass(v):
+    """One vectorized carry-save pass: split each limb into low 12 bits +
+    carry, shift carries up one limb; the top limb is split at its 2^255
+    boundary (bit 3 of limb 21) and that carry folds back as 19*co into
+    limbs 0/1 (2^255 ≡ 19 mod p).  ~9 elementwise ops instead of a 22-step
+    serial chain.  Signed inputs work via arithmetic shifts (x & MASK,
+    x >> k is an exact two's-complement split).  Folding at 2^255 (not
+    2^264) makes repeated passes converge: the fold term is 19*co, so each
+    pass shrinks carries ~2^12-fold instead of re-injecting FOLD-scale
+    values."""
+    c = v >> RADIX                      # limb carries (limbs 0..20 used)
+    r = v & MASK
+    co = v[-1] >> _TOP_BITS             # weight 2^255 -> *19 at limb 0
+    r = r.at[-1].set(v[-1] & ((1 << _TOP_BITS) - 1))
+    r = r + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    # 19*co, with co split into SIGNED 12-bit digits (round-to-nearest) so
+    # products stay < 2^31 AND a small negative co injects ±19*|co|, not a
+    # +19*4095 / -19*4096 pair that would re-cascade through the limbs.
+    co_hi = (co + (1 << (RADIX - 1))) >> RADIX
+    co_lo = co - (co_hi << RADIX)       # in [-2048, 2047]
+    r = r.at[0].add(19 * co_lo)
+    r = r.at[1].add(19 * co_hi)
+    return r
+
+
+def carry(c):
+    """Reduce a (NLIMB, ...) signed-limb value to *loose-carried* form.
+
+    Contract: for any int32 input (the passes only decompose, never grow,
+    the input limbs) the output represents the same value mod p with limbs
+    in (-2^10, L), L = 4608 = 2^12 + 2^9.  NOT canonical (freeze does that),
+    but tight enough for the ring ops' int32 budget:
+      * one lazy add/sub of loose values: |limb| < 2L
+      * schoolbook column sums: 22 * (2L)^2 = 1.87e9, plus the < 4.5e7
+        fold term in _reduce_wide, < 2^31 with ~10% margin.
+    Convergence of the 4 passes (worst case |limb| < 2^31-ish):
+      pass 1: carries <= 2^19 in-limb; 19-fold <= 19*4095 = 78k at limb 0,
+              19*2^16 = 1.2e6 at limb 1
+      pass 2: carries <= 300; fold <= 78k
+      pass 3: carries <= 19;  fold <= 760
+      pass 4: carries <= 1;   fold <= 57     ->  limbs < 4096 + 512
+    Bounds are regression-checked (tests/test_field.py::test_carry_bounds).
     """
-    limbs, co = _carry_chain(c, NLIMB)
-    # fold carry-out: co * 2^264 ≡ co * FOLD.  |co| can reach ~2^19 (raw
-    # convolution limbs are ~2^30.5), so FOLD*co may overflow int32; split co
-    # into two radix-2^12 digits first (exact for signed co with arithmetic
-    # shift + mask in two's complement).
-    limbs = limbs.at[0].add((co & MASK) * FOLD)
-    limbs = limbs.at[1].add((co >> RADIX) * FOLD)
-    limbs, co2 = _carry_chain(limbs, NLIMB)
-    limbs = limbs.at[0].add(co2 * FOLD)  # |co2| <= 1 here
-    limbs, _ = _carry_chain(limbs, NLIMB)
-    return limbs
+    return _carry_pass(_carry_pass(_carry_pass(_carry_pass(c))))
 
 
 # ---------------------------------------------------------------------------
@@ -145,14 +176,14 @@ def one(shape=()):
     return jnp.zeros((NLIMB,) + shape, dtype=_i32).at[0].set(1)
 
 def add(a, b):
-    """Lazy add: result limbs < 2^13, safe as a mul operand. NOT carried."""
+    """Lazy add: |result limb| < 2L, safe as a mul operand. NOT carried."""
     return a + b
 
 def add_carried(a, b):
     return carry(a + b)
 
 def sub(a, b):
-    """Lazy sub: limbs in (-2^13, 2^13), safe as a mul operand."""
+    """Lazy sub: |result limb| < 2L, safe as a mul operand."""
     return a - b
 
 def neg(a):
@@ -166,31 +197,40 @@ def _bcast(x, batch):
     return jnp.broadcast_to(x, (NLIMB,) + batch)
 
 def mul(a, b):
-    """Field multiply.  Operands may be lazy (|limbs| < 2^13); the result is
-    fully carried (limbs in [0, 2^12))."""
+    """Field multiply.  Result is loose-carried (see `carry`).
+
+    Operand contract (int32 budget, checked by
+    tests/test_field.py::test_mul_extreme_lazy_bound):
+        22 * max|a_limb| * max|b_limb| + 4.6e7 < 2^31
+    where 4.6e7 bounds _reduce_wide's FOLD*h fold term.  Sufficient cases:
+      * both operands one lazy add/sub of loose-carried values
+        (|limb| < 2L + 2^10 = 10240 vs |limb| < 2L = 9216:
+        22*10240*9216 + 4.6e7 = 2.12e9 < 2^31, the curve-formula worst
+        case — see ops/curve.py bound notes), or
+      * both |limb| <= 9216: 22*9216^2 + 4.6e7 = 1.91e9."""
     B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
     a = _bcast(a, B)
     b = _bcast(b, B)
-    # schoolbook convolution: c[k] = sum_{i+j=k} a[i]*b[j], k in [0, 2N-2]
-    c = jnp.zeros((2 * NLIMB - 1,) + B, dtype=_i32)
-    for i in range(NLIMB):
-        c = c.at[i : i + NLIMB].add(a[i] * b)
+    # schoolbook convolution c[k] = sum_{i+j=k} a[i]*b[j] as a sum of
+    # statically-padded partial products (no dynamic-update-slice chains:
+    # they dominate both compile time and runtime).
+    pad_spec = lambda i: [(i, NLIMB - 1 - i)] + [(0, 0)] * len(B)
+    c = jnp.pad(a[0] * b, pad_spec(0))
+    for i in range(1, NLIMB):
+        c = c + jnp.pad(a[i] * b, pad_spec(i))
     return _reduce_wide(c)
 
 def _reduce_wide(c):
-    """Reduce a (2N-1, ...) signed coefficient vector to (N, ...) carried."""
+    """Reduce a (2N-1, ...) signed coefficient vector (|coeff| < 1.87e9) to
+    loose-carried (N, ...) limbs."""
     lo = c[:NLIMB]
     hi = c[NLIMB:]
-    # carry the high part first so each high limb is < 2^12 before the
-    # FOLD multiply (9728 * 2^12 < 2^26, overflow-safe when added to lo).
-    hi_l, hi_co = _carry_chain(hi, NLIMB)  # hi has NLIMB-1 coeffs -> padded
-    lo = lo + FOLD * hi_l
-    # hi_l is NLIMB limbs of the high value H (< 2^268); the carry-out of its
-    # chain has weight 2^264 *relative to H's base 2^264*, i.e. absolute
-    # weight 2^528 ≡ FOLD^2 mod p.  For our operand bounds H < 2^267 so
-    # hi_co < 2^3; FOLD^2 = 9728^2 < 2^27.
-    lo = lo.at[0].add(hi_co * ((FOLD * FOLD) % P & MASK))
-    lo = lo.at[1].add(hi_co * (((FOLD * FOLD) % P) >> RADIX))
+    # Squeeze the high value H (coefficients of weight 2^264 * 2^(12t)) to
+    # loose limbs first, then fold: H * 2^264 ≡ H * FOLD, and
+    # FOLD * |h limb| <= 9728 * 4608 < 4.5e7 — overflow-safe added to lo.
+    hi_p = jnp.concatenate([hi, jnp.zeros_like(hi[:1])], axis=0)
+    h = carry(hi_p)
+    lo = lo + FOLD * h
     return carry(lo)
 
 def sqr(a):
@@ -257,9 +297,19 @@ def _freeze_pass(a):
     out, _ = _carry_chain(a, NLIMB)
     return out
 
+# 2p in raw (non-reduced) limb form: loose-carried values can represent
+# small negatives (limbs > -2^10); adding 2p (> 2^256 > any negative
+# magnitude) makes the value non-negative before exact reduction.
+_TWO_P = jnp.asarray(
+    np.array([(2 * P >> (RADIX * i)) & MASK for i in range(NLIMB)],
+             dtype=np.int32))
+
+
 def freeze(a):
-    """Carried (N, ...) limbs -> canonical representative in [0, p)."""
-    return _freeze_pass(_freeze_pass(carry(a)))
+    """Any-bounds (N, ...) limbs -> canonical representative in [0, p)."""
+    v = carry(a)
+    v = v + _TWO_P.reshape((NLIMB,) + (1,) * (v.ndim - 1))
+    return _freeze_pass(_freeze_pass(v))
 
 def eq(a, b):
     """Exact field equality (handles non-canonical inputs)."""

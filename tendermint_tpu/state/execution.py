@@ -1,0 +1,251 @@
+"""BlockExecutor (reference state/execution.go).
+
+ApplyBlock: validate -> BeginBlock/DeliverTx*/EndBlock -> save ABCI
+responses -> update State -> Commit app + update mempool -> prune -> fire
+events (reference state/execution.go:189-266).  Commit verification inside
+validate_block routes through the TPU batch plane
+(ValidatorSet.verify_commit, reference state/validation.go:92).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs.fail import fail_point
+from tendermint_tpu.types.basic import BlockID, Timestamp
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.commit import Commit
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import CommitVerifyError
+
+from .state import State
+
+
+@dataclass
+class ABCIResponses:
+    """Responses from executing a block (reference state/store.go
+    ABCIResponses)."""
+    deliver_txs: List[abci.ResponseDeliverTx] = field(default_factory=list)
+    end_block: Optional[abci.ResponseEndBlock] = None
+    begin_block: Optional[abci.ResponseBeginBlock] = None
+
+    def results_hash(self) -> bytes:
+        """Merkle root of deterministic tx results (reference
+        types/results.go ABCIResults.Hash)."""
+        return merkle.hash_from_byte_slices(
+            [r.proto_deterministic() for r in self.deliver_txs])
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+def validator_updates_to_validators(updates) -> List[Validator]:
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    out = []
+    for vu in updates:
+        if vu.pub_key_type != "ed25519":
+            raise BlockExecutionError(
+                f"unsupported validator pubkey type {vu.pub_key_type}")
+        out.append(Validator.new(edkeys.PubKey(vu.pub_key_bytes), vu.power))
+    return out
+
+
+class BlockExecutor:
+    def __init__(self, state_store, app: abci.Application, mempool=None,
+                 evidence_pool=None, event_bus=None, block_store=None):
+        self.state_store = state_store
+        self.app = app
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.block_store = block_store
+
+    # -- proposal creation (reference state/execution.go:95-145) -----------
+
+    def create_proposal_block(self, height: int, state: State,
+                              commit: Commit,
+                              proposer_address: bytes) -> Block:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = (self.evidence_pool.pending_evidence(
+            state.consensus_params.evidence.max_bytes)
+            if self.evidence_pool else [])
+        max_data = max_data_bytes(max_bytes, len(evidence),
+                                  state.validators.size())
+        txs = (self.mempool.reap_max_bytes_max_gas(max_data, max_gas)
+               if self.mempool else [])
+        # PrepareProposal: the app may reorder/replace txs
+        rpp = self.app.prepare_proposal(abci.RequestPrepareProposal(
+            block_data=list(txs), block_data_size=max_data))
+        return state.make_block(height, list(rpp.block_data), commit,
+                                evidence, proposer_address)
+
+    def process_proposal(self, block: Block, state: State) -> bool:
+        """ProcessProposal ABCI gate (reference state/execution.go:147)."""
+        resp = self.app.process_proposal(abci.RequestProcessProposal(
+            txs=list(block.data.txs), header_proto=block.header.proto()))
+        return resp.accept
+
+    # -- validation (reference state/validation.go) ------------------------
+
+    def validate_block(self, state: State, block: Block):
+        block.validate_basic()
+        header = block.header
+        if header.version.block != 11 or header.version.app != state.app_version:
+            raise BlockExecutionError("wrong Block.Header.Version")
+        if header.chain_id != state.chain_id:
+            raise BlockExecutionError("wrong Block.Header.ChainID")
+        if header.height != state.last_block_height + 1 and not (
+                state.last_block_height == 0
+                and header.height == state.initial_height):
+            raise BlockExecutionError(
+                f"wrong Block.Header.Height: got {header.height}")
+        if header.last_block_id != state.last_block_id:
+            raise BlockExecutionError("wrong Block.Header.LastBlockID")
+        if header.app_hash != state.app_hash:
+            raise BlockExecutionError("wrong Block.Header.AppHash")
+        if header.validators_hash != state.validators.hash():
+            raise BlockExecutionError("wrong Block.Header.ValidatorsHash")
+        if header.next_validators_hash != state.next_validators.hash():
+            raise BlockExecutionError("wrong Block.Header.NextValidatorsHash")
+        if header.consensus_hash != state.consensus_params.hash():
+            raise BlockExecutionError("wrong Block.Header.ConsensusHash")
+        if header.last_results_hash != state.last_results_hash:
+            raise BlockExecutionError("wrong Block.Header.LastResultsHash")
+
+        # LastCommit (reference state/validation.go:92: the hot full-set
+        # verification -> TPU batch plane)
+        if block.header.height == state.initial_height:
+            if block.last_commit is not None and block.last_commit.signatures:
+                raise BlockExecutionError(
+                    "initial block can't have LastCommit signatures")
+        else:
+            if block.last_commit is None:
+                raise BlockExecutionError("nil LastCommit")
+            if len(block.last_commit.signatures) != state.last_validators.size():
+                raise BlockExecutionError("invalid LastCommit signature count")
+            state.last_validators.verify_commit(
+                state.chain_id, state.last_block_id,
+                block.header.height - 1, block.last_commit)
+
+        if not state.validators.has_address(header.proposer_address):
+            raise BlockExecutionError(
+                "block proposer is not in the validator set")
+
+    # -- apply (reference state/execution.go:189-266) ----------------------
+
+    def apply_block(self, state: State, block_id: BlockID,
+                    block: Block) -> Tuple[State, ABCIResponses]:
+        self.validate_block(state, block)
+
+        responses = self._exec_block_on_app(state, block)
+        fail_point(1)
+
+        if self.state_store is not None:
+            self.state_store.save_abci_responses(block.header.height,
+                                                 responses)
+        fail_point(2)
+
+        validator_updates = validator_updates_to_validators(
+            responses.end_block.validator_updates
+            if responses.end_block else [])
+
+        new_state = update_state(state, block_id, block, responses,
+                                 validator_updates)
+
+        # Commit app state; lock+flush mempool against the new height
+        app_hash = self._commit(new_state, block)
+        new_state.app_hash = app_hash
+        fail_point(3)
+
+        if self.state_store is not None:
+            self.state_store.save(new_state)
+        fail_point(4)
+
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(new_state, block.evidence)
+
+        if self.event_bus is not None:
+            self._fire_events(block, block_id, responses, validator_updates)
+        return new_state, responses
+
+    def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:
+        last_commit_votes = []
+        if block.last_commit is not None and state.last_validators is not None:
+            for i, cs in enumerate(block.last_commit.signatures):
+                _, val = state.last_validators.get_by_index(i)
+                if val is not None:
+                    last_commit_votes.append((val, not cs.is_absent()))
+        rbb = self.app.begin_block(abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header_proto=block.header.proto(),
+            last_commit_votes=last_commit_votes,
+            byzantine_validators=list(block.evidence)))
+        dtxs = [self.app.deliver_tx(tx) for tx in block.data.txs]
+        reb = self.app.end_block(block.header.height)
+        return ABCIResponses(deliver_txs=dtxs, end_block=reb,
+                             begin_block=rbb)
+
+    def _commit(self, state: State, block: Block) -> bytes:
+        if self.mempool is not None:
+            self.mempool.lock()
+        try:
+            rc = self.app.commit()
+            if self.mempool is not None:
+                self.mempool.update(block.header.height, block.data.txs)
+        finally:
+            if self.mempool is not None:
+                self.mempool.unlock()
+        return rc.data
+
+    def _fire_events(self, block, block_id, responses, validator_updates):
+        self.event_bus.publish_new_block(block, block_id, responses)
+        if validator_updates:
+            self.event_bus.publish_validator_set_updates(validator_updates)
+
+
+def update_state(state: State, block_id: BlockID, block: Block,
+                 responses: ABCIResponses,
+                 validator_updates: List[Validator]) -> State:
+    """Reference state/execution.go updateState."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if validator_updates:
+        n_val_set.update_with_change_set(validator_updates)
+        last_height_vals_changed = block.header.height + 1 + 1
+    n_val_set.increment_proposer_priority(1)
+
+    next_params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if responses.end_block is not None and \
+            responses.end_block.consensus_param_updates is not None:
+        next_params = state.consensus_params.update(
+            responses.end_block.consensus_param_updates)
+        next_params.validate_basic()
+        last_height_params_changed = block.header.height + 1
+
+    return State(
+        chain_id=state.chain_id,
+        initial_height=state.initial_height,
+        last_block_height=block.header.height,
+        last_block_id=block_id,
+        last_block_time=block.header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=next_params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=responses.results_hash(),
+        app_hash=b"",  # set by caller after app Commit
+        app_version=state.app_version,
+    )
+
+
+def max_data_bytes(max_bytes: int, evidence_count: int, vals_count: int) -> int:
+    """Approximate tx-byte budget (reference types/block.go MaxDataBytes)."""
+    overhead = 1024 + 121 * vals_count + 500 * evidence_count
+    return max(max_bytes - overhead, 1024)

@@ -1,0 +1,184 @@
+"""Block, Header, Data (reference types/block.go).
+
+Header.hash() merkle-izes the 14 header fields exactly as the reference
+(types/block.go:440-475): each leaf is the field's protobuf encoding, with
+scalars wrapped in gogotypes *Value messages (encoding_helper.go cdcEncode).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.crypto import merkle, tmhash
+from tendermint_tpu.libs import protoenc as pe
+
+from .basic import BlockID, Timestamp
+from .commit import Commit
+
+MAX_HEADER_BYTES = 626  # reference types/block.go:32
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """Version info (proto/tendermint/version/types.proto Consensus)."""
+    block: int = 11  # BlockProtocol (reference version/version.go:22)
+    app: int = 0
+
+    def proto(self) -> bytes:
+        return pe.varint_field(1, self.block) + pe.varint_field(2, self.app)
+
+
+def _wrap_string(s: str) -> bytes:
+    return pe.string_field(1, s)
+
+
+def _wrap_int64(v: int) -> bytes:
+    return pe.varint_field(1, v)
+
+
+def _wrap_bytes(b: bytes) -> bytes:
+    return pe.bytes_field(1, b)
+
+
+@dataclass
+class Header:
+    version: Consensus = field(default_factory=Consensus)
+    chain_id: str = ""
+    height: int = 0
+    time: Timestamp = field(default_factory=Timestamp.zero)
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> Optional[bytes]:
+        """Merkle root of the proto-encoded fields (reference
+        types/block.go:440); None until validators_hash is populated."""
+        if not self.validators_hash:
+            return None
+        return merkle.hash_from_byte_slices([
+            self.version.proto(),
+            _wrap_string(self.chain_id),
+            _wrap_int64(self.height),
+            self.time.proto(),
+            self.last_block_id.proto(),
+            _wrap_bytes(self.last_commit_hash),
+            _wrap_bytes(self.data_hash),
+            _wrap_bytes(self.validators_hash),
+            _wrap_bytes(self.next_validators_hash),
+            _wrap_bytes(self.consensus_hash),
+            _wrap_bytes(self.app_hash),
+            _wrap_bytes(self.last_results_hash),
+            _wrap_bytes(self.evidence_hash),
+            _wrap_bytes(self.proposer_address),
+        ])
+
+    def proto(self) -> bytes:
+        return (
+            pe.message_field_always(1, self.version.proto())
+            + pe.string_field(2, self.chain_id)
+            + pe.varint_field(3, self.height)
+            + pe.message_field_always(4, self.time.proto())
+            + pe.message_field_always(5, self.last_block_id.proto())
+            + pe.bytes_field(6, self.last_commit_hash)
+            + pe.bytes_field(7, self.data_hash)
+            + pe.bytes_field(8, self.validators_hash)
+            + pe.bytes_field(9, self.next_validators_hash)
+            + pe.bytes_field(10, self.consensus_hash)
+            + pe.bytes_field(11, self.app_hash)
+            + pe.bytes_field(12, self.last_results_hash)
+            + pe.bytes_field(13, self.evidence_hash)
+            + pe.bytes_field(14, self.proposer_address)
+        )
+
+    def validate_basic(self):
+        if len(self.chain_id) > 50:
+            raise ValueError("chain_id too long")
+        if self.height < 0:
+            raise ValueError("negative height")
+        self.last_block_id.validate_basic()
+        for name in ("last_commit_hash", "data_hash", "validators_hash",
+                     "next_validators_hash", "consensus_hash",
+                     "last_results_hash", "evidence_hash"):
+            h = getattr(self, name)
+            if h and len(h) != tmhash.SIZE:
+                raise ValueError(f"wrong {name} size")
+        if (self.proposer_address
+                and len(self.proposer_address) != 20):
+            raise ValueError("invalid proposer address size")
+
+
+@dataclass
+class Data:
+    """Transactions in the block (reference types/block.go Data)."""
+    txs: List[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(list(self.txs))
+
+    def proto(self) -> bytes:
+        return b"".join(pe.bytes_field(1, tx) for tx in self.txs)
+
+
+def tx_hash(tx: bytes) -> bytes:
+    """Tx key for mempool/index (reference types/tx.go Hash = SHA-256)."""
+    return tmhash.sum(tx)
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data
+    evidence: List = field(default_factory=list)
+    last_commit: Optional[Commit] = None
+
+    def hash(self) -> Optional[bytes]:
+        return self.header.hash()
+
+    def proto(self) -> bytes:
+        ev_body = b"".join(
+            pe.message_field_always(1, e.proto()) for e in self.evidence)
+        out = (pe.message_field_always(1, self.header.proto())
+               + pe.message_field_always(2, self.data.proto())
+               + pe.message_field_always(3, ev_body))
+        if self.last_commit is not None:
+            out += pe.message_field_always(4, self.last_commit.proto())
+        return out
+
+    def fill_header(self):
+        """Populate derived header hashes (reference types/block.go
+        fillHeader)."""
+        if not self.header.last_commit_hash and self.last_commit is not None:
+            self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = merkle.hash_from_byte_slices(
+                [e.bytes() for e in self.evidence])
+
+    def validate_basic(self):
+        self.header.validate_basic()
+        if self.header.height > 1:
+            if self.last_commit is None:
+                raise ValueError("nil LastCommit")
+            self.last_commit.validate_basic()
+        if self.last_commit is not None and self.header.last_commit_hash:
+            if self.header.last_commit_hash != self.last_commit.hash():
+                raise ValueError("wrong LastCommitHash")
+        if self.header.data_hash and self.header.data_hash != self.data.hash():
+            raise ValueError("wrong DataHash")
+
+
+@dataclass
+class BlockMeta:
+    """Stored per-height block metadata (reference types/block_meta.go)."""
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int

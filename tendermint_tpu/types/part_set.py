@@ -1,0 +1,111 @@
+"""PartSet — block split into 64KB merkle-proved parts for gossip
+(reference types/part_set.go).
+
+This is the reference's mechanism for moving one large logical item in
+verifiable chunks; the part-set root is what proposals commit to.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.libs.bits import BitArray
+
+from .basic import PartSetHeader
+
+BLOCK_PART_SIZE_BYTES = 65536  # reference types/params.go:19
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self):
+        if self.index < 0:
+            raise ValueError("negative part index")
+        if len(self.bytes_) > BLOCK_PART_SIZE_BYTES:
+            raise ValueError("part too big")
+        if (self.proof.total < 0 or self.proof.index < 0
+                or len(self.proof.leaf_hash) != 32):
+            raise ValueError("invalid part proof")
+
+    def proto(self) -> bytes:
+        proof_body = (
+            pe.varint_field(1, self.proof.total)
+            + pe.varint_field(2, self.proof.index)
+            + pe.bytes_field(3, self.proof.leaf_hash)
+            + b"".join(pe.bytes_field(4, a) for a in self.proof.aunts))
+        return (pe.varint_field(1, self.index)
+                + pe.bytes_field(2, self.bytes_)
+                + pe.message_field_always(3, proof_body))
+
+
+class PartSet:
+    def __init__(self, header: PartSetHeader):
+        self.header_ = header
+        self.parts: List[Optional[Part]] = [None] * header.total
+        self.parts_bit_array = BitArray(header.total)
+        self.count = 0
+        self.byte_size = 0
+
+    @classmethod
+    def from_data(cls, data: bytes,
+                  part_size: int = BLOCK_PART_SIZE_BYTES) -> "PartSet":
+        """Split data into parts with merkle proofs (reference
+        types/part_set.go NewPartSetFromData)."""
+        chunks = [data[i:i + part_size]
+                  for i in range(0, max(len(data), 1), part_size)]
+        if not chunks:
+            chunks = [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps.add_part(Part(i, chunk, proof))
+        return ps
+
+    def header(self) -> PartSetHeader:
+        return self.header_
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self.header_ == header
+
+    def add_part(self, part: Part) -> bool:
+        """Verify the part's proof against the header and add it; returns
+        False if already present (reference types/part_set.go AddPart)."""
+        if part.index >= self.header_.total:
+            raise ValueError("unexpected part index")
+        if self.parts[part.index] is not None:
+            return False
+        part.validate_basic()
+        if not part.proof.verify(self.header_.hash, part.bytes_):
+            raise ValueError("wrong part proof")
+        if part.proof.total != self.header_.total:
+            raise ValueError("wrong proof total")
+        if part.proof.index != part.index:
+            raise ValueError("wrong proof index")
+        self.parts[part.index] = part
+        self.parts_bit_array.set_index(part.index, True)
+        self.count += 1
+        self.byte_size += len(part.bytes_)
+        return True
+
+    def get_part(self, index: int) -> Optional[Part]:
+        if 0 <= index < len(self.parts):
+            return self.parts[index]
+        return None
+
+    def is_complete(self) -> bool:
+        return self.count == self.header_.total
+
+    def bit_array(self) -> BitArray:
+        return self.parts_bit_array.copy()
+
+    def assemble(self) -> bytes:
+        """Reassemble the original data; requires completeness."""
+        if not self.is_complete():
+            raise ValueError("part set incomplete")
+        return b"".join(p.bytes_ for p in self.parts)

@@ -149,11 +149,12 @@ class VoteSet:
             raise VoteSetError("duplicate vote (already handled)")
         else:
             conflicting = existing
-            # replace canonical vote only if this one is for a
-            # peer-claimed-2/3 block (reference :265-270)
-            bv = self.votes_by_block.get(block_key)
-            if bv is not None and bv.peer_maj23:
+            # replace the canonical vote only if the new one is for the
+            # established 2/3-majority block (reference
+            # types/vote_set.go:252-256)
+            if self.maj23 is not None and self.maj23.key() == block_key:
                 self.votes[val_index] = vote
+                self.votes_bit_array.set_index(val_index, True)
 
         bv = self.votes_by_block.get(block_key)
         if bv is None:

@@ -4,14 +4,13 @@ Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual CPU mesh (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
 
-Also enables the persistent compilation cache: the ed25519 verify kernel
-takes minutes to compile per (shape, platform) and every pytest process
-would otherwise recompile from scratch.
+NOTE: this environment pre-imports jax at interpreter startup (PYTHONPATH
+sitecustomize registering the tunneled-TPU "axon" PJRT plugin with
+JAX_PLATFORMS=axon), so env vars are too late — the platform must be forced
+via jax.config.update, and XLA_FLAGS set before first backend init.
 """
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,3 +18,6 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import tendermint_tpu  # noqa: E402  (sets compilation-cache env defaults)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,110 @@
+"""In-process consensus network fixtures (modeled on the reference's
+consensus/common_test.go randConsensusNet: N real consensus states wired
+through in-memory connections, each with its own kvstore app)."""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.config import test_config
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.basic import Timestamp
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.crypto import ed25519 as edkeys
+
+CHAIN_ID = "test-chain-tpu"
+
+
+def make_genesis(n_validators: int, power: int = 10):
+    privs = [edkeys.PrivKey((0xBEE + i).to_bytes(32, "big"))
+             for i in range(n_validators)]
+    gdoc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Timestamp(1700000000, 0),
+        validators=[
+            GenesisValidator(
+                address=p.pub_key().address(), pub_key_type="ed25519",
+                pub_key_bytes=p.pub_key().bytes(), power=power)
+            for p in privs
+        ])
+    return gdoc, privs
+
+
+class Node:
+    """One in-process consensus node over its own kvstore app."""
+
+    def __init__(self, gdoc: GenesisDoc, priv: Optional[edkeys.PrivKey],
+                 name: str = "", wal_path: Optional[str] = None,
+                 config=None):
+        self.app = KVStoreApplication()
+        self.mempool = Mempool(self.app)
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(MemDB())
+        self.event_bus = EventBus()
+        self.exec = BlockExecutor(self.state_store, self.app,
+                                  mempool=self.mempool,
+                                  event_bus=self.event_bus)
+        state = state_from_genesis(gdoc)
+        self.pv = FilePV(priv) if priv is not None else None
+        self.cs = ConsensusState(
+            config or test_config(), state, self.exec, self.block_store,
+            mempool=self.mempool, priv_validator=self.pv,
+            wal_path=wal_path, event_bus=self.event_bus, name=name)
+        self.mempool.on_new_tx(self.cs.notify_txs_available)
+
+    def start(self):
+        self.cs.start()
+
+    def stop(self):
+        self.cs.stop()
+
+
+def wire(nodes: List[Node]):
+    """Full-mesh gossip: every node's broadcasts feed every other node's
+    queues (the in-memory analog of the consensus reactor's channels)."""
+    for i, a in enumerate(nodes):
+        peers = [b for j, b in enumerate(nodes) if j != i]
+        pid = f"node{i}"
+
+        def mk(peers=peers, pid=pid):
+            def on_vote(vote):
+                for b in peers:
+                    b.cs.add_vote(vote, peer_id=pid)
+
+            def on_proposal(p):
+                for b in peers:
+                    b.cs.set_proposal(p, peer_id=pid)
+
+            def on_part(h, r, part):
+                for b in peers:
+                    b.cs.add_block_part(h, r, part, peer_id=pid)
+            return on_vote, on_proposal, on_part
+
+        ov, op, opart = mk()
+        a.cs.broadcast_vote.append(ov)
+        a.cs.broadcast_proposal.append(op)
+        a.cs.broadcast_block_part.append(opart)
+
+
+def wait_for_height(nodes: List[Node], height: int, timeout: float = 30.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(n.block_store.height() >= height for n in nodes):
+            return True
+        if any(not n.cs.is_running() for n in nodes):
+            raise RuntimeError("a consensus state machine died")
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"heights: {[n.block_store.height() for n in nodes]}, wanted "
+        f"{height}")

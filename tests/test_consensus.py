@@ -1,0 +1,141 @@
+"""Consensus state machine end-to-end: block production, multi-validator
+agreement, tx inclusion, WAL replay after crash (modeled on reference
+consensus/state_test.go + replay_test.go scenarios)."""
+import os
+import tempfile
+import time
+
+import pytest
+
+from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+from helpers import Node, make_genesis, wire, wait_for_height
+
+
+def test_single_validator_produces_blocks():
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], "solo")
+    node.start()
+    try:
+        wait_for_height([node], 3, timeout=30)
+        # committed blocks verify and link
+        b1 = node.block_store.load_block(1)
+        b2 = node.block_store.load_block(2)
+        assert b1 is not None and b2 is not None
+        assert b2.last_commit is not None
+        assert b2.header.last_block_id.hash == b1.hash()
+        sc = node.block_store.load_seen_commit(1)
+        assert sc is not None and sc.height == 1
+    finally:
+        node.stop()
+
+
+def test_four_validators_commit_same_chain():
+    gdoc, privs = make_genesis(4)
+    nodes = [Node(gdoc, p, f"v{i}") for i, p in enumerate(privs)]
+    wire(nodes)
+    for n in nodes:
+        n.start()
+    try:
+        wait_for_height(nodes, 3, timeout=45)
+        h1 = {n.block_store.load_block(1).hash() for n in nodes}
+        h2 = {n.block_store.load_block(2).hash() for n in nodes}
+        assert len(h1) == 1 and len(h2) == 1, "nodes disagree on chain"
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_tx_inclusion_and_app_state():
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], "solo-tx")
+    node.start()
+    try:
+        res = node.mempool.check_tx(b"alice=1000")
+        assert res.is_ok()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if node.app.data.get(b"alice") == b"1000":
+                break
+            time.sleep(0.05)
+        assert node.app.data.get(b"alice") == b"1000"
+        assert node.mempool.size() == 0  # removed after commit
+    finally:
+        node.stop()
+
+
+def test_three_of_four_liveness():
+    """Consensus proceeds with one validator down (2/3+ alive)."""
+    gdoc, privs = make_genesis(4)
+    nodes = [Node(gdoc, p, f"l{i}") for i, p in enumerate(privs[:3])]
+    # node 3 never starts; wire only the live ones
+    wire(nodes)
+    for n in nodes:
+        n.start()
+    try:
+        wait_for_height(nodes, 2, timeout=60)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_wal_written_and_replayable(tmp_path):
+    wal_path = str(tmp_path / "cs.wal")
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], "walnode", wal_path=wal_path)
+    node.start()
+    try:
+        wait_for_height([node], 2, timeout=30)
+    finally:
+        node.stop()
+    msgs = list(WAL.iter_messages(wal_path))
+    assert msgs, "WAL empty"
+    ends = [m for m in msgs if isinstance(m, EndHeightMessage)]
+    assert any(m.height == 1 for m in ends)
+    # torn tail tolerance: truncate mid-frame, iteration still works
+    with open(wal_path, "ab") as f:
+        f.write(b"\x00\x01\x02")
+    msgs2 = list(WAL.iter_messages(wal_path))
+    assert len(msgs2) == len(msgs)
+
+
+def test_crash_recovery_resumes_chain(tmp_path):
+    """Stop a node mid-chain; a fresh node over the same stores+WAL resumes
+    from the persisted height (handshake-free restart path)."""
+    wal_path = str(tmp_path / "cs2.wal")
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], "crash1", wal_path=wal_path)
+    node.start()
+    try:
+        wait_for_height([node], 2, timeout=30)
+    finally:
+        node.stop()
+    committed = node.block_store.height()
+    assert committed >= 2
+
+    # "restart": same app state is rebuilt by replaying blocks into a fresh
+    # app (the reference's handshake replay); here we reuse store+state.
+    st = node.state_store.load()
+    assert st is not None and st.last_block_height == committed
+
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.consensus.config import test_config
+    from tendermint_tpu.state.execution import BlockExecutor
+
+    # replay blocks into a fresh app to rebuild app state
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    app2 = KVStoreApplication()
+    exec2 = BlockExecutor(node.state_store, app2, mempool=node.mempool)
+    cs2 = ConsensusState(test_config(), st, exec2, node.block_store,
+                         mempool=node.mempool, priv_validator=node.pv,
+                         wal_path=wal_path, name="crash2")
+    cs2.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if node.block_store.height() >= committed + 2:
+                break
+            time.sleep(0.05)
+        assert node.block_store.height() >= committed + 2
+    finally:
+        cs2.stop()

@@ -84,15 +84,60 @@ def test_mul_lazy_operands():
         assert F.limbs_to_int(col(out, i)) % P == want
 
 
+LOOSE_L = (1 << 12) + (1 << 9)  # carry()'s documented output bound
+
+
 def test_mul_worst_case_limbs():
-    """All-ones worst-case limb magnitudes: limbs at ±(2^13-1)."""
-    hi = np.full((F.NLIMB, 1), (1 << 13) - 1, dtype=np.int32)
+    """Worst-case lazy operands: limbs at ±(2L-1) (one lazy add/sub of
+    loose-carried values, the documented mul operand bound)."""
+    hi = np.full((F.NLIMB, 1), 2 * LOOSE_L - 1, dtype=np.int32)
     lo = -hi
     for a_np, b_np in [(hi, hi), (hi, lo), (lo, lo)]:
         a_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(a_np[:, 0]))
         b_val = sum(int(v) << (F.RADIX * i) for i, v in enumerate(b_np[:, 0]))
         out = np.asarray(F.mul(jnp.asarray(a_np), jnp.asarray(b_np)))
         assert F.limbs_to_int(col(out, 0)) % P == (a_val * b_val) % P
+        assert out.max() < LOOSE_L and out.min() > -(1 << 10), (
+            out.max(), out.min())
+
+
+def test_mul_extreme_lazy_bound():
+    """mul's documented operand contract at its extreme: |a| = 10240
+    (three-term lazy combination) x |b| = 9216 (two-term) must not
+    overflow int32 anywhere in the reduction."""
+    amax, bmax = 10240, 9216
+    for asign in (1, -1):
+        for bsign in (1, -1):
+            a_np = np.full((F.NLIMB, 1), asign * amax, dtype=np.int32)
+            b_np = np.full((F.NLIMB, 1), bsign * bmax, dtype=np.int32)
+            a_val = sum(int(v) << (F.RADIX * i)
+                        for i, v in enumerate(a_np[:, 0]))
+            b_val = sum(int(v) << (F.RADIX * i)
+                        for i, v in enumerate(b_np[:, 0]))
+            out = np.asarray(F.mul(jnp.asarray(a_np), jnp.asarray(b_np)))
+            assert F.limbs_to_int(col(out, 0)) % P == (a_val * b_val) % P
+
+
+def test_carry_bounds():
+    """carry() must honor its loose-carried contract for adversarial int32
+    inputs: correct value mod p AND limbs in (-2^10, L)."""
+    cases = [
+        np.full((F.NLIMB, 1), (1 << 30) + 12345, dtype=np.int32),
+        np.full((F.NLIMB, 1), -(1 << 30), dtype=np.int32),
+        np.asarray([[(1 << 30)] if i % 2 else [-(1 << 30)]
+                    for i in range(F.NLIMB)], dtype=np.int32),
+        np.asarray([[-5]] + [[0]] * (F.NLIMB - 1), dtype=np.int32),  # negative total
+    ]
+    for v in cases:
+        val = sum(int(x) << (F.RADIX * i) for i, x in enumerate(v[:, 0]))
+        out = np.asarray(F.carry(jnp.asarray(v)))
+        got = sum(int(x) << (F.RADIX * i) for i, x in enumerate(out[:, 0]))
+        assert got % P == val % P
+        assert out.max() < LOOSE_L and out.min() > -(1 << 10), (
+            out.max(), out.min())
+        # and freeze canonicalizes it exactly
+        frozen = np.asarray(F.freeze(jnp.asarray(v)))
+        assert F.limbs_to_int(col(frozen, 0)) == val % P
 
 
 def test_freeze_and_eq():
