@@ -1,0 +1,176 @@
+"""Node configuration (reference config/config.go), TOML-backed.
+
+Layout under $TMHOME mirrors the reference: config/config.toml,
+config/genesis.json, config/node_key.json, config/priv_validator_key.json,
+data/ (stores + WAL).
+"""
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "127.0.0.1:26656"
+    persistent_peers: str = ""  # comma-separated id@host:port
+    max_num_peers: int = 50
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    cache_size: int = 10000
+    max_tx_bytes: int = 1048576
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "127.0.0.1:26657"
+    enabled: bool = True
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+
+
+@dataclass
+class BatchVerifierConfig:
+    """TPU data-plane routing (no reference analog — the new component)."""
+    tpu_threshold: int = 32
+    enable: bool = True
+
+
+@dataclass
+class Config:
+    home: str = ""
+    moniker: str = "node"
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    block_sync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    batch_verifier: BatchVerifierConfig = field(
+        default_factory=BatchVerifierConfig)
+
+    # -- paths -------------------------------------------------------------
+
+    def config_dir(self) -> str:
+        return os.path.join(self.home, "config")
+
+    def data_dir(self) -> str:
+        return os.path.join(self.home, "data")
+
+    def genesis_file(self) -> str:
+        return os.path.join(self.config_dir(), "genesis.json")
+
+    def node_key_file(self) -> str:
+        return os.path.join(self.config_dir(), "node_key.json")
+
+    def priv_validator_key_file(self) -> str:
+        return os.path.join(self.config_dir(), "priv_validator_key.json")
+
+    def priv_validator_state_file(self) -> str:
+        return os.path.join(self.data_dir(), "priv_validator_state.json")
+
+    def wal_file(self) -> str:
+        return os.path.join(self.data_dir(), "cs.wal")
+
+    def block_db_file(self) -> str:
+        return os.path.join(self.data_dir(), "blockstore.db")
+
+    def state_db_file(self) -> str:
+        return os.path.join(self.data_dir(), "state.db")
+
+    def ensure_dirs(self):
+        os.makedirs(self.config_dir(), exist_ok=True)
+        os.makedirs(self.data_dir(), exist_ok=True)
+
+    # -- TOML --------------------------------------------------------------
+
+    def save(self):
+        self.ensure_dirs()
+        c = self.consensus
+        text = f"""# tendermint_tpu node configuration
+moniker = "{self.moniker}"
+
+[p2p]
+laddr = "{self.p2p.laddr}"
+persistent_peers = "{self.p2p.persistent_peers}"
+max_num_peers = {self.p2p.max_num_peers}
+
+[mempool]
+size = {self.mempool.size}
+cache_size = {self.mempool.cache_size}
+max_tx_bytes = {self.mempool.max_tx_bytes}
+
+[rpc]
+laddr = "{self.rpc.laddr}"
+enabled = {str(self.rpc.enabled).lower()}
+
+[block_sync]
+enable = {str(self.block_sync.enable).lower()}
+
+[batch_verifier]
+tpu_threshold = {self.batch_verifier.tpu_threshold}
+enable = {str(self.batch_verifier.enable).lower()}
+
+[consensus]
+timeout_propose = {c.timeout_propose}
+timeout_propose_delta = {c.timeout_propose_delta}
+timeout_prevote = {c.timeout_prevote}
+timeout_prevote_delta = {c.timeout_prevote_delta}
+timeout_precommit = {c.timeout_precommit}
+timeout_precommit_delta = {c.timeout_precommit_delta}
+timeout_commit = {c.timeout_commit}
+skip_timeout_commit = {str(c.skip_timeout_commit).lower()}
+create_empty_blocks = {str(c.create_empty_blocks).lower()}
+create_empty_blocks_interval = {c.create_empty_blocks_interval}
+"""
+        with open(os.path.join(self.config_dir(), "config.toml"), "w") as f:
+            f.write(text)
+
+    @classmethod
+    def load(cls, home: str) -> "Config":
+        cfg = cls(home=home)
+        path = os.path.join(home, "config", "config.toml")
+        if not os.path.exists(path):
+            return cfg
+        with open(path, "rb") as f:
+            d = tomllib.load(f)
+        cfg.moniker = d.get("moniker", cfg.moniker)
+        p = d.get("p2p", {})
+        cfg.p2p = P2PConfig(
+            laddr=p.get("laddr", cfg.p2p.laddr),
+            persistent_peers=p.get("persistent_peers", ""),
+            max_num_peers=p.get("max_num_peers", 50))
+        m = d.get("mempool", {})
+        cfg.mempool = MempoolConfig(
+            size=m.get("size", 5000), cache_size=m.get("cache_size", 10000),
+            max_tx_bytes=m.get("max_tx_bytes", 1048576))
+        r = d.get("rpc", {})
+        cfg.rpc = RPCConfig(laddr=r.get("laddr", cfg.rpc.laddr),
+                            enabled=r.get("enabled", True))
+        bs = d.get("block_sync", {})
+        cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
+        bv = d.get("batch_verifier", {})
+        cfg.batch_verifier = BatchVerifierConfig(
+            tpu_threshold=bv.get("tpu_threshold", 32),
+            enable=bv.get("enable", True))
+        c = d.get("consensus", {})
+        cc = ConsensusConfig()
+        for k in ("timeout_propose", "timeout_propose_delta",
+                  "timeout_prevote", "timeout_prevote_delta",
+                  "timeout_precommit", "timeout_precommit_delta",
+                  "timeout_commit", "create_empty_blocks_interval"):
+            if k in c:
+                setattr(cc, k, float(c[k]))
+        for k in ("skip_timeout_commit", "create_empty_blocks"):
+            if k in c:
+                setattr(cc, k, bool(c[k]))
+        cfg.consensus = cc
+        return cfg

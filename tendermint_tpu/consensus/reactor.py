@@ -1,0 +1,187 @@
+"""Consensus reactor (reference consensus/reactor.go): gossips round
+state, proposals/parts and votes over three channels (0x20-0x22).
+
+Simplifications vs the reference (full part-by-part/bit-array gossip comes
+with larger nets): new proposals/parts/votes are broadcast to all peers,
+and a per-peer catch-up thread re-sends votes/parts to peers that report
+(via NewRoundStep) being behind in the current height — enough for
+localnet-scale operation plus blocksync for big gaps.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.basic import SignedMsgType
+
+from .round_types import Step
+from .state import ConsensusState
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+
+
+@register
+@dataclass
+class NewRoundStepMessage:
+    height: int
+    round: int
+    step: int
+    last_commit_round: int
+
+
+@register
+@dataclass
+class ProposalGossip:
+    proposal: object
+
+
+@register
+@dataclass
+class BlockPartGossip:
+    height: int
+    round: int
+    part: object
+
+
+@register
+@dataclass
+class VoteGossip:
+    vote: object
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState):
+        super().__init__("CONSENSUS")
+        self.cs = cs
+        self._peer_state: Dict[str, NewRoundStepMessage] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+        cs.broadcast_vote.append(self._on_new_vote)
+        cs.broadcast_proposal.append(self._on_new_proposal)
+        cs.broadcast_block_part.append(self._on_new_part)
+        if cs.event_bus is not None:
+            self._sub = cs.event_bus.subscribe("NewRoundStep")
+            threading.Thread(target=self._step_broadcaster,
+                             daemon=True).start()
+        threading.Thread(target=self._catchup_routine, daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6,
+                              send_queue_capacity=100),
+            ChannelDescriptor(DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7,
+                              send_queue_capacity=200),
+        ]
+
+    # -- outbound ----------------------------------------------------------
+
+    def _round_step_msg(self) -> NewRoundStepMessage:
+        rs = self.cs.get_round_state()
+        return NewRoundStepMessage(rs.height, rs.round, int(rs.step),
+                                   rs.commit_round)
+
+    def _on_new_vote(self, vote):
+        if self.switch is not None:
+            self.switch.broadcast(VOTE_CHANNEL, VoteGossip(vote))
+
+    def _on_new_proposal(self, proposal):
+        if self.switch is not None:
+            self.switch.broadcast(DATA_CHANNEL, ProposalGossip(proposal))
+
+    def _on_new_part(self, height, round_, part):
+        if self.switch is not None:
+            self.switch.broadcast(DATA_CHANNEL,
+                                  BlockPartGossip(height, round_, part))
+
+    def _step_broadcaster(self):
+        while not self._stop.is_set():
+            try:
+                self._sub.queue.get(timeout=0.2)
+            except Exception:  # queue.Empty
+                continue
+            if self.switch is not None:
+                self.switch.broadcast(STATE_CHANNEL, self._round_step_msg())
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer: Peer):
+        peer.send(STATE_CHANNEL, self._round_step_msg())
+
+    def remove_peer(self, peer: Peer, reason):
+        with self._lock:
+            self._peer_state.pop(peer.id, None)
+
+    # -- inbound -----------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
+        msg = loads(msg_bytes)
+        if ch_id == STATE_CHANNEL:
+            if isinstance(msg, NewRoundStepMessage):
+                with self._lock:
+                    self._peer_state[peer.id] = msg
+        elif ch_id == DATA_CHANNEL:
+            if isinstance(msg, ProposalGossip):
+                self.cs.set_proposal(msg.proposal, peer_id=peer.id)
+            elif isinstance(msg, BlockPartGossip):
+                self.cs.add_block_part(msg.height, msg.round, msg.part,
+                                       peer_id=peer.id)
+        elif ch_id == VOTE_CHANNEL:
+            if isinstance(msg, VoteGossip):
+                self.cs.add_vote(msg.vote, peer_id=peer.id)
+
+    # -- catch-up gossip (simplified gossipVotesRoutine) -------------------
+
+    def _catchup_routine(self):
+        rng = random.Random()
+        while not self._stop.is_set():
+            time.sleep(0.1)
+            if self.switch is None:
+                continue
+            with self._lock:
+                peer_states = dict(self._peer_state)
+            if not peer_states:
+                continue
+            with self.cs._mtx:
+                rs = self.cs.rs
+                height, round_ = rs.height, rs.round
+                votes = rs.votes
+                proposal = rs.proposal
+                parts = rs.proposal_block_parts
+                if votes is None:
+                    continue
+                prevotes = list(votes.prevotes(round_).votes)
+                precommits = list(votes.precommits(round_).votes)
+            for pid, ps in peer_states.items():
+                peer = self.switch.peers.get(pid)
+                if peer is None or ps.height != height:
+                    continue
+                # re-send current-round votes the peer may be missing
+                candidates = [v for v in prevotes + precommits
+                              if v is not None]
+                if ps.round < round_ or ps.step < int(Step.PRECOMMIT):
+                    if candidates:
+                        v = rng.choice(candidates)
+                        peer.try_send(VOTE_CHANNEL, VoteGossip(v))
+                    if proposal is not None and ps.round == round_:
+                        peer.try_send(DATA_CHANNEL, ProposalGossip(proposal))
+                        if parts is not None:
+                            for i in range(parts.header().total):
+                                part = parts.get_part(i)
+                                if part is not None:
+                                    peer.try_send(
+                                        DATA_CHANNEL,
+                                        BlockPartGossip(height, round_, part))

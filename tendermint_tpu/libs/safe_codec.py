@@ -1,0 +1,67 @@
+"""Restricted (de)serialization for wire/storage use.
+
+Pickle is convenient for our dataclass graph but unpickling attacker bytes
+is code execution; this wraps it with a class whitelist: only types
+registered here (framework dataclasses + harmless builtins) deserialize.
+The p2p layer, WAL and stores use these instead of raw pickle.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Set, Tuple
+
+_ALLOWED: Set[Tuple[str, str]] = set()
+
+
+def register(cls) -> type:
+    """Allow a class for deserialization (usable as a decorator)."""
+    _ALLOWED.add((cls.__module__, cls.__qualname__))
+    return cls
+
+
+def _register_defaults():
+    from tendermint_tpu.types import basic, block, commit, part_set, proposal, vote
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.consensus import round_types, wal
+
+    for cls in (
+        basic.Timestamp, basic.BlockID, basic.PartSetHeader,
+        basic.SignedMsgType, basic.BlockIDFlag,
+        block.Header, block.Block, block.Data, block.Consensus,
+        block.BlockMeta,
+        commit.Commit, commit.CommitSig,
+        part_set.Part, merkle.Proof,
+        proposal.Proposal, vote.Vote,
+        round_types.ProposalMessage, round_types.BlockPartMessage,
+        round_types.VoteMessage, round_types.TimeoutInfo, round_types.Step,
+        wal.EndHeightMessage,
+    ):
+        register(cls)
+
+
+_BUILTINS = {
+    ("builtins", "bytes"), ("builtins", "bytearray"), ("builtins", "int"),
+    ("builtins", "str"), ("builtins", "list"), ("builtins", "dict"),
+    ("builtins", "tuple"), ("builtins", "set"), ("builtins", "frozenset"),
+    ("builtins", "bool"), ("builtins", "float"), ("builtins", "complex"),
+    ("builtins", "NoneType"),
+}
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if not _ALLOWED:
+            _register_defaults()
+        if (module, name) in _ALLOWED or (module, name) in _BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"deserialization of {module}.{name} is not allowed")
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=4)
+
+
+def loads(data: bytes):
+    return _SafeUnpickler(io.BytesIO(data)).load()

@@ -1,0 +1,77 @@
+"""Mempool reactor (reference mempool/v0/reactor.go): gossip admitted txs
+to peers; the LRU cache dedups loops."""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict
+
+from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+
+from .mempool import Mempool
+
+MEMPOOL_CHANNEL = 0x30
+
+
+@register
+@dataclass
+class TxsMessage:
+    txs: list
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+        self._peer_sent: Dict[str, set] = {}  # peer -> sent tx hashes
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._broadcast_routine, daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+
+    def get_channels(self):
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=100)]
+
+    def add_peer(self, peer: Peer):
+        with self._lock:
+            self._peer_sent[peer.id] = set()
+
+    def remove_peer(self, peer: Peer, reason):
+        with self._lock:
+            self._peer_sent.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
+        msg = loads(msg_bytes)
+        if isinstance(msg, TxsMessage):
+            for tx in msg.txs:
+                self.mempool.check_tx(bytes(tx))
+
+    def _broadcast_routine(self):
+        """Per-peer broadcast of not-yet-sent txs (the clist walk in the
+        reference, mempool/v0/reactor.go:189; here tracked by tx hash)."""
+        from tendermint_tpu.types.block import tx_hash
+        while not self._stop.is_set():
+            time.sleep(0.05)
+            if self.switch is None:
+                continue
+            pool = [(tx_hash(tx), tx) for tx in self.mempool.reap_max_txs(-1)]
+            pool_keys = {k for k, _ in pool}
+            with self._lock:
+                peers_sent = {pid: set(s) for pid, s in self._peer_sent.items()}
+            for pid, sent in peers_sent.items():
+                peer = self.switch.peers.get(pid)
+                if peer is None:
+                    continue
+                fresh = [tx for k, tx in pool if k not in sent]
+                if fresh and peer.try_send(MEMPOOL_CHANNEL, TxsMessage(fresh)):
+                    sent.update(k for k, _ in pool)
+                sent &= pool_keys  # prune hashes no longer in the pool
+                with self._lock:
+                    if pid in self._peer_sent:
+                        self._peer_sent[pid] = sent

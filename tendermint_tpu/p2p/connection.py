@@ -1,0 +1,149 @@
+"""Multiplexed connection (reference p2p/conn/connection.go MConnection).
+
+N logical channels over one SecretConnection; per-channel priority send
+queues drained by one send thread (most-behind-by-priority scheduling, the
+reference's recently-sent EMA policy in spirit); one recv thread dispatches
+to the owner's on_receive.  Ping/pong keepalive with timeout.
+"""
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .secret_connection import SecretConnection
+
+_MSG = 0x01
+_PING = 0x02
+_PONG = 0x03
+
+PING_INTERVAL = 10.0
+PONG_TIMEOUT = 45.0
+MAX_MSG_SIZE = 32 * 1024 * 1024
+
+
+@dataclass
+class ChannelDescriptor:
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+
+
+class MConnection:
+    def __init__(self, conn: SecretConnection,
+                 channels: List[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], None],
+                 on_error: Callable[[Exception], None]):
+        self.conn = conn
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self._chans: Dict[int, ChannelDescriptor] = {c.id: c for c in channels}
+        self._queues: Dict[int, "queue.Queue[bytes]"] = {
+            c.id: queue.Queue(maxsize=c.send_queue_capacity) for c in channels}
+        self._send_event = threading.Event()
+        self._stop = threading.Event()
+        self._last_pong = time.time()
+        self._threads: List[threading.Thread] = []
+
+    def start(self):
+        for target, name in ((self._send_routine, "send"),
+                             (self._recv_routine, "recv"),
+                             (self._ping_routine, "ping")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"mconn-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        self._send_event.set()
+        self.conn.close()
+
+    def send(self, ch_id: int, msg: bytes, block: bool = True) -> bool:
+        """Queue msg on channel; False if the queue is full (try_send) or
+        the connection is stopped."""
+        if self._stop.is_set():
+            return False
+        q = self._queues.get(ch_id)
+        if q is None:
+            raise ValueError(f"unknown channel {ch_id:#x}")
+        try:
+            q.put(msg, block=block, timeout=10 if block else None)
+        except queue.Full:
+            return False
+        self._send_event.set()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.send(ch_id, msg, block=False)
+
+    # -- routines ----------------------------------------------------------
+
+    def _next_msg(self) -> Optional[tuple]:
+        """Pick from the highest-priority non-empty queue."""
+        best = None
+        for cid, q in self._queues.items():
+            if not q.empty():
+                pr = self._chans[cid].priority
+                if best is None or pr > best[0]:
+                    best = (pr, cid, q)
+        if best is None:
+            return None
+        try:
+            return best[1], best[2].get_nowait()
+        except queue.Empty:
+            return None
+
+    def _send_routine(self):
+        try:
+            while not self._stop.is_set():
+                item = self._next_msg()
+                if item is None:
+                    self._send_event.wait(timeout=0.1)
+                    self._send_event.clear()
+                    continue
+                cid, msg = item
+                self.conn.send_frame(bytes([_MSG, cid]) + msg)
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _recv_routine(self):
+        try:
+            while not self._stop.is_set():
+                frame = self.conn.recv_frame()
+                if not frame:
+                    continue
+                kind = frame[0]
+                if kind == _PING:
+                    self.conn.send_frame(bytes([_PONG]))
+                elif kind == _PONG:
+                    self._last_pong = time.time()
+                elif kind == _MSG:
+                    if len(frame) < 2 or len(frame) > MAX_MSG_SIZE:
+                        raise ValueError("bad mconn frame")
+                    self.on_receive(frame[1], frame[2:])
+                else:
+                    raise ValueError(f"unknown frame kind {kind}")
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _ping_routine(self):
+        try:
+            while not self._stop.is_set():
+                time.sleep(PING_INTERVAL)
+                if self._stop.is_set():
+                    return
+                self.conn.send_frame(bytes([_PING]))
+                if time.time() - self._last_pong > PONG_TIMEOUT:
+                    raise TimeoutError("pong timeout")
+        except Exception as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _fail(self, e: Exception):
+        if not self._stop.is_set():
+            self._stop.set()
+            self.conn.close()
+            self.on_error(e)

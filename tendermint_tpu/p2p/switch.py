@@ -1,0 +1,301 @@
+"""Peer, Reactor, Switch and transport (reference p2p/switch.go:158,
+p2p/peer.go, p2p/transport.go, p2p/base_reactor.go).
+
+The Switch owns the listener/dialer, authenticates peers over
+SecretConnection, exchanges NodeInfo, wires each peer's MConnection
+channels to the registered reactors, and handles reconnection to
+persistent peers with exponential backoff.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.libs import safe_codec
+
+from .connection import ChannelDescriptor, MConnection
+from .key import NodeKey
+from .secret_connection import SecretConnection
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    listen_addr: str
+    network: str           # chain id
+    version: str
+    channels: bytes        # supported channel ids
+    moniker: str = ""
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "node_id": self.node_id, "listen_addr": self.listen_addr,
+            "network": self.network, "version": self.version,
+            "channels": self.channels.hex(), "moniker": self.moniker,
+        }).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "NodeInfo":
+        d = json.loads(data.decode())
+        return cls(node_id=d["node_id"], listen_addr=d["listen_addr"],
+                   network=d["network"], version=d["version"],
+                   channels=bytes.fromhex(d["channels"]),
+                   moniker=d.get("moniker", ""))
+
+
+class Reactor:
+    """Base reactor (reference p2p/base_reactor.go).  Subclasses register
+    channels and react to peer lifecycle + messages."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: Optional["Switch"] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return []
+
+    def add_peer(self, peer: "Peer"):
+        pass
+
+    def remove_peer(self, peer: "Peer", reason):
+        pass
+
+    def receive(self, ch_id: int, peer: "Peer", msg_bytes: bytes):
+        pass
+
+
+class Peer:
+    def __init__(self, node_info: NodeInfo, mconn: MConnection,
+                 outbound: bool, persistent: bool = False):
+        self.node_info = node_info
+        self.mconn = mconn
+        self.outbound = outbound
+        self.persistent = persistent
+        self.data: Dict[str, object] = {}
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def send(self, ch_id: int, msg) -> bool:
+        return self.mconn.send(ch_id, safe_codec.dumps(msg))
+
+    def try_send(self, ch_id: int, msg) -> bool:
+        return self.mconn.try_send(ch_id, safe_codec.dumps(msg))
+
+    def stop(self):
+        self.mconn.stop()
+
+
+class Switch:
+    def __init__(self, node_key: NodeKey, listen_addr: str, network: str,
+                 moniker: str = "", version: str = "0.1.0"):
+        self.node_key = node_key
+        self.listen_addr = listen_addr
+        self.network = network
+        self.moniker = moniker
+        self.version = version
+        self.reactors: Dict[str, Reactor] = {}
+        self._chan_to_reactor: Dict[int, Reactor] = {}
+        self._descriptors: List[ChannelDescriptor] = []
+        self.peers: Dict[str, Peer] = {}
+        self._lock = threading.RLock()
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._reconnecting: set = set()
+        self.max_peers = 50
+
+    # -- reactor registry (reference p2p/switch.go AddReactor) -------------
+
+    def add_reactor(self, name: str, reactor: Reactor):
+        for ch in reactor.get_channels():
+            if ch.id in self._chan_to_reactor:
+                raise ValueError(f"channel {ch.id:#x} already registered")
+            self._chan_to_reactor[ch.id] = reactor
+            self._descriptors.append(ch)
+        self.reactors[name] = reactor
+        reactor.switch = self
+
+    def node_info(self) -> NodeInfo:
+        return NodeInfo(
+            node_id=self.node_key.node_id, listen_addr=self.listen_addr,
+            network=self.network, version=self.version,
+            channels=bytes(sorted(self._chan_to_reactor)),
+            moniker=self.moniker)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        host, port = self.listen_addr.rsplit(":", 1)
+        self._listener = socket.create_server((host, int(port)))
+        self._listener.settimeout(0.5)
+        t = threading.Thread(target=self._accept_routine, daemon=True,
+                             name="switch-accept")
+        t.start()
+
+    def actual_listen_addr(self) -> str:
+        host, port = self._listener.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.close()
+        with self._lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            self.stop_peer_for_error(p, "switch stopping")
+
+    def _accept_routine(self):
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handshake_inbound, args=(sock,),
+                             daemon=True).start()
+
+    # -- dialing (reference p2p/switch.go DialPeerWithAddress) -------------
+
+    def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:
+        """addr: "host:port" or "nodeid@host:port"."""
+        expected_id = None
+        if "@" in addr:
+            expected_id, addr = addr.split("@", 1)
+        host, port = addr.rsplit(":", 1)
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=10)
+            peer = self._handshake(sock, outbound=True, persistent=persistent)
+        except Exception as e:  # noqa: BLE001
+            if persistent:
+                self._schedule_reconnect(addr, expected_id)
+            return None
+        if peer is not None and expected_id is not None \
+                and peer.id != expected_id:
+            self.stop_peer_for_error(peer, "node id mismatch")
+            return None
+        if peer is not None:
+            peer.data["dial_addr"] = addr
+        return peer
+
+    def _schedule_reconnect(self, addr: str, expected_id):
+        key = f"{expected_id}@{addr}" if expected_id else addr
+        with self._lock:
+            if key in self._reconnecting:
+                return
+            self._reconnecting.add(key)
+
+        def routine():
+            backoff = 1.0
+            try:
+                while not self._stop.is_set():
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 60.0)
+                    peer = None
+                    try:
+                        host, port = addr.rsplit(":", 1)
+                        sock = socket.create_connection(
+                            (host, int(port)), timeout=10)
+                        peer = self._handshake(sock, outbound=True,
+                                               persistent=True)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if peer is not None:
+                        return
+            finally:
+                with self._lock:
+                    self._reconnecting.discard(key)
+        threading.Thread(target=routine, daemon=True).start()
+
+    def _handshake_inbound(self, sock: socket.socket):
+        try:
+            self._handshake(sock, outbound=False)
+        except Exception:  # noqa: BLE001
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handshake(self, sock: socket.socket, outbound: bool,
+                   persistent: bool = False) -> Optional[Peer]:
+        sock.settimeout(10)
+        sconn = SecretConnection(sock, self.node_key.priv_key)
+        # NodeInfo exchange
+        sconn.send_frame(self.node_info().to_bytes())
+        their_info = NodeInfo.from_bytes(sconn.recv_frame())
+        sock.settimeout(None)
+        if their_info.node_id != sconn.remote_node_id:
+            raise ValueError("node id does not match secret-connection key")
+        if their_info.network != self.network:
+            raise ValueError(
+                f"wrong network: {their_info.network} != {self.network}")
+        if their_info.node_id == self.node_key.node_id:
+            raise ValueError("self connection")
+        with self._lock:
+            if their_info.node_id in self.peers:
+                raise ValueError("duplicate peer")
+            if len(self.peers) >= self.max_peers:
+                raise ValueError("too many peers")
+
+        peer_box: List[Optional[Peer]] = [None]
+
+        def on_receive(ch_id: int, msg: bytes):
+            reactor = self._chan_to_reactor.get(ch_id)
+            peer = peer_box[0]
+            if reactor is not None and peer is not None:
+                try:
+                    reactor.receive(ch_id, peer, msg)
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    self.stop_peer_for_error(peer, e)
+
+        def on_error(e: Exception):
+            peer = peer_box[0]
+            if peer is not None:
+                self.stop_peer_for_error(peer, e)
+
+        mconn = MConnection(sconn, self._descriptors, on_receive, on_error)
+        peer = Peer(their_info, mconn, outbound, persistent)
+        peer_box[0] = peer
+        with self._lock:
+            self.peers[peer.id] = peer
+        mconn.start()
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+        return peer
+
+    # -- peer management ---------------------------------------------------
+
+    def stop_peer_for_error(self, peer: Peer, reason):
+        with self._lock:
+            existing = self.peers.pop(peer.id, None)
+        if existing is None:
+            return
+        peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        if peer.persistent and not self._stop.is_set():
+            addr = peer.data.get("dial_addr") or peer.node_info.listen_addr
+            self._schedule_reconnect(addr, peer.id)
+
+    def broadcast(self, ch_id: int, msg) -> None:
+        """Queue msg to all peers (reference p2p/switch.go:264)."""
+        data = safe_codec.dumps(msg)
+        with self._lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.mconn.try_send(ch_id, data)
+
+    def num_peers(self) -> int:
+        with self._lock:
+            return len(self.peers)
