@@ -42,10 +42,10 @@ from .config import ConsensusConfig
 from .round_types import (
     BlockPartMessage, HeightVoteSet, ProposalMessage, RoundState, Step,
     TimeoutInfo, VoteMessage)
+from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
+
 from .ticker import TimeoutTicker
 from .wal import WAL, EndHeightMessage
-
-import pickle
 
 
 class ConsensusState:
@@ -200,13 +200,36 @@ class ConsensusState:
         if isinstance(msg, VoteMessage):
             self._try_add_vote(msg.vote, peer_id)
         elif isinstance(msg, ProposalMessage):
-            self._set_proposal(msg.proposal)
+            self._try_peer_msg(peer_id,
+                               lambda: self._set_proposal(msg.proposal))
         elif isinstance(msg, BlockPartMessage):
-            self._add_proposal_block_part(msg, peer_id)
+            self._try_peer_msg(
+                peer_id,
+                lambda: self._add_proposal_block_part(msg, peer_id))
         elif isinstance(msg, TimeoutInfo):
             self._handle_timeout(msg)
         else:
             raise ValueError(f"unknown msg type {type(msg)}")
+
+    def _try_peer_msg(self, peer_id: str, fn):
+        """Validation failures on peer-originated messages are the peer's
+        fault, not an internal invariant violation: log and continue
+        (reference handleMsg logs `err` and keeps running,
+        consensus/state.go:810-860).  Internal messages re-raise — a bad
+        own-proposal IS a consensus failure."""
+        try:
+            fn()
+        except (VoteSetError, ValueError, TypeError, AttributeError,
+                KeyError, IndexError, OverflowError) as e:
+            # ProtoError subclasses ValueError; the extra types cover
+            # type-confused fields in peer-supplied objects (the wire codec
+            # guarantees wrapper classes, not field types).  RuntimeError is
+            # deliberately NOT caught: internal invariant violations stay
+            # fatal.
+            if peer_id == "":
+                raise
+            # TODO: punish peer through the switch (reference StopPeerForError)
+            print(f"[consensus-{self.name}] bad msg from {peer_id}: {e}")
 
     def _on_ticker_timeout(self, ti: TimeoutInfo):
         self._internal_queue.put((ti, ""))
@@ -366,7 +389,7 @@ class ConsensusState:
                 return
             block = self.block_exec.create_proposal_block(
                 height, self.state, commit, self.priv_pub_key.address())
-            parts = PartSet.from_data(pickle.dumps(block))
+            parts = PartSet.from_data(block.proto())
         block_id = BlockID(block.hash(), parts.header())
         proposal = Proposal(height=height, round=round_,
                             pol_round=rs.valid_round, block_id=block_id,
@@ -418,10 +441,20 @@ class ConsensusState:
         if not proposer.pub_key.verify_signature(
                 proposal.sign_bytes(self.state.chain_id), proposal.signature):
             raise VoteSetError("invalid proposal signature")
+        # DoS bound: the part-set total a proposal commits to must fit the
+        # consensus block-size limit (reference consensus/state.go:1862 via
+        # PartSetHeader + addProposalBlockPart ByteSize check :1932) — else
+        # a Byzantine proposer allocates total*64KB on every honest node.
+        psh = proposal.block_id.part_set_header
+        max_bytes = self.state.consensus_params.block.max_bytes
+        max_parts = (max_bytes + BLOCK_PART_SIZE_BYTES - 1) \
+            // BLOCK_PART_SIZE_BYTES
+        if psh.total < 1 or psh.total > max_parts:
+            raise VoteSetError(
+                f"proposal part-set total {psh.total} outside [1, {max_parts}]")
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
-            rs.proposal_block_parts = PartSet(
-                proposal.block_id.part_set_header)
+            rs.proposal_block_parts = PartSet(psh)
 
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str):
         rs = self.rs
@@ -429,19 +462,17 @@ class ConsensusState:
             return
         if rs.proposal_block_parts is None:
             return
-        try:
-            added = rs.proposal_block_parts.add_part(msg.part)
-        except ValueError:
-            if peer_id == "":
-                raise
-            return
+        added = rs.proposal_block_parts.add_part(msg.part)
         if not added:
             return
+        if (rs.proposal_block_parts.byte_size
+                > self.state.consensus_params.block.max_bytes):
+            raise ValueError(
+                f"total size of proposal block parts exceeds maximum "
+                f"({self.state.consensus_params.block.max_bytes})")
         if rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.assemble()
-            block = pickle.loads(data)
-            if not isinstance(block, Block):
-                raise ValueError("proposal parts decode to non-Block")
+            block = Block.from_proto(data)
             if (rs.proposal is not None
                     and block.hash() != rs.proposal.block_id.hash):
                 raise ValueError("proposal block hash mismatch")
@@ -789,13 +820,17 @@ class ConsensusState:
             fn(vote)
 
     def _vote_time(self) -> Timestamp:
+        """Reference consensus/state.go voteTime: BFT-time monotonicity —
+        a vote's timestamp must exceed the block time it votes on by at
+        least ConsensusParams.Block.TimeIotaMs."""
         now = Timestamp.now()
         rs = self.rs
+        iota_ms = max(self.state.consensus_params.block.time_iota_ms, 1)
         min_time = None
         if rs.locked_block is not None:
-            min_time = rs.locked_block.header.time.add_ms(1)
+            min_time = rs.locked_block.header.time.add_ms(iota_ms)
         elif rs.proposal_block is not None:
-            min_time = rs.proposal_block.header.time.add_ms(1)
+            min_time = rs.proposal_block.header.time.add_ms(iota_ms)
         if min_time is not None and now < min_time:
             return min_time
         return now

@@ -3,7 +3,7 @@
 Every message the consensus state machine processes is logged BEFORE it is
 processed (WAL-then-act discipline); on crash, replay from the last height
 boundary reproduces the exact state.  Framing: 4-byte CRC32c | 4-byte
-length | pickle(msg), matching the reference's crc/length framing
+length | safe_codec(msg), matching the reference's crc/length framing
 (consensus/wal.go:288-355); EndHeightMessage marks height boundaries.
 
 fsync policy mirrors the reference: WriteSync on own votes/timeouts and on
@@ -12,12 +12,13 @@ EndHeight (consensus/state.go:765,774,1683).
 from __future__ import annotations
 
 import os
-import pickle
 import struct
 import threading
 import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional
+
+from tendermint_tpu.libs import safe_codec
 
 MAX_MSG_SIZE = 1 << 20  # 1MB (reference consensus/wal.go:25)
 
@@ -41,7 +42,7 @@ class WAL:
         self._lock = threading.Lock()
 
     def write(self, msg) -> None:
-        data = pickle.dumps(msg)
+        data = safe_codec.dumps(msg)
         if len(data) > MAX_MSG_SIZE:
             raise ValueError(f"WAL msg too big: {len(data)}")
         frame = (struct.pack(">I", zlib.crc32(data))
@@ -90,7 +91,7 @@ class WAL:
                         return
                     raise WALCorruptionError("crc mismatch")
                 try:
-                    yield pickle.loads(data)
+                    yield safe_codec.loads(data)
                 except Exception:
                     if allow_corruption_tail:
                         return

@@ -1,0 +1,168 @@
+"""Minimal protobuf wire decoder — the parse side of libs/protoenc.
+
+The framework defines every wire/storage message as a deterministic proto
+encoding (matching proto/tendermint/*.proto in the reference); this module
+parses the three wire types those encodings use.  It is strict about
+structure (truncated/garbage input raises ProtoError) but, like any proto
+parser, tolerant of unknown fields (skipped) and repeated scalar overrides
+(last one wins), so honest peers on compatible versions interop.
+
+Used by the gossip and blocksync paths to decode Byzantine-controlled bytes
+(the replacement for the round-1 pickle.loads RCE, see VERDICT.md weak #4):
+the worst malformed input can do is raise ProtoError.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Union
+
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_BYTES = 2
+WT_FIXED32 = 5
+
+Value = Union[int, bytes]
+Fields = Dict[int, List[Tuple[int, Value]]]  # field -> [(wire_type, value)]
+
+
+class ProtoError(ValueError):
+    pass
+
+
+def read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ProtoError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if shift and b == 0:
+                raise ProtoError("non-minimal varint")
+            if result >= 1 << 64:
+                raise ProtoError("varint overflows 64 bits")
+            return result, pos
+        shift += 7
+        if shift >= 64:
+            raise ProtoError("varint too long")
+
+
+def to_signed64(v: int) -> int:
+    """Interpret a wire varint as int64 (two's complement)."""
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def parse(data: bytes) -> Fields:
+    """Parse a message body into {field_num: [(wire_type, value), ...]}."""
+    fields: Fields = {}
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key, pos = read_uvarint(data, pos)
+        field_num, wt = key >> 3, key & 7
+        if field_num == 0:
+            raise ProtoError("field number 0")
+        if wt == WT_VARINT:
+            v, pos = read_uvarint(data, pos)
+        elif wt == WT_FIXED64:
+            if pos + 8 > n:
+                raise ProtoError("truncated fixed64")
+            v = int.from_bytes(data[pos:pos + 8], "little")
+            pos += 8
+        elif wt == WT_FIXED32:
+            if pos + 4 > n:
+                raise ProtoError("truncated fixed32")
+            v = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        elif wt == WT_BYTES:
+            ln, pos = read_uvarint(data, pos)
+            if pos + ln > n:
+                raise ProtoError("truncated length-delimited field")
+            v = data[pos:pos + ln]
+            pos += ln
+        else:
+            raise ProtoError(f"unsupported wire type {wt}")
+        fields.setdefault(field_num, []).append((wt, v))
+    return fields
+
+
+def _last(fields: Fields, num: int):
+    vals = fields.get(num)
+    return vals[-1] if vals else None
+
+
+def get_uint(fields: Fields, num: int, default: int = 0) -> int:
+    v = _last(fields, num)
+    if v is None:
+        return default
+    if v[0] != WT_VARINT:
+        raise ProtoError(f"field {num}: expected varint")
+    return v[1]
+
+
+def get_int(fields: Fields, num: int, default: int = 0) -> int:
+    """int32/int64/enum: varint decoded as signed 64-bit."""
+    v = _last(fields, num)
+    if v is None:
+        return default
+    if v[0] != WT_VARINT:
+        raise ProtoError(f"field {num}: expected varint")
+    return to_signed64(v[1])
+
+
+def get_sfixed64(fields: Fields, num: int, default: int = 0) -> int:
+    v = _last(fields, num)
+    if v is None:
+        return default
+    if v[0] != WT_FIXED64:
+        raise ProtoError(f"field {num}: expected fixed64")
+    raw = v[1]
+    return raw - (1 << 64) if raw >= 1 << 63 else raw
+
+
+def get_bytes(fields: Fields, num: int, default: bytes = b"") -> bytes:
+    v = _last(fields, num)
+    if v is None:
+        return default
+    if v[0] != WT_BYTES:
+        raise ProtoError(f"field {num}: expected bytes")
+    return v[1]
+
+
+def get_string(fields: Fields, num: int, default: str = "") -> str:
+    raw = get_bytes(fields, num)
+    if not raw:
+        return default
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise ProtoError(f"field {num}: invalid utf-8") from e
+
+
+def get_message(fields: Fields, num: int):
+    """Embedded message body, or None when absent (nil pointer in Go)."""
+    v = _last(fields, num)
+    if v is None:
+        return None
+    if v[0] != WT_BYTES:
+        raise ProtoError(f"field {num}: expected message")
+    return v[1]
+
+
+def get_messages(fields: Fields, num: int) -> List[bytes]:
+    """All occurrences of a repeated message/bytes field, in order."""
+    out = []
+    for wt, v in fields.get(num, ()):
+        if wt != WT_BYTES:
+            raise ProtoError(f"field {num}: expected repeated message")
+        out.append(v)
+    return out
+
+
+def read_length_delimited(data: bytes) -> bytes:
+    """Inverse of protoenc.length_delimited: uvarint(len) || msg."""
+    ln, pos = read_uvarint(data, 0)
+    if pos + ln != len(data):
+        raise ProtoError("length-delimited framing mismatch")
+    return data[pos:pos + ln]

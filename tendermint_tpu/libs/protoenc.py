@@ -98,3 +98,9 @@ def length_delimited(msg: bytes) -> bytes:
 
 def repeated_message_field(field_num: int, encoded_list) -> bytes:
     return b"".join(message_field_always(field_num, e) for e in encoded_list)
+
+
+# Repeated bytes: every entry is emitted, INCLUDING empty ones (proto3
+# zero-omission applies to singular scalars, not repeated entries).  Same
+# wire bytes as repeated embedded messages.
+repeated_bytes_field = repeated_message_field

@@ -21,9 +21,13 @@ def register(cls) -> type:
 
 
 def _register_defaults():
-    from tendermint_tpu.types import basic, block, commit, part_set, proposal, vote
-    from tendermint_tpu.crypto import merkle
+    import tendermint_tpu.abci.types as abci_types
+    from tendermint_tpu.types import (
+        basic, block, commit, params, part_set, proposal, validator,
+        validator_set, vote)
+    from tendermint_tpu.crypto import ed25519, merkle
     from tendermint_tpu.consensus import round_types, wal
+    from tendermint_tpu.state import state as sm_state
 
     for cls in (
         basic.Timestamp, basic.BlockID, basic.PartSetHeader,
@@ -36,8 +40,20 @@ def _register_defaults():
         round_types.ProposalMessage, round_types.BlockPartMessage,
         round_types.VoteMessage, round_types.TimeoutInfo, round_types.Step,
         wal.EndHeightMessage,
+        # storage-side graph (state/validators/params/ABCI responses)
+        validator.Validator, validator_set.ValidatorSet,
+        ed25519.PubKey, ed25519.PrivKey,
+        params.ConsensusParams, params.BlockParams, params.EvidenceParams,
+        params.ValidatorParams, params.VersionParams,
+        sm_state.State,
     ):
         register(cls)
+    # every ABCI request/response dataclass (stored in SaveABCIResponses)
+    import dataclasses
+    for name in dir(abci_types):
+        obj = getattr(abci_types, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            register(obj)
 
 
 _BUILTINS = {

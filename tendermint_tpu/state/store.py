@@ -1,13 +1,13 @@
 """State store (reference state/store.go): persists State, per-height
 validator sets, consensus params, and ABCI responses.
 
-Serialization is pickle over our own dataclasses (internal storage format;
+Serialization is safe_codec (allowlisted pickle) over our own dataclasses;
 the wire formats in types/ stay protobuf-exact).  Keys mirror the
 reference's layout (state/store.go:25-40).
 """
 from __future__ import annotations
 
-import pickle
+from tendermint_tpu.libs import safe_codec
 from typing import List, Optional
 
 from tendermint_tpu.libs.kvdb import KVDB
@@ -43,37 +43,37 @@ class StateStore:
             # genesis bootstrap: save base validator sets too
             base = state.initial_height
             self._save_validators(base, state.validators)
-            self.db.set(_params_key(base), pickle.dumps(state.consensus_params))
+            self.db.set(_params_key(base), safe_codec.dumps(state.consensus_params))
         self._save_validators(next_height + 1, state.next_validators)
         self.db.set(_params_key(next_height),
-                    pickle.dumps(state.consensus_params))
-        self.db.set(_STATE_KEY, pickle.dumps(state))
+                    safe_codec.dumps(state.consensus_params))
+        self.db.set(_STATE_KEY, safe_codec.dumps(state))
 
     def load(self) -> Optional[State]:
         raw = self.db.get(_STATE_KEY)
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     # -- validators (reference state/store.go:481) -------------------------
 
     def _save_validators(self, height: int, val_set):
-        self.db.set(_vals_key(height), pickle.dumps(val_set))
+        self.db.set(_vals_key(height), safe_codec.dumps(val_set))
 
     def load_validators(self, height: int):
         raw = self.db.get(_vals_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     def load_consensus_params(self, height: int):
         raw = self.db.get(_params_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     # -- ABCI responses (reference state/store.go:378) ---------------------
 
     def save_abci_responses(self, height: int, responses):
-        self.db.set(_abci_key(height), pickle.dumps(responses))
+        self.db.set(_abci_key(height), safe_codec.dumps(responses))
 
     def load_abci_responses(self, height: int):
         raw = self.db.get(_abci_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     # -- pruning (reference state/store.go:240) ----------------------------
 

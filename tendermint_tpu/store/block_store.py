@@ -7,7 +7,7 @@ one batch (reference store/store.go:331); LoadBlock reassembles from parts
 """
 from __future__ import annotations
 
-import pickle
+from tendermint_tpu.libs import safe_codec
 import threading
 from typing import Optional
 
@@ -42,7 +42,7 @@ class BlockStore:
         self._lock = threading.RLock()
         raw = db.get(_STORE_STATE_KEY)
         if raw is not None:
-            self._base, self._height = pickle.loads(raw)
+            self._base, self._height = safe_codec.loads(raw)
         else:
             self._base, self._height = 0, 0
 
@@ -75,17 +75,17 @@ class BlockStore:
                              block_size=part_set.byte_size,
                              header=block.header,
                              num_txs=len(block.data.txs))
-            sets = [(_meta_key(height), pickle.dumps(meta)),
+            sets = [(_meta_key(height), safe_codec.dumps(meta)),
                     (_hash_key(block.hash()), b"%d" % height),
-                    (_seen_commit_key(height), pickle.dumps(seen_commit))]
+                    (_seen_commit_key(height), safe_codec.dumps(seen_commit))]
             for i in range(part_set.header().total):
                 sets.append((_part_key(height, i),
-                             pickle.dumps(part_set.get_part(i))))
+                             safe_codec.dumps(part_set.get_part(i))))
             if block.last_commit is not None:
                 sets.append((_commit_key(height - 1),
-                             pickle.dumps(block.last_commit)))
+                             safe_codec.dumps(block.last_commit)))
             new_base = self._base or height
-            sets.append((_STORE_STATE_KEY, pickle.dumps((new_base, height))))
+            sets.append((_STORE_STATE_KEY, safe_codec.dumps((new_base, height))))
             self.db.write_batch(sets)
             self._base, self._height = new_base, height
 
@@ -93,7 +93,7 @@ class BlockStore:
 
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
         raw = self.db.get(_meta_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     def load_block(self, height: int) -> Optional[Block]:
         meta = self.load_block_meta(height)
@@ -104,9 +104,10 @@ class BlockStore:
             raw = self.db.get(_part_key(height, i))
             if raw is None:
                 return None
-            ps.add_part(pickle.loads(raw))
-        data = ps.assemble()
-        return pickle.loads(data)
+            ps.add_part(safe_codec.loads(raw))
+        # parts carry the canonical proto Block encoding (the same bytes
+        # that were gossiped and hash-bound by the part-set root)
+        return Block.from_proto(ps.assemble())
 
     def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
         raw = self.db.get(_hash_key(block_hash))
@@ -116,15 +117,15 @@ class BlockStore:
 
     def load_block_part(self, height: int, index: int):
         raw = self.db.get(_part_key(height, index))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     def load_block_commit(self, height: int) -> Optional[Commit]:
         raw = self.db.get(_commit_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     def load_seen_commit(self, height: int) -> Optional[Commit]:
         raw = self.db.get(_seen_commit_key(height))
-        return pickle.loads(raw) if raw is not None else None
+        return safe_codec.loads(raw) if raw is not None else None
 
     # -- prune (reference store/store.go:248) ------------------------------
 
@@ -148,7 +149,7 @@ class BlockStore:
                     deletes.append(_part_key(h, i))
                 pruned += 1
             deletes_sets = [(_STORE_STATE_KEY,
-                             pickle.dumps((retain_height, self._height)))]
+                             safe_codec.dumps((retain_height, self._height)))]
             self.db.write_batch(deletes_sets, deletes)
             self._base = retain_height
             return pruned

@@ -16,6 +16,7 @@ import time as _time
 from dataclasses import dataclass, field
 from enum import IntEnum
 
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 
 # Go time.Time{}.Unix()
@@ -58,6 +59,11 @@ class Timestamp:
         """google.protobuf.Timestamp message body."""
         return pe.timestamp_msg(self.seconds, self.nanos)
 
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Timestamp":
+        f = pd.parse(body)
+        return cls(pd.get_int(f, 1, 0), pd.get_int(f, 2, 0))
+
     def __le__(self, other):
         return (self.seconds, self.nanos) <= (other.seconds, other.nanos)
 
@@ -81,6 +87,11 @@ class PartSetHeader:
         """{uint32 total = 1; bytes hash = 2} — same layout for
         PartSetHeader and CanonicalPartSetHeader."""
         return pe.varint_field(1, self.total) + pe.bytes_field(2, self.hash)
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "PartSetHeader":
+        f = pd.parse(body)
+        return cls(total=pd.get_int(f, 1, 0), hash=pd.get_bytes(f, 2))
 
     def validate_basic(self):
         if self.total < 0:
@@ -108,6 +119,14 @@ class BlockID:
         (non-nullable, always emitted)}."""
         return (pe.bytes_field(1, self.hash)
                 + pe.message_field_always(2, self.part_set_header.proto()))
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "BlockID":
+        f = pd.parse(body)
+        psh = pd.get_message(f, 2)
+        return cls(hash=pd.get_bytes(f, 1),
+                   part_set_header=(PartSetHeader.from_proto(psh)
+                                    if psh is not None else PartSetHeader()))
 
     def canonical_proto(self) -> bytes | None:
         """CanonicalBlockID body, or None when zero (reference

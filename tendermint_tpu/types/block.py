@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from tendermint_tpu.crypto import merkle, tmhash
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 
 from .basic import BlockID, Timestamp
@@ -26,6 +27,11 @@ class Consensus:
 
     def proto(self) -> bytes:
         return pe.varint_field(1, self.block) + pe.varint_field(2, self.app)
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Consensus":
+        f = pd.parse(body)
+        return cls(block=pd.get_int(f, 1, 0), app=pd.get_int(f, 2, 0))
 
 
 def _wrap_string(s: str) -> bytes:
@@ -97,6 +103,31 @@ class Header:
             + pe.bytes_field(14, self.proposer_address)
         )
 
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Header":
+        f = pd.parse(body)
+        ver = pd.get_message(f, 1)
+        ts = pd.get_message(f, 4)
+        bid = pd.get_message(f, 5)
+        return cls(
+            version=(Consensus.from_proto(ver) if ver is not None
+                     else Consensus(0, 0)),
+            chain_id=pd.get_string(f, 2),
+            height=pd.get_int(f, 3, 0),
+            time=(Timestamp.from_proto(ts) if ts is not None
+                  else Timestamp.zero()),
+            last_block_id=(BlockID.from_proto(bid) if bid is not None
+                           else BlockID()),
+            last_commit_hash=pd.get_bytes(f, 6),
+            data_hash=pd.get_bytes(f, 7),
+            validators_hash=pd.get_bytes(f, 8),
+            next_validators_hash=pd.get_bytes(f, 9),
+            consensus_hash=pd.get_bytes(f, 10),
+            app_hash=pd.get_bytes(f, 11),
+            last_results_hash=pd.get_bytes(f, 12),
+            evidence_hash=pd.get_bytes(f, 13),
+            proposer_address=pd.get_bytes(f, 14))
+
     def validate_basic(self):
         if len(self.chain_id) > 50:
             raise ValueError("chain_id too long")
@@ -123,7 +154,11 @@ class Data:
         return merkle.hash_from_byte_slices(list(self.txs))
 
     def proto(self) -> bytes:
-        return b"".join(pe.bytes_field(1, tx) for tx in self.txs)
+        return pe.repeated_bytes_field(1, self.txs)
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Data":
+        return cls(txs=pd.get_messages(pd.parse(body), 1))
 
 
 def tx_hash(tx: bytes) -> bytes:
@@ -151,6 +186,31 @@ class Block:
             out += pe.message_field_always(4, self.last_commit.proto())
         return out
 
+    @classmethod
+    def from_proto(cls, data: bytes) -> "Block":
+        """Decode a wire/storage Block (inverse of proto()).  Raises
+        protodec.ProtoError on malformed bytes — safe on Byzantine input."""
+        f = pd.parse(data)
+        hdr = pd.get_message(f, 1)
+        dat = pd.get_message(f, 2)
+        if hdr is None or dat is None:
+            raise pd.ProtoError("block missing header or data")
+        evidence = []
+        ev_body = pd.get_message(f, 3)
+        if ev_body:
+            try:
+                from tendermint_tpu.types import evidence as ev_mod
+            except ImportError as e:
+                raise pd.ProtoError("evidence decoding unavailable") from e
+            evidence = [ev_mod.evidence_from_proto(e)
+                        for e in pd.get_messages(pd.parse(ev_body), 1)]
+        lc = pd.get_message(f, 4)
+        return cls(
+            header=Header.from_proto(hdr),
+            data=Data.from_proto(dat),
+            evidence=evidence,
+            last_commit=Commit.from_proto(lc) if lc is not None else None)
+
     def fill_header(self):
         """Populate derived header hashes (reference types/block.go
         fillHeader)."""
@@ -163,16 +223,22 @@ class Block:
                 [e.bytes() for e in self.evidence])
 
     def validate_basic(self):
+        """Reference types/block.go:62-101 — the header-to-content binding
+        checks are UNCONDITIONAL: an empty hash field never exempts a block
+        from committing to its own contents (a Byzantine proposer could
+        otherwise ship arbitrary txs under an empty data_hash)."""
         self.header.validate_basic()
-        if self.header.height > 1:
-            if self.last_commit is None:
-                raise ValueError("nil LastCommit")
-            self.last_commit.validate_basic()
-        if self.last_commit is not None and self.header.last_commit_hash:
-            if self.header.last_commit_hash != self.last_commit.hash():
-                raise ValueError("wrong LastCommitHash")
-        if self.header.data_hash and self.header.data_hash != self.data.hash():
+        if self.last_commit is None:
+            raise ValueError("nil LastCommit")
+        self.last_commit.validate_basic()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("wrong LastCommitHash")
+        if self.header.data_hash != self.data.hash():
             raise ValueError("wrong DataHash")
+        ev_hash = merkle.hash_from_byte_slices(
+            [e.bytes() for e in self.evidence])
+        if self.header.evidence_hash != ev_hash:
+            raise ValueError("wrong EvidenceHash")
 
 
 @dataclass

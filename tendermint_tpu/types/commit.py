@@ -11,6 +11,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 
 from .basic import BlockID, BlockIDFlag, SignedMsgType, Timestamp
@@ -49,6 +50,21 @@ class CommitSig:
             + pe.message_field_always(3, self.timestamp.proto())
             + pe.bytes_field(4, self.signature)
         )
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "CommitSig":
+        f = pd.parse(body)
+        ts = pd.get_message(f, 3)
+        try:
+            flag = BlockIDFlag(pd.get_int(f, 1, 0))
+        except ValueError as e:
+            raise pd.ProtoError(f"bad BlockIDFlag: {e}") from e
+        return cls(
+            block_id_flag=flag,
+            validator_address=pd.get_bytes(f, 2),
+            timestamp=(Timestamp.from_proto(ts) if ts is not None
+                       else Timestamp.zero()),
+            signature=pd.get_bytes(f, 4))
 
     def validate_basic(self):
         if self.block_id_flag not in (BlockIDFlag.ABSENT, BlockIDFlag.COMMIT,
@@ -95,6 +111,18 @@ class Commit:
             + pe.message_field_always(3, self.block_id.proto())
             + pe.repeated_message_field(4, [s.proto() for s in self.signatures])
         )
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Commit":
+        f = pd.parse(body)
+        bid = pd.get_message(f, 3)
+        return cls(
+            height=pd.get_int(f, 1, 0),
+            round=pd.get_int(f, 2, 0),
+            block_id=(BlockID.from_proto(bid) if bid is not None
+                      else BlockID()),
+            signatures=[CommitSig.from_proto(s)
+                        for s in pd.get_messages(f, 4)])
 
     def hash(self) -> bytes:
         """Merkle root of the proto-encoded signatures (reference

@@ -17,6 +17,10 @@ ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
 class BlockParams:
     max_bytes: int = 22020096  # 21MB default (reference types/params.go:66)
     max_gas: int = -1
+    # minimum ms between the last block time and a vote time (reference
+    # types/params.go DefaultBlockParams TimeIotaMs; used at
+    # consensus/state.go voteTime)
+    time_iota_ms: int = 1000
 
 
 @dataclass

@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 from tendermint_tpu.libs.bits import BitArray
 
@@ -38,10 +39,25 @@ class Part:
             pe.varint_field(1, self.proof.total)
             + pe.varint_field(2, self.proof.index)
             + pe.bytes_field(3, self.proof.leaf_hash)
-            + b"".join(pe.bytes_field(4, a) for a in self.proof.aunts))
+            + pe.repeated_bytes_field(4, self.proof.aunts))
         return (pe.varint_field(1, self.index)
                 + pe.bytes_field(2, self.bytes_)
                 + pe.message_field_always(3, proof_body))
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Part":
+        f = pd.parse(body)
+        proof_body = pd.get_message(f, 3)
+        if proof_body is None:
+            raise pd.ProtoError("part missing proof")
+        pf = pd.parse(proof_body)
+        proof = merkle.Proof(
+            total=pd.get_int(pf, 1, 0),
+            index=pd.get_int(pf, 2, 0),
+            leaf_hash=pd.get_bytes(pf, 3),
+            aunts=pd.get_messages(pf, 4))
+        return cls(index=pd.get_int(f, 1, 0), bytes_=pd.get_bytes(f, 2),
+                   proof=proof)
 
 
 class PartSet:

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 
 from .basic import BlockID, SignedMsgType, Timestamp
@@ -33,6 +34,22 @@ class Proposal:
             + pe.message_field_always(6, self.timestamp.proto())
             + pe.bytes_field(7, self.signature)
         )
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Proposal":
+        f = pd.parse(body)
+        if pd.get_int(f, 1, 0) != int(SignedMsgType.PROPOSAL):
+            raise pd.ProtoError("not a proposal message")
+        bid = pd.get_message(f, 5)
+        ts = pd.get_message(f, 6)
+        return cls(
+            height=pd.get_int(f, 2, 0),
+            round=pd.get_int(f, 3, 0),
+            pol_round=pd.get_int(f, 4, 0),
+            block_id=BlockID.from_proto(bid) if bid is not None else BlockID(),
+            timestamp=(Timestamp.from_proto(ts) if ts is not None
+                       else Timestamp.zero()),
+            signature=pd.get_bytes(f, 7))
 
     def validate_basic(self):
         if self.height < 0:

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs import protoenc as pe
 
 from .basic import BlockID, BlockIDFlag, SignedMsgType, Timestamp
@@ -46,6 +47,26 @@ class Vote:
             + pe.varint_field(7, self.validator_index)
             + pe.bytes_field(8, self.signature)
         )
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Vote":
+        f = pd.parse(body)
+        bid = pd.get_message(f, 4)
+        ts = pd.get_message(f, 5)
+        try:
+            vtype = SignedMsgType(pd.get_int(f, 1, 0))
+        except ValueError as e:
+            raise pd.ProtoError(f"bad vote type: {e}") from e
+        return cls(
+            type=vtype,
+            height=pd.get_int(f, 2, 0),
+            round=pd.get_int(f, 3, 0),
+            block_id=BlockID.from_proto(bid) if bid is not None else BlockID(),
+            timestamp=(Timestamp.from_proto(ts) if ts is not None
+                       else Timestamp.zero()),
+            validator_address=pd.get_bytes(f, 6),
+            validator_index=pd.get_int(f, 7, 0),
+            signature=pd.get_bytes(f, 8))
 
     def verify(self, chain_id: str, pub_key) -> bool:
         """Single-vote verification (reference types/vote.go:147); the

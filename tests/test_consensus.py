@@ -139,3 +139,36 @@ def test_crash_recovery_resumes_chain(tmp_path):
         assert node.block_store.height() >= committed + 2
     finally:
         cs2.stop()
+
+
+def test_byzantine_peer_messages_do_not_kill_node():
+    """ADVICE r1 high #2: malformed peer proposals/parts must be dropped,
+    not escalate to CONSENSUS FAILURE; and a peer-supplied part set larger
+    than max_bytes must be rejected (ADVICE r1 medium #2)."""
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+    from tendermint_tpu.types.part_set import Part
+    from tendermint_tpu.types.proposal import Proposal
+
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], name="victim")
+    node.start()
+    try:
+        # 1. proposal with a bogus signature and absurd part-set total
+        evil_psh = PartSetHeader(total=1 << 30, hash=b"\xEE" * 32)
+        evil = Proposal(height=1, round=0, pol_round=-1,
+                        block_id=BlockID(b"\xEE" * 32, evil_psh),
+                        timestamp=Timestamp.now(), signature=b"\x01" * 64)
+        node.cs.set_proposal(evil, peer_id="attacker")
+
+        # 2. garbage block part with an unverifiable proof
+        bad_part = Part(index=0, bytes_=b"\xFF" * 100,
+                        proof=merkle.Proof(total=1, index=0,
+                                           leaf_hash=b"\x00" * 32, aunts=[]))
+        node.cs.add_block_part(1, 0, bad_part, peer_id="attacker")
+
+        # the node keeps committing blocks regardless
+        wait_for_height([node], 2, timeout=30)
+        assert node.cs.is_running()
+    finally:
+        node.stop()
