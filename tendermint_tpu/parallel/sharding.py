@@ -33,33 +33,28 @@ def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
     last axis, output (bitmap, all_valid) with the bitmap batch-sharded and
     the all-valid bit replicated (XLA lowers the jnp.all to a psum over the
     mesh axis)."""
-    shard_last = {
-        "a_y": NamedSharding(mesh, P(None, axis)),
-        "a_sign": NamedSharding(mesh, P(axis)),
-        "r_bits": NamedSharding(mesh, P(None, axis)),
-        "s_digits": NamedSharding(mesh, P(None, axis)),
-        "k_digits": NamedSharding(mesh, P(None, axis)),
-    }
+    # the compact staged arrays are all batch-major (axis 0), so the whole
+    # batch shards with a single spec; limb/bit expansion happens on-device
+    # inside each shard (edops.device_stage)
+    batch_sharded = NamedSharding(mesh, P(axis))
 
-    def step(a_y, a_sign, r_bits, s_digits, k_digits):
-        bitmap = edops.verify_impl(a_y, a_sign, r_bits, s_digits, k_digits)
+    def step(pub, r, s_digits, k_digits):
+        bitmap = edops.verify_staged(pub, r, s_digits, k_digits)
         return bitmap, jnp.all(bitmap)
 
     jitted = jax.jit(
         step,
-        in_shardings=tuple(shard_last[k] for k in (
-            "a_y", "a_sign", "r_bits", "s_digits", "k_digits")),
-        out_shardings=(NamedSharding(mesh, P(axis)),
-                       NamedSharding(mesh, P())),
+        in_shardings=(batch_sharded,) * 4,
+        out_shardings=(batch_sharded, NamedSharding(mesh, P())),
     )
 
     def run(dev_arrays: dict):
-        n = dev_arrays["a_sign"].shape[0]
+        n = dev_arrays["pub"].shape[0]
         nshard = mesh.devices.size
         nb = -(-n // nshard) * nshard
         nb = max(nb, nshard)
         padded = edops._pad_dev(dict(dev_arrays), n, nb)
-        bitmap, _ = jitted(padded["a_y"], padded["a_sign"], padded["r_bits"],
+        bitmap, _ = jitted(padded["pub"], padded["r"],
                            padded["s_digits"], padded["k_digits"])
         import numpy as np
         return np.asarray(bitmap)[:n]

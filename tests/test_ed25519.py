@@ -147,8 +147,8 @@ def test_digit_decomposition():
         np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
         for s in scalars
     ])
-    digits = edops.scalars_to_digits(b)  # (64, B)
-    assert digits.min() >= -8 and digits.max() <= 8
+    digits = edops.scalars_to_digits(b)  # (B, 64) int8, balanced
+    assert digits.min() >= -8 and digits.max() <= 7
     for i, s in enumerate(scalars):
-        val = sum(int(digits[j, i]) << (4 * j) for j in range(64))
+        val = sum(int(digits[i, j]) << (4 * j) for j in range(64))
         assert val == s

@@ -32,9 +32,9 @@ def test_sharded_verifier_8dev_mesh():
     assert bitmap.shape == (32,) and bitmap.all()
 
     bad = dict(dev)
-    r = np.array(bad["r_bits"], copy=True)
-    r[0, 3] ^= 1
-    bad["r_bits"] = r
+    r = np.array(bad["r"], copy=True)
+    r[3, 0] ^= 1
+    bad["r"] = r
     bitmap = run(bad)
     assert not bitmap[3]
     assert bitmap[:3].all() and bitmap[4:].all()
