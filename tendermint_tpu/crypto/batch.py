@@ -25,8 +25,26 @@ from . import PubKey
 from . import ed25519 as ed
 
 
+_backend_ok = None
+
+
 def _use_device() -> bool:
-    return os.environ.get("TM_TPU_DISABLE_BATCH", "") != "1"
+    """Route to the device kernel only when an accelerator is attached.
+    When jax's default backend is plain host CPU the serial OpenSSL path is
+    strictly faster than the jitted ladder, so the batch stays on the host
+    (TM_TPU_FORCE_BATCH=1 overrides, for kernel tests on CPU)."""
+    if os.environ.get("TM_TPU_DISABLE_BATCH", "") == "1":
+        return False
+    if os.environ.get("TM_TPU_FORCE_BATCH", "") == "1":
+        return True
+    global _backend_ok
+    if _backend_ok is None:
+        try:
+            import jax
+            _backend_ok = jax.default_backend() != "cpu"
+        except Exception:
+            _backend_ok = False
+    return _backend_ok
 
 
 @dataclass

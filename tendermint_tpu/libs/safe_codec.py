@@ -12,6 +12,7 @@ import pickle
 from typing import Set, Tuple
 
 _ALLOWED: Set[Tuple[str, str]] = set()
+_defaults_done = False
 
 
 def register(cls) -> type:
@@ -21,6 +22,8 @@ def register(cls) -> type:
 
 
 def _register_defaults():
+    global _defaults_done
+    _defaults_done = True
     import tendermint_tpu.abci.types as abci_types
     from tendermint_tpu.types import (
         basic, block, commit, params, part_set, proposal, validator,
@@ -67,7 +70,7 @@ _BUILTINS = {
 
 class _SafeUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
-        if not _ALLOWED:
+        if not _defaults_done:
             _register_defaults()
         if (module, name) in _ALLOWED or (module, name) in _BUILTINS:
             return super().find_class(module, name)
