@@ -1,0 +1,196 @@
+"""Blocksync (fast sync) reactor — reference blocksync/reactor.go.
+
+Channel 0x40.  Peers exchange Status{base,height} and Block request/response
+messages; the sync routine drains the pool in coalesced windows through
+replay.replay_window (ONE batched TPU signature launch per window instead of
+the reference's two serial loops per block), then hands off to the consensus
+reactor once caught up (reference reactor.go:316 SwitchToConsensus).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.block import Block
+
+from .pool import BlockPool
+from .replay import WindowSyncError, replay_window
+
+BLOCKSYNC_CHANNEL = 0x40
+TRY_SYNC_INTERVAL_S = 0.01          # reference reactor.go:38
+STATUS_UPDATE_INTERVAL_S = 10.0     # reference reactor.go:41
+SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0  # reference reactor.go:44
+
+
+@register
+@dataclass
+class BlockRequest:
+    height: int
+
+
+@register
+@dataclass
+class NoBlockResponse:
+    height: int
+
+
+@register
+@dataclass
+class BlockResponse:
+    block_proto: bytes
+
+
+@register
+@dataclass
+class StatusRequest:
+    pass
+
+
+@register
+@dataclass
+class StatusResponse:
+    base: int
+    height: int
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(self, executor, store, state, fast_sync: bool = True,
+                 window: int = 32,
+                 on_caught_up: Optional[Callable] = None):
+        """on_caught_up(state) is invoked once when the pool reports caught
+        up (the node wires this to ConsensusState start / SwitchToConsensus,
+        reference reactor.go:322-330)."""
+        super().__init__("BLOCKSYNC")
+        self.executor = executor
+        self.store = store
+        self.state = state
+        self.window = window
+        self.fast_sync = fast_sync
+        self.on_caught_up = on_caught_up
+        self.blocks_synced = 0
+        self.pool = BlockPool(state.last_block_height + 1,
+                              self._send_request, self._peer_error)
+        self._stop = threading.Event()
+        self._switched = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self.fast_sync:
+            self.pool.start()
+            threading.Thread(target=self._sync_routine, daemon=True).start()
+            threading.Thread(target=self._status_routine, daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+        self.pool.stop()
+
+    def get_channels(self):
+        return [ChannelDescriptor(BLOCKSYNC_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer: Peer):
+        peer.send(BLOCKSYNC_CHANNEL,
+                  StatusResponse(self.store.base(), self.store.height()))
+
+    def remove_peer(self, peer: Peer, reason):
+        self.pool.remove_peer(peer.id)
+
+    # -- wire --------------------------------------------------------------
+
+    def _send_request(self, peer_id: str, height: int):
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            peer.try_send(BLOCKSYNC_CHANNEL, BlockRequest(height))
+
+    def _peer_error(self, peer_id: str, reason: str):
+        sw = self.switch
+        if sw is None:
+            return
+        peer = sw.peers.get(peer_id)
+        if peer is not None:
+            sw.stop_peer_for_error(peer, reason)
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
+        msg = loads(msg_bytes)
+        if isinstance(msg, BlockRequest):
+            block = self.store.load_block(msg.height)
+            if block is not None:
+                peer.try_send(BLOCKSYNC_CHANNEL, BlockResponse(block.proto()))
+            else:
+                peer.try_send(BLOCKSYNC_CHANNEL, NoBlockResponse(msg.height))
+        elif isinstance(msg, BlockResponse):
+            try:
+                block = Block.from_proto(msg.block_proto)
+            except Exception:
+                self._peer_error(peer.id, "undecodable block")
+                return
+            self.pool.add_block(peer.id, block)
+        elif isinstance(msg, NoBlockResponse):
+            self.pool.no_block(peer.id, msg.height)
+        elif isinstance(msg, StatusRequest):
+            peer.try_send(BLOCKSYNC_CHANNEL,
+                          StatusResponse(self.store.base(),
+                                         self.store.height()))
+        elif isinstance(msg, StatusResponse):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+
+    # -- sync loop (reference reactor.go:255 poolRoutine) ------------------
+
+    def _status_routine(self):
+        while not self._stop.is_set():
+            if self.switch is not None:
+                self.switch.broadcast(BLOCKSYNC_CHANNEL, StatusRequest())
+            self._stop.wait(STATUS_UPDATE_INTERVAL_S)
+
+    def _sync_routine(self):
+        last_switch_check = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
+                last_switch_check = now
+                if self.pool.is_caught_up() and not self._switched:
+                    self._switched = True
+                    self.pool.stop()
+                    if self.on_caught_up is not None:
+                        self.on_caught_up(self.state)
+                    return
+            try:
+                progressed = self.try_sync()
+            except Exception:
+                # the sync thread must survive anything a peer can trigger
+                progressed = False
+            if not progressed:
+                self._stop.wait(TRY_SYNC_INTERVAL_S)
+
+    def try_sync(self) -> bool:
+        """One window: verify+apply all ready blocks (minus the last, whose
+        certifying commit hasn't arrived).  Returns True if progress."""
+        ready = self.pool.peek_window(self.window + 1)
+        if len(ready) < 2:
+            return False
+        blocks = ready[:-1]
+        certifiers = [ready[i + 1].last_commit for i in range(len(blocks))]
+        try:
+            self.state, n = replay_window(self.executor, self.store,
+                                          self.state, blocks, certifiers,
+                                          max_window=self.window)
+        except WindowSyncError as e:
+            if e.state is not None and e.applied > 0:
+                self.state = e.state
+                self.pool.pop_requests(e.applied)
+                self.blocks_synced += e.applied
+            # redo the bad block and its certifier (reference reactor.go:381)
+            for h in (e.height, e.height + 1):
+                self.pool.redo_request(h)
+            return e.applied > 0
+        self.pool.pop_requests(n)
+        self.blocks_synced += n
+        return n > 0

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from tendermint_tpu.abci import types as abci
-from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto import merkle, tmhash
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.types.basic import BlockID, Timestamp
 from tendermint_tpu.types.block import Block
@@ -62,6 +62,7 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
         self.block_store = block_store
+        self._verified_commits: dict = {}
 
     # -- proposal creation (reference state/execution.go:95-145) -----------
 
@@ -88,6 +89,25 @@ class BlockExecutor:
         resp = self.app.process_proposal(abci.RequestProcessProposal(
             txs=list(block.data.txs), header_proto=block.header.proto()))
         return resp.accept
+
+    # -- pre-verified commit cache (blocksync coalescing seam) -------------
+
+    def mark_commit_verified(self, height: int, commit) -> None:
+        """Record that EVERY non-absent signature of `commit` (certifying
+        `height`) was verified in a coalesced batch (blocksync/replay.py),
+        so validate_block skips the redundant re-verification.  Keyed by the
+        full canonical encoding — any content difference (round, block ID,
+        timestamps, signatures) misses the cache and re-verifies."""
+        self._verified_commits[(height, tmhash.sum(commit.proto()))] = True
+        # bounded: drop entries far below the verified frontier
+        if len(self._verified_commits) > 4096:
+            cutoff = height - 2048
+            self._verified_commits = {
+                k: v for k, v in self._verified_commits.items()
+                if k[0] >= cutoff}
+
+    def _commit_preverified(self, height: int, commit) -> bool:
+        return (height, tmhash.sum(commit.proto())) in self._verified_commits
 
     # -- validation (reference state/validation.go) ------------------------
 
@@ -127,13 +147,25 @@ class BlockExecutor:
                 raise BlockExecutionError("nil LastCommit")
             if len(block.last_commit.signatures) != state.last_validators.size():
                 raise BlockExecutionError("invalid LastCommit signature count")
-            state.last_validators.verify_commit(
-                state.chain_id, state.last_block_id,
-                block.header.height - 1, block.last_commit)
+            if self._commit_preverified(block.header.height - 1,
+                                        block.last_commit):
+                # signatures already batched (blocksync window); still check
+                # header linkage + >2/3 power, skipping only re-verification
+                state.last_validators.check_commit_no_sigs(
+                    state.chain_id, state.last_block_id,
+                    block.header.height - 1, block.last_commit)
+            else:
+                state.last_validators.verify_commit(
+                    state.chain_id, state.last_block_id,
+                    block.header.height - 1, block.last_commit)
 
         if not state.validators.has_address(header.proposer_address):
             raise BlockExecutionError(
                 "block proposer is not in the validator set")
+
+        # evidence verification (reference state/validation.go:139)
+        if self.evidence_pool is not None and block.evidence:
+            self.evidence_pool.check_evidence(block.evidence)
 
     # -- apply (reference state/execution.go:189-266) ----------------------
 

@@ -25,6 +25,18 @@ def pubkey_proto(pub: PubKey) -> bytes:
     return pe.tag(num, pe.WT_BYTES) + pe.uvarint(len(data)) + data
 
 
+def pubkey_from_proto(body: bytes) -> PubKey:
+    """Inverse of pubkey_proto: decode the PublicKey oneof."""
+    from tendermint_tpu.libs import protodec as pd
+    f = pd.parse(body)
+    for tname, num in _PUBKEY_ONEOF_FIELD.items():
+        data = pd.get_bytes(f, num, None)
+        if data is not None:
+            from tendermint_tpu import crypto
+            return crypto.pubkey_from_type_name(tname, data)
+    raise pd.ProtoError("PublicKey: no known oneof field set")
+
+
 @dataclass
 class Validator:
     address: bytes
@@ -44,6 +56,25 @@ class Validator:
         """SimpleValidator proto (reference types/validator.go:117-133)."""
         return (pe.message_field_always(1, pubkey_proto(self.pub_key))
                 + pe.varint_field(2, self.voting_power))
+
+    def proto(self) -> bytes:
+        """Full tendermint.types.Validator message body."""
+        return (pe.bytes_field(1, self.address)
+                + pe.message_field_always(2, pubkey_proto(self.pub_key))
+                + pe.varint_field(3, self.voting_power)
+                + pe.varint_field(4, self.proposer_priority))
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "Validator":
+        from tendermint_tpu.libs import protodec as pd
+        f = pd.parse(body)
+        pk = pd.get_message(f, 2)
+        if pk is None:
+            raise pd.ProtoError("Validator: missing pub_key")
+        return cls(address=pd.get_bytes(f, 1),
+                   pub_key=pubkey_from_proto(pk),
+                   voting_power=pd.get_int(f, 3, 0),
+                   proposer_priority=pd.get_int(f, 4, 0))
 
     def validate_basic(self):
         if self.pub_key is None:

@@ -286,6 +286,36 @@ class ValidatorSet:
         self.validators = [v for v in self.validators
                            if v.address not in daddrs]
 
+    # -- proto codec (tendermint.types.ValidatorSet) -----------------------
+
+    def proto(self) -> bytes:
+        from tendermint_tpu.libs import protoenc as pe
+        body = b"".join(pe.message_field_always(1, v.proto())
+                        for v in self.validators)
+        prop = self.get_proposer()
+        if prop is not None:
+            body += pe.message_field_always(2, prop.proto())
+        body += pe.varint_field(3, self.total_voting_power())
+        return body
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "ValidatorSet":
+        from tendermint_tpu.libs import protodec as pd
+        f = pd.parse(body)
+        vals = [Validator.from_proto(m) for m in pd.get_messages(f, 1)]
+        vs = cls.__new__(cls)
+        vs.validators = vals
+        vs._total_voting_power = 0
+        vs.proposer = None
+        prop = pd.get_message(f, 2)
+        if prop is not None:
+            p = Validator.from_proto(prop)
+            for v in vals:
+                if v.address == p.address:
+                    vs.proposer = v
+                    break
+        return vs
+
     # -- commit verification (the north-star hot loops) --------------------
 
     def verify_commit(self, chain_id: str, block_id: BlockID, height: int,
@@ -319,6 +349,19 @@ class ValidatorSet:
                             height: int, commit: Commit):
         """Reference :717-760 — verify only the minimal prefix of for-block
         signatures that crosses 2/3, in one batch."""
+        prefix = self.collect_commit_light(chain_id, block_id, height, commit)
+        self._verify_prefix_batch(chain_id, commit, prefix,
+                                  [self.validators[i] for i in prefix])
+
+    def collect_commit_light(self, chain_id: str, block_id: BlockID,
+                             height: int, commit: Commit) -> List[int]:
+        """Header/power checks of verify_commit_light WITHOUT signature
+        verification; returns the minimal >2/3 prefix of signature indices.
+
+        This is the coalescing seam: blocksync collects prefixes from many
+        consecutive blocks and verifies them in ONE batched kernel launch
+        (vs the reference's per-block serial loop, blocksync/reactor.go:375).
+        """
         self._check_commit_header(chain_id, block_id, height, commit)
         needed = self.total_voting_power() * 2 // 3
         prefix = []
@@ -332,8 +375,7 @@ class ValidatorSet:
                 break
         else:
             raise NotEnoughVotingPowerError(tallied, needed)
-        self._verify_prefix_batch(chain_id, commit, prefix,
-                                  [self.validators[i] for i in prefix])
+        return prefix
 
     def verify_commit_light_trusting(self, chain_id: str, commit: Commit,
                                      trust_level: Fraction):
@@ -370,6 +412,20 @@ class ValidatorSet:
         else:
             raise NotEnoughVotingPowerError(tallied, needed)
         self._verify_prefix_batch(chain_id, commit, prefix, vals)
+
+    def check_commit_no_sigs(self, chain_id: str, block_id: BlockID,
+                             height: int, commit: Commit):
+        """verify_commit minus signature verification: header linkage plus
+        the >2/3 for-block power tally.  Used when every signature in
+        `commit` was already verified in a coalesced batch (blocksync's
+        pre-verified cache, state/execution.py)."""
+        self._check_commit_header(chain_id, block_id, height, commit)
+        tallied = sum(self.validators[i].voting_power
+                      for i, cs in enumerate(commit.signatures)
+                      if cs.for_block())
+        needed = self.total_voting_power() * 2 // 3
+        if tallied <= needed:
+            raise NotEnoughVotingPowerError(tallied, needed)
 
     def _check_commit_header(self, chain_id: str, block_id: BlockID,
                              height: int, commit: Commit):

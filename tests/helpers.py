@@ -108,3 +108,58 @@ def wait_for_height(nodes: List[Node], height: int, timeout: float = 30.0):
     raise TimeoutError(
         f"heights: {[n.block_store.height() for n in nodes]}, wanted "
         f"{height}")
+
+
+def build_chain(gdoc: GenesisDoc, privs, n_heights: int, txs_fn=None,
+                tamper_height: int = 0):
+    """Deterministically build a committed chain of n_heights blocks by
+    signing real precommits (no consensus rounds) and applying each block
+    through a fresh BlockExecutor — the synthetic peer chain for blocksync
+    tests (the analog of the reference's makeBlockchain helpers in
+    blocksync/reactor_test.go:107-137).
+
+    Returns (blocks, commits, states): commits[i] certifies blocks[i];
+    states[i] is the post-apply state after blocks[i].  tamper_height, if
+    set, corrupts one signature in that height's certifying commit.
+    """
+    from tendermint_tpu.blocksync.replay import block_id_of
+    from tendermint_tpu.types.basic import BlockID, BlockIDFlag, SignedMsgType
+    from tendermint_tpu.types.canonical import canonical_vote_bytes
+    from tendermint_tpu.types.commit import Commit, CommitSig
+
+    app = KVStoreApplication()
+    ex = BlockExecutor(StateStore(MemDB()), app)
+    state = state_from_genesis(gdoc)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    blocks, commits, states = [], [], []
+    last_commit = Commit(0, 0, BlockID(), [])
+    for h in range(1, n_heights + 1):
+        txs = txs_fn(h) if txs_fn is not None else []
+        proposer = state.validators.get_proposer()
+        block = state.make_block(h, txs, last_commit, [], proposer.address,
+                                 block_time=Timestamp(1700000000 + h, 0))
+        bid, _parts = block_id_of(block)
+        sigs = []
+        for val in state.validators.validators:
+            priv = by_addr[val.address]
+            ts = Timestamp(1700000000 + h, 500)
+            sb = canonical_vote_bytes(gdoc.chain_id, SignedMsgType.PRECOMMIT,
+                                      h, 0, bid, ts)
+            sig = priv.sign(sb)
+            sigs.append(CommitSig(BlockIDFlag.COMMIT, val.address, ts, sig))
+        commit = Commit(h, 0, bid, sigs)
+        blocks.append(block)
+        if h == tamper_height:
+            # corrupt only the CERTIFIER copy handed to the consumer (a
+            # lying peer); the chain itself stays internally consistent
+            bad = CommitSig(sigs[0].block_id_flag, sigs[0].validator_address,
+                            sigs[0].timestamp,
+                            bytes([sigs[0].signature[0] ^ 1])
+                            + sigs[0].signature[1:])
+            commits.append(Commit(h, 0, bid, [bad] + sigs[1:]))
+        else:
+            commits.append(commit)
+        state, _ = ex.apply_block(state, bid, block)
+        states.append(state)
+        last_commit = commit
+    return blocks, commits, states

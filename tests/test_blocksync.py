@@ -1,0 +1,247 @@
+"""Blocksync: coalesced window replay, pool scheduling, and end-to-end
+sync over the reactor message flow (reference blocksync/pool_test.go +
+reactor_test.go)."""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from helpers import build_chain, make_genesis
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.blocksync.pool import BlockPool
+from tendermint_tpu.blocksync.replay import (WindowSyncError, block_id_of,
+                                             replay_window)
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+
+
+def _fresh_node(gdoc):
+    ex = BlockExecutor(StateStore(MemDB()), KVStoreApplication())
+    store = BlockStore(MemDB())
+    return ex, store, state_from_genesis(gdoc)
+
+
+# --- replay core ----------------------------------------------------------
+
+def test_replay_window_coalesced_applies_all():
+    gdoc, privs = make_genesis(6)
+    blocks, commits, states = build_chain(gdoc, privs, 20)
+    ex, store, state = _fresh_node(gdoc)
+    # feed in two windows; certifier of block i is commits[i]
+    state, n1 = replay_window(ex, store, state, blocks[:12], commits[:12],
+                              max_window=16)
+    assert n1 == 12
+    state, n2 = replay_window(ex, store, state, blocks[12:], commits[12:],
+                              max_window=16)
+    assert n2 == 8
+    assert state.last_block_height == 20
+    assert store.height() == 20
+    assert state.app_hash == states[-1].app_hash
+    # stored blocks round-trip
+    assert store.load_block(7).hash() == blocks[6].hash()
+
+
+def test_replay_window_detects_bad_commit():
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 10, tamper_height=6)
+    ex, store, state = _fresh_node(gdoc)
+    with pytest.raises(WindowSyncError) as ei:
+        replay_window(ex, store, state, blocks, commits, max_window=16)
+    # heights 1..5 applied; 6's certifying commit is bad
+    assert ei.value.height == 6
+    assert ei.value.applied == 5
+    assert ei.value.state.last_block_height == 5
+    # resume with a corrected certifier succeeds
+    good_blocks, good_commits, _ = build_chain(gdoc, privs, 10)
+    state = ei.value.state
+    state, n = replay_window(ex, store, state, good_blocks[5:],
+                             good_commits[5:], max_window=16)
+    assert n == 5 and state.last_block_height == 10
+
+
+def test_replay_window_bad_app_hash_rejected():
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 5)
+    ex, store, state = _fresh_node(gdoc)
+    blocks[2].header.app_hash = b"\xEE" * 32  # breaks hash/commit chain
+    # first window applies the good prefix (heights 1-2) and stops short
+    state, n = replay_window(ex, store, state, blocks, commits, max_window=8)
+    assert n == 2 and state.last_block_height == 2
+    # the tampered block is now first: strict path attributes it
+    with pytest.raises(WindowSyncError) as ei:
+        replay_window(ex, store, state, blocks[2:], commits[2:],
+                      max_window=8)
+    assert ei.value.height == 3
+    assert ei.value.applied == 0
+
+
+def test_replay_window_nonprefix_garbage_signature_rejected():
+    """A LastCommit signature AFTER the >2/3 certification prefix must
+    still be verified before the enclosing block applies (full
+    verify_commit semantics, reference state/validation.go:92) — the
+    pre-verified cache may only absorb fully-verified commits."""
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 8)
+    # equal powers: the light prefix is the first 3 of 4 signatures; corrupt
+    # the 4th inside block 5's embedded LastCommit (certifying height 4)
+    lc = blocks[4].last_commit
+    s = lc.signatures[3]
+    lc.signatures[3] = type(s)(s.block_id_flag, s.validator_address,
+                               s.timestamp,
+                               bytes([s.signature[0] ^ 1])
+                               + s.signature[1:])
+    blocks[4].header.last_commit_hash = lc.hash()
+    blocks[4].fill_header()
+    ex, store, state = _fresh_node(gdoc)
+    applied_total = 0
+    with pytest.raises(WindowSyncError) as ei:
+        state, n = replay_window(ex, store, state, blocks, commits,
+                                 max_window=16)
+        applied_total += n
+        # corrupted block 5 changed its hash, so its certifier fails first;
+        # either way nothing at or past height 5 may apply
+        while True:
+            state, n = replay_window(ex, store, state,
+                                     blocks[applied_total:],
+                                     commits[applied_total:], max_window=16)
+            if n == 0:
+                break
+            applied_total += n
+    assert ei.value.height <= 5
+    assert ei.value.state is None or ei.value.state.last_block_height < 5
+
+
+# --- pool -----------------------------------------------------------------
+
+def test_pool_schedules_and_serves_window():
+    sent = []
+    errs = []
+    pool = BlockPool(1, lambda pid, h: sent.append((pid, h)),
+                     lambda pid, r: errs.append((pid, r)))
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 8)
+    pool.set_peer_range("p1", 1, 8)
+    pool._schedule_once()
+    assert sent, "requests must go out"
+    for pid, h in list(sent):
+        assert pid == "p1"
+        assert pool.add_block("p1", blocks[h - 1])
+    win = pool.peek_window(10)
+    assert [b.header.height for b in win] == list(
+        range(1, len(win) + 1))
+    pool.pop_requests(len(win) - 1)
+    assert pool.height == len(win)
+    assert not errs
+
+
+def test_pool_rejects_wrong_peer_and_redoes():
+    sent = []
+    pool = BlockPool(1, lambda pid, h: sent.append((pid, h)),
+                     lambda pid, r: None)
+    gdoc, privs = make_genesis(4)
+    blocks, _, _ = build_chain(gdoc, privs, 4)
+    pool.set_peer_range("p1", 1, 4)
+    pool.set_peer_range("p2", 1, 4)
+    pool._schedule_once()
+    (pid1, h1) = sent[0]
+    other = "p2" if pid1 == "p1" else "p1"
+    assert not pool.add_block(other, blocks[h1 - 1])  # wrong peer
+    assert pool.add_block(pid1, blocks[h1 - 1])
+    # redo removes the peer and clears the block
+    assert pool.redo_request(h1) == pid1
+    assert pool.num_peers() == 1
+    assert pool.peek_window(4) == []
+
+
+def test_pool_caught_up():
+    pool = BlockPool(5, lambda *a: None, lambda *a: None)
+    assert not pool.is_caught_up()          # no peers
+    pool.set_peer_range("p1", 1, 5)
+    pool._start_time -= 10                   # pretend we waited
+    assert pool.is_caught_up()               # height 5 >= max(5)-1
+    pool.set_peer_range("p2", 1, 50)
+    assert not pool.is_caught_up()
+
+
+# --- reactor-level end-to-end over an in-memory wire ----------------------
+
+class _MemPeer:
+    """Duck-typed Peer delivering messages directly to a target reactor."""
+
+    def __init__(self, pid, deliver):
+        self.id = pid
+        self._deliver = deliver
+
+    def send(self, ch_id, msg):
+        from tendermint_tpu.libs import safe_codec
+        self._deliver(ch_id, self, safe_codec.dumps(msg))
+        return True
+
+    try_send = send
+
+
+def test_blocksync_reactor_end_to_end():
+    """A served node catches up from a serving node through real reactor
+    messages (StatusRequest/Response, BlockRequest/Response) — in-memory
+    transport, full verify+apply."""
+    from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 25)
+
+    # server side: store holds the whole chain
+    ex_s, store_s, state_s = _fresh_node(gdoc)
+    for b, c in zip(blocks, commits):
+        _bid, parts = block_id_of(b)
+        store_s.save_block(b, parts, c)
+    server = BlocksyncReactor(ex_s, store_s, state_s, fast_sync=False)
+
+    # client side: empty, wants to catch up
+    ex_c, store_c, state_c = _fresh_node(gdoc)
+    caught = threading.Event()
+    client = BlocksyncReactor(ex_c, store_c, state_c, window=8,
+                              on_caught_up=lambda st: caught.set())
+
+    # cross-wire: sending to the "server" handle lands in server.receive
+    # (which sees the "client" handle as the sender), and vice versa
+    handles = {}
+    server_peer = _MemPeer("server", lambda ch, p, mb: server.receive(
+        ch, handles["client"], mb))
+    client_peer = _MemPeer("client", lambda ch, p, mb: client.receive(
+        ch, handles["server"], mb))
+    handles["server"] = server_peer
+    handles["client"] = client_peer
+
+    class _OneSwitch:
+        def __init__(self, peer):
+            self.peers = {peer.id: peer}
+
+        def broadcast(self, ch_id, msg):
+            for p in self.peers.values():
+                p.send(ch_id, msg)
+
+        def stop_peer_for_error(self, peer, reason):
+            raise AssertionError(f"peer error: {reason}")
+
+    client.switch = _OneSwitch(server_peer)
+    server.switch = _OneSwitch(client_peer)
+
+    client.start()
+    client.add_peer(server_peer)
+    server.add_peer(client_peer)
+    # announce server's range
+    client.pool.set_peer_range("server", store_s.base(), store_s.height())
+
+    deadline = time.time() + 30
+    while time.time() < deadline and client.state.last_block_height < 24:
+        time.sleep(0.05)
+    client.stop()
+    # can only sync up to height-1 (last block needs successor commit)
+    assert client.state.last_block_height >= 24
+    assert store_c.load_block(24).hash() == blocks[23].hash()
+    assert client.blocks_synced >= 24
