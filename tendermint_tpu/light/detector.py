@@ -1,0 +1,67 @@
+"""Witness cross-checking (reference light/detector.go).
+
+After the primary's header verifies, each witness is asked for the same
+height; a hash mismatch means a fork/light-client attack on one side.  The
+divergence carries both conflicting blocks so the caller can form
+LightClientAttackEvidence (evidence/ package) and submit it to full nodes
+(reference detector.go:48-112 detectDivergence + examineConflictingHeader).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tendermint_tpu.types.basic import Timestamp
+from tendermint_tpu.types.light_block import LightBlock
+
+from .provider import ProviderError
+
+
+class Divergence(Exception):
+    """A witness disagrees with the primary about a verified header."""
+
+    def __init__(self, primary_block: LightBlock, witness_block: LightBlock,
+                 witness_index: int):
+        super().__init__(
+            f"witness {witness_index} has conflicting header at height "
+            f"{primary_block.height}: primary {primary_block.hash().hex()} "
+            f"vs witness {witness_block.hash().hex()}")
+        self.primary_block = primary_block
+        self.witness_block = witness_block
+        self.witness_index = witness_index
+
+    def make_evidence(self, common_height: int):
+        """Build LightClientAttackEvidence against the witness's view
+        (reference detector.go:120-150 examineConflictingHeaderAgainstTrace).
+        The conflicting block is the one that diverges from our verified
+        chain."""
+        from tendermint_tpu.evidence import LightClientAttackEvidence
+        wb = self.witness_block
+        return LightClientAttackEvidence(
+            conflicting_block=wb,
+            common_height=common_height,
+            byzantine_validators=[],
+            total_voting_power=wb.validators.total_voting_power(),
+            timestamp=wb.time,
+        )
+
+
+def detect_divergence(client, trace: List[LightBlock],
+                      now: Timestamp) -> Optional[Divergence]:
+    """Compare the newly verified header against every witness
+    (reference detector.go:48).  Returns the first Divergence found (the
+    caller raises it), None when all witnesses agree.  Unresponsive
+    witnesses are skipped (the reference removes them after repeated
+    failures)."""
+    if not trace:
+        return None
+    target = trace[-1]
+    for i, w in enumerate(list(client.witnesses)):
+        try:
+            wb = w.light_block(target.height)
+        except ProviderError:
+            continue
+        if wb is None:
+            continue
+        if wb.hash() != target.hash():
+            return Divergence(target, wb, i)
+    return None

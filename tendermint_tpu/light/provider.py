@@ -1,0 +1,93 @@
+"""Light block providers (reference light/provider/provider.go).
+
+A provider serves LightBlocks by height.  The framework ships a local
+store/chain-backed provider (tests, in-process full node) — the RPC-backed
+provider lives in rpc/ and plugs in via the same interface.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tendermint_tpu.types.light_block import LightBlock
+
+
+class ProviderError(Exception):
+    pass
+
+
+class LightBlockNotFound(ProviderError):
+    """Benign: the provider has no block at that height
+    (reference provider/errors.go ErrLightBlockNotFound)."""
+
+
+class HeightTooHigh(ProviderError):
+    """Benign: requested above the provider's head."""
+
+
+class BadLightBlockError(ProviderError):
+    """Malevolent: provider returned an invalid block; drop the provider."""
+
+
+class Provider:
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    def light_block(self, height: int) -> LightBlock:
+        """height=0 means latest.  Raises ProviderError subclasses."""
+        raise NotImplementedError
+
+
+class DictProvider(Provider):
+    """In-memory provider over a prebuilt {height: LightBlock} map — the
+    test double (reference light/provider/mock)."""
+
+    def __init__(self, chain_id: str,
+                 blocks: Optional[Dict[int, LightBlock]] = None):
+        self._chain_id = chain_id
+        self.blocks: Dict[int, LightBlock] = dict(blocks or {})
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def add(self, lb: LightBlock):
+        self.blocks[lb.height] = lb
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            if not self.blocks:
+                raise LightBlockNotFound("provider is empty")
+            return self.blocks[max(self.blocks)]
+        if height > max(self.blocks, default=0):
+            raise HeightTooHigh(f"{height} above head")
+        lb = self.blocks.get(height)
+        if lb is None:
+            raise LightBlockNotFound(f"no light block at {height}")
+        return lb
+
+
+class NodeBackedProvider(Provider):
+    """Serves light blocks straight from a full node's block + state stores
+    (reference light/provider/http does this over RPC; in-process here)."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+        if height == 0:
+            height = self.block_store.height()
+        if height > self.block_store.height():
+            raise HeightTooHigh(f"{height} above {self.block_store.height()}")
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_seen_commit(height) \
+            if height == self.block_store.height() \
+            else self.block_store.load_block_commit(height)
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            raise LightBlockNotFound(f"no light block at {height}")
+        return LightBlock(SignedHeader(meta.header, commit), vals)

@@ -1,0 +1,144 @@
+"""Light client: stateless verifier, bisection client, witness divergence
+(reference light/verifier_test.go, client_test.go, detector_test.go)."""
+from __future__ import annotations
+
+from fractions import Fraction
+
+import pytest
+
+from helpers import build_chain, make_genesis
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.light import (Client, DictProvider, Divergence,
+                                  LightClientError, LightStore, TrustOptions,
+                                  verifier)
+from tendermint_tpu.types.basic import Timestamp
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+
+PERIOD = 3600.0 * 24 * 14
+DRIFT = 10.0
+NOW = Timestamp(1700005000, 0)
+
+
+def _light_chain(n_heights=20, n_vals=5):
+    gdoc, privs = make_genesis(n_vals)
+    blocks, commits, states = build_chain(gdoc, privs, n_heights)
+    # validator set is static in build_chain; light block at height h pairs
+    # the header with the commit certifying it
+    lbs = {}
+    for i, b in enumerate(blocks):
+        vals = states[i].validators
+        lbs[b.header.height] = LightBlock(
+            SignedHeader(b.header, commits[i]), vals)
+    return gdoc, lbs
+
+
+def test_verify_adjacent_and_non_adjacent():
+    gdoc, lbs = _light_chain()
+    verifier.verify_adjacent(lbs[3].signed_header, lbs[4].signed_header,
+                             lbs[4].validators, PERIOD, NOW, DRIFT)
+    verifier.verify_non_adjacent(
+        lbs[3].signed_header, lbs[3].validators, lbs[17].signed_header,
+        lbs[17].validators, PERIOD, NOW, DRIFT)
+    # adjacent heights rejected by the non-adjacent entry point and
+    # vice versa
+    with pytest.raises(verifier.LightError):
+        verifier.verify_non_adjacent(
+            lbs[3].signed_header, lbs[3].validators, lbs[4].signed_header,
+            lbs[4].validators, PERIOD, NOW, DRIFT)
+    with pytest.raises(verifier.LightError):
+        verifier.verify_adjacent(lbs[3].signed_header, lbs[7].signed_header,
+                                 lbs[7].validators, PERIOD, NOW, DRIFT)
+
+
+def test_verify_rejects_expired_and_tampered():
+    gdoc, lbs = _light_chain()
+    # expired trusted header
+    with pytest.raises(verifier.OldHeaderExpiredError):
+        verifier.verify_adjacent(lbs[3].signed_header, lbs[4].signed_header,
+                                 lbs[4].validators, 1.0, NOW, DRIFT)
+    # tampered header fails (commit no longer matches header hash)
+    bad = lbs[9].signed_header
+    orig = bad.header.app_hash
+    bad.header.app_hash = b"\x01" * 32
+    with pytest.raises(verifier.LightError):
+        verifier.verify_adjacent(lbs[8].signed_header, bad,
+                                 lbs[9].validators, PERIOD, NOW, DRIFT)
+    bad.header.app_hash = orig
+
+
+def test_verify_backwards():
+    gdoc, lbs = _light_chain()
+    verifier.verify_backwards(lbs[6].signed_header, lbs[7].signed_header)
+    with pytest.raises(verifier.InvalidHeaderError):
+        verifier.verify_backwards(lbs[5].signed_header, lbs[7].signed_header)
+
+
+def test_trust_level_validation():
+    verifier.validate_trust_level(Fraction(1, 3))
+    verifier.validate_trust_level(Fraction(1, 1))
+    for bad in (Fraction(1, 4), Fraction(3, 2)):
+        with pytest.raises(verifier.LightError):
+            verifier.validate_trust_level(bad)
+
+
+def _make_client(lbs, chain_id, trusted_height=1, witnesses=None,
+                 sequential=False):
+    primary = DictProvider(chain_id, lbs)
+    return Client(
+        chain_id,
+        TrustOptions(trusted_height, lbs[trusted_height].hash(), PERIOD),
+        primary, witnesses if witnesses is not None else [],
+        LightStore(MemDB()), sequential=sequential)
+
+
+def test_client_bisection_reaches_target():
+    gdoc, lbs = _light_chain(30)
+    c = _make_client(lbs, gdoc.chain_id)
+    lb = c.verify_light_block_at_height(30, NOW)
+    assert lb.height == 30
+    assert c.store.get(30) is not None
+    assert c.last_trusted_height() == 30
+
+
+def test_client_sequential_matches():
+    gdoc, lbs = _light_chain(10)
+    c = _make_client(lbs, gdoc.chain_id, sequential=True)
+    lb = c.verify_light_block_at_height(10, NOW)
+    assert lb.height == 10
+    # sequential stored every intermediate height
+    assert c.store.heights() == list(range(1, 11))
+
+
+def test_client_update_and_backwards():
+    gdoc, lbs = _light_chain(15)
+    c = _make_client(lbs, gdoc.chain_id, trusted_height=10)
+    got = c.update(NOW)
+    assert got is not None and got.height == 15
+    # below the anchor: backwards hash-link walk
+    lb = c.verify_light_block_at_height(4, NOW)
+    assert lb.height == 4
+
+
+def test_client_detects_witness_divergence():
+    gdoc, lbs = _light_chain(12)
+    # witness serves a fork: same chain but a corrupted header at 12
+    import copy
+    forked = dict(lbs)
+    evil = copy.deepcopy(lbs[12])
+    evil.signed_header.header.app_hash = b"\xBA\xD0" * 16
+    forked[12] = evil
+    witness = DictProvider(gdoc.chain_id, forked)
+    c = _make_client(lbs, gdoc.chain_id, witnesses=[witness])
+    with pytest.raises(Divergence) as ei:
+        c.verify_light_block_at_height(12, NOW)
+    ev = ei.value.make_evidence(common_height=11)
+    assert ev.conflicting_block.height == 12
+    assert ev.total_voting_power > 0
+
+
+def test_client_rejects_wrong_trust_anchor():
+    gdoc, lbs = _light_chain(5)
+    primary = DictProvider(gdoc.chain_id, lbs)
+    with pytest.raises(LightClientError):
+        Client(gdoc.chain_id, TrustOptions(1, b"\x00" * 32, PERIOD),
+               primary, [], LightStore(MemDB()))
