@@ -1,0 +1,84 @@
+"""Evidence reactor (reference evidence/reactor.go): broadcast pending
+evidence to peers on channel 0x38; received evidence enters the pool (which
+verifies it) and is re-broadcast if new."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.evidence import (EvidenceError,
+                                           evidence_from_proto,
+                                           evidence_proto)
+
+from .pool import EvidencePool
+
+EVIDENCE_CHANNEL = 0x38
+BROADCAST_INTERVAL_S = 10.0
+
+
+@register
+@dataclass
+class EvidenceGossip:
+    """Carries the canonical proto encoding (reference evidence/reactor.go
+    evidenceListToProto)."""
+    evidence_proto: bytes
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool):
+        super().__init__("EVIDENCE")
+        self.pool = pool
+        self._stop = threading.Event()
+        self._sent: dict = {}  # peer_id -> set of evidence hashes sent
+
+    def start(self):
+        threading.Thread(target=self._broadcast_routine, daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+
+    def get_channels(self):
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6,
+                                  send_queue_capacity=100)]
+
+    def add_peer(self, peer: Peer):
+        self._sent[peer.id] = set()
+        self._send_pending(peer)
+
+    def remove_peer(self, peer: Peer, reason):
+        self._sent.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
+        msg = loads(msg_bytes)
+        if not isinstance(msg, EvidenceGossip):
+            return
+        try:
+            ev = evidence_from_proto(msg.evidence_proto)
+            self.pool.add_evidence(ev)
+        except (EvidenceError, Exception) as e:
+            # invalid evidence from a peer: drop it (reference reactor.go
+            # punishes the peer; the switch hook does that here)
+            sw = self.switch
+            if sw is not None and isinstance(e, EvidenceError):
+                sw.stop_peer_for_error(peer, f"bad evidence: {e}")
+
+    def _send_pending(self, peer: Peer):
+        sent = self._sent.get(peer.id, set())
+        for ev in self.pool.pending_evidence():
+            h = ev.hash()
+            if h in sent:
+                continue
+            if peer.try_send(EVIDENCE_CHANNEL,
+                             EvidenceGossip(evidence_proto(ev))):
+                sent.add(h)
+
+    def _broadcast_routine(self):
+        while not self._stop.is_set():
+            sw = self.switch
+            if sw is not None:
+                for peer in list(sw.peers.values()):
+                    self._send_pending(peer)
+            self._stop.wait(BROADCAST_INTERVAL_S)
