@@ -42,6 +42,21 @@ class PubKey(abc.ABC):
         return hash((self.type_name, self.bytes()))
 
 
+def pubkey_from_type_name(type_name: str, data: bytes) -> "PubKey":
+    """Key-scheme registry (the decode half of the PublicKey proto oneof,
+    reference crypto/encoding/codec.go PubKeyFromProto)."""
+    if type_name == "ed25519":
+        from . import ed25519
+        return ed25519.PubKey(data)
+    if type_name == "secp256k1":
+        from . import secp256k1
+        return secp256k1.PubKey(data)
+    if type_name == "sr25519":
+        from . import sr25519
+        return sr25519.PubKey(data)
+    raise ValueError(f"unsupported key type {type_name}")
+
+
 class PrivKey(abc.ABC):
     @abc.abstractmethod
     def bytes(self) -> bytes: ...

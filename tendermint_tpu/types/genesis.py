@@ -25,9 +25,10 @@ class GenesisValidator:
     name: str = ""
 
     def to_validator(self) -> Validator:
-        if self.pub_key_type != "ed25519":
-            raise ValueError(f"unsupported genesis key type {self.pub_key_type}")
-        return Validator.new(edkeys.PubKey(self.pub_key_bytes), self.power)
+        from tendermint_tpu.crypto import pubkey_from_type_name
+        return Validator.new(
+            pubkey_from_type_name(self.pub_key_type, self.pub_key_bytes),
+            self.power)
 
 
 @dataclass

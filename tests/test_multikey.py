@@ -1,0 +1,177 @@
+"""secp256k1 + sr25519 schemes and mixed-key-type batch dispatch
+(BASELINE config 5; reference crypto/secp256k1/secp256k1_test.go,
+crypto/sr25519/sr25519_test.go, types/validator_set_test.go mixed sets)."""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import secp256k1, sr25519
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.types.basic import (BlockID, BlockIDFlag, PartSetHeader,
+                                        SignedMsgType, Timestamp)
+from tendermint_tpu.types.canonical import canonical_vote_bytes
+from tendermint_tpu.types.commit import Commit, CommitSig
+from tendermint_tpu.types.validator import (Validator, pubkey_from_proto,
+                                            pubkey_proto)
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+# --- secp256k1 ------------------------------------------------------------
+
+def test_secp256k1_bip340_vector():
+    """BIP-340 test vector 0 (seckey=3, zero aux, zero msg).  This fork of
+    the reference verifies via btcec/v2/schnorr (secp256k1.go:195-213)."""
+    pk = secp256k1.PrivKey((3).to_bytes(32, "big"))
+    pub = pk.pub_key()
+    assert pub.data[1:].hex().upper() == (
+        "F9308A019258C31049344F85F89D5229B531C845836F99B08601F113BCE036F9")
+    msg32 = bytes(32)
+    sig = secp256k1.schnorr_sign(3, msg32)
+    assert sig.hex().upper() == (
+        "E907831F80848D1069A5371B402410364BDF1C5F8307B0084C55F1CE2DCA8215"
+        "25F66A4A85EA8B71E482A74F382D2CE5EBEEE8FDB2172F477DF4900D310536C0")
+    assert secp256k1.schnorr_verify(
+        int.from_bytes(pub.data[1:], "big"), msg32, sig)
+
+
+def test_secp256k1_sign_verify_and_address():
+    pk = secp256k1.PrivKey.gen_from_secret(b"test secret")
+    pub = pk.pub_key()
+    msg = b"tendermint secp tx"
+    sig = pk.sign(msg)
+    assert len(sig) == 64 and len(pub.data) == 33
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg + b"!", sig)
+    assert not pub.verify_signature(msg, sig[:32] + bytes(32))
+    # bitcoin-style address RIPEMD160(SHA256(pub))
+    assert len(pub.address()) == 20
+    sha = hashlib.sha256(pub.data).digest()
+    assert pub.address() == secp256k1._ripemd160_py(sha)
+
+
+def test_secp256k1_ripemd160_kats():
+    for msg, want in [
+        (b"", "9c1185a5c5e9fc54612808977ee8f548b2258d31"),
+        (b"abc", "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"),
+        (b"message digest", "5d0689ef49d2fae572b881b123a85ffa21595f36"),
+    ]:
+        assert secp256k1._ripemd160_py(msg).hex() == want
+
+
+# --- sr25519 --------------------------------------------------------------
+
+def test_sr25519_sign_verify():
+    pk = sr25519.PrivKey(b"\x11" * 32)
+    pub = pk.pub_key()
+    msg = b"tendermint sr25519 vote"
+    sig = pk.sign(msg)
+    assert len(sig) == 64 and sig[63] & 0x80
+    assert pub.verify_signature(msg, sig)
+    # single-bit mutation rejected (reference sr25519_test.go:27)
+    bad = bytearray(sig)
+    bad[7] ^= 1
+    assert not pub.verify_signature(msg, bytes(bad))
+    assert not pub.verify_signature(msg + b"x", sig)
+    # missing schnorrkel marker bit rejected
+    assert not pub.verify_signature(msg, sig[:63] + bytes([sig[63] & 0x7F]))
+
+
+def test_sr25519_merlin_conformance():
+    """merlin transcript equivalence vector (merlin's own test suite) —
+    proves transcript-level compat with go-schnorrkel."""
+    from tendermint_tpu.crypto._strobe import MerlinTranscript
+    t = MerlinTranscript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    assert t.challenge_bytes(b"challenge", 32).hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615")
+
+
+def test_ristretto_rfc9496_vectors():
+    from tendermint_tpu.crypto._ristretto import Point
+    B = Point.base()
+    assert B.encode().hex() == ("e2f2ae0a6abc4e71a884a961c500515f"
+                                "58e30b6aa582dd8db6a65945e08d2d76")
+    assert B.mul(2).encode().hex() == ("6a493210f7499cd17fecb510ae0cea23"
+                                       "a110e8d5b901f8acadd3095c73a3b919")
+    assert Point.identity().encode() == bytes(32)
+
+
+# --- PublicKey proto oneof round-trips ------------------------------------
+
+def test_pubkey_proto_all_schemes():
+    keys = [
+        ed.PrivKey(b"\x21" * 32).pub_key(),
+        secp256k1.PrivKey.gen_from_secret(b"k2").pub_key(),
+        sr25519.PrivKey(b"\x22" * 32).pub_key(),
+    ]
+    for pub in keys:
+        back = pubkey_from_proto(pubkey_proto(pub))
+        assert back.type_name == pub.type_name
+        assert back.bytes() == pub.bytes()
+
+
+# --- mixed-key batch dispatch (BASELINE config 5) -------------------------
+
+def _mixed_items(n_ed=40, n_secp=3, n_sr=3):
+    items = []
+    for i in range(n_ed):
+        pk = ed.PrivKey((0x1000 + i).to_bytes(32, "big"))
+        m = b"ed msg %d" % i
+        items.append((pk.pub_key(), m, pk.sign(m)))
+    for i in range(n_secp):
+        pk = secp256k1.PrivKey.gen_from_secret(b"secp%d" % i)
+        m = b"secp msg %d" % i
+        items.append((pk.pub_key(), m, pk.sign(m)))
+    for i in range(n_sr):
+        pk = sr25519.PrivKey((0x2000 + i).to_bytes(32, "little"))
+        m = b"sr msg %d" % i
+        items.append((pk.pub_key(), m, pk.sign(m)))
+    return items
+
+
+def test_mixed_batch_dispatch():
+    items = _mixed_items()
+    bv = BatchVerifier()
+    for pub, m, sig in items:
+        bv.add(pub, m, sig)
+    ok, bits = bv.verify()
+    assert ok and bits.all() and len(bits) == len(items)
+    # poison one of each scheme: exact offenders identified
+    bv = BatchVerifier()
+    bad_idx = {1, 41, 44}
+    for i, (pub, m, sig) in enumerate(items):
+        if i in bad_idx:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        bv.add(pub, m, sig)
+    ok, bits = bv.verify()
+    assert not ok
+    assert set(np.flatnonzero(~bits)) == bad_idx
+
+
+def test_mixed_validator_set_verify_commit():
+    """A commit over a validator set containing all three key schemes."""
+    privs = [ed.PrivKey((0x77 + i).to_bytes(32, "big")) for i in range(4)]
+    privs += [secp256k1.PrivKey.gen_from_secret(b"v-secp"),
+              sr25519.PrivKey(b"\x09" * 32)]
+    vals = [Validator.new(p.pub_key(), 10) for p in privs]
+    vset = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(hash=bytes([7] * 32),
+                  part_set_header=PartSetHeader(1, bytes([8] * 32)))
+    chain = "mixed-chain"
+    sigs = []
+    for idx, val in enumerate(vset.validators):
+        ts = Timestamp(1700000000 + idx, 0)
+        sb = canonical_vote_bytes(chain, SignedMsgType.PRECOMMIT, 3, 0,
+                                  bid, ts)
+        sigs.append(CommitSig(BlockIDFlag.COMMIT, val.address, ts,
+                              by_addr[val.address].sign(sb)))
+    commit = Commit(3, 0, bid, sigs)
+    vset.verify_commit(chain, bid, 3, commit)
+    vset.verify_commit_light(chain, bid, 3, commit)
+    from fractions import Fraction
+    vset.verify_commit_light_trusting(chain, commit, Fraction(1, 3))
