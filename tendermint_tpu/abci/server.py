@@ -1,0 +1,149 @@
+"""ABCI socket server (reference abci/server/socket_server.go): serve an
+Application to an external node process over unix/tcp sockets.
+
+Framing: 4-byte big-endian length + allowlisted-codec payload of
+(method_name, request).  The ABCI socket is the operator's own app process
+— a trusted local channel (the reference's socket protocol makes the same
+assumption); Byzantine-exposed wire paths (p2p gossip, storage of gossiped
+data) use the canonical proto codecs instead.
+
+Requests on one connection are handled strictly in order (the reference's
+per-connection ordering guarantee that consensus relies on).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+from tendermint_tpu.libs import safe_codec
+
+from . import types as abci
+
+# every request/response dataclass is already registered with safe_codec
+# via _register_defaults; method names double as the dispatch table
+METHODS = (
+    "echo", "flush", "info", "init_chain", "query", "check_tx",
+    "begin_block", "deliver_tx", "end_block", "commit",
+    "list_snapshots", "offer_snapshot", "load_snapshot_chunk",
+    "apply_snapshot_chunk", "prepare_proposal", "process_proposal",
+)
+
+
+def parse_addr(addr: str) -> Tuple[str, object]:
+    """'unix:///path' or 'tcp://host:port' (reference server.go
+    NewServer)."""
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://"):]
+    if addr.startswith("tcp://"):
+        hostport = addr[len("tcp://"):]
+        host, _, port = hostport.rpartition(":")
+        return "tcp", (host or "127.0.0.1", int(port))
+    raise ValueError(f"unsupported ABCI address {addr!r}")
+
+
+def read_frame(sock: socket.socket):
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    if n > 64 * 1024 * 1024:
+        raise ConnectionError("ABCI frame too large")
+    body = _read_exact(sock, n)
+    if body is None:
+        return None
+    return safe_codec.loads(body)
+
+
+def write_frame(sock: socket.socket, obj) -> None:
+    body = safe_codec.dumps(obj)
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class ABCIServer:
+    def __init__(self, app: abci.Application, addr: str):
+        self.app = app
+        self.addr = addr
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        # one lock across connections: the 4 AppConns multiplex one app,
+        # and in-process apps are not assumed re-entrant
+        self._app_lock = threading.Lock()
+
+    def start(self):
+        kind, target = parse_addr(self.addr)
+        if kind == "unix":
+            if os.path.exists(target):
+                os.unlink(target)
+            ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            ls.bind(target)
+        else:
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ls.bind(target)
+            if target[1] == 0:
+                host = target[0]
+                self.addr = f"tcp://{host}:{ls.getsockname()[1]}"
+        ls.listen(16)
+        ls.settimeout(0.5)
+        self._listener = ls
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.close()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                frame = read_frame(conn)
+                if frame is None:
+                    return
+                method, req = frame
+                if method == "echo":
+                    write_frame(conn, ("echo", req))
+                    continue
+                if method == "flush":
+                    write_frame(conn, ("flush", None))
+                    continue
+                if method not in METHODS:
+                    write_frame(conn, ("error", f"unknown method {method}"))
+                    continue
+                with self._app_lock:
+                    if method == "deliver_tx":
+                        resp = self.app.deliver_tx(req)
+                    elif method == "end_block":
+                        resp = self.app.end_block(req)
+                    elif method == "commit":
+                        resp = self.app.commit()
+                    else:
+                        resp = getattr(self.app, method)(req)
+                write_frame(conn, (method, resp))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()

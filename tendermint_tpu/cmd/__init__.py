@@ -1,0 +1,3 @@
+"""CLI (reference cmd/tendermint/): init, start, testnet, show-node-id,
+show-validator, unsafe-reset-all, version.  Run as
+`python -m tendermint_tpu.cmd <command>`."""

@@ -1,0 +1,231 @@
+"""tendermint_tpu command line (reference cmd/tendermint/main.go:16-35 and
+cmd/tendermint/commands/*.go)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+from tendermint_tpu import __version__
+from tendermint_tpu.config.config import Config
+
+
+def _home(args) -> str:
+    return os.path.abspath(args.home or os.environ.get(
+        "TMHOME", os.path.expanduser("~/.tendermint_tpu")))
+
+
+def cmd_init(args):
+    """Reference commands/init.go: private validator, node key, genesis."""
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = Config(home=_home(args))
+    cfg.ensure_dirs()
+    cfg.save()
+
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    NodeKey.load_or_generate(cfg.node_key_file())
+
+    if not os.path.exists(cfg.genesis_file()):
+        pub = pv.get_pub_key()
+        gdoc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=Timestamp(int(time.time()), 0),
+            validators=[GenesisValidator(
+                address=pub.address(), pub_key_type=pub.type_name,
+                pub_key_bytes=pub.bytes(), power=10)])
+        with open(cfg.genesis_file(), "w") as f:
+            f.write(gdoc.to_json())
+    print(f"Initialized node in {cfg.home}")
+
+
+def cmd_start(args):
+    """Reference commands/run_node.go: assemble + start a node and block."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.node import Node
+
+    cfg = Config.load(_home(args))
+    cfg.home = _home(args)
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    app = _load_app(args.app)
+    node = Node(cfg, app)
+    node.start()
+    print(f"node {node.node_key.node_id} started: "
+          f"p2p={node.switch.actual_listen_addr()} "
+          f"rpc={node.rpc_server.laddr if node.rpc_server else 'off'}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+
+
+def _load_app(spec: str):
+    """`kvstore` (default), a socket address (`unix:///path` or
+    `tcp://host:port`) for an external ABCI app process, or
+    `module:factory` for an in-process Python app."""
+    if spec in ("", "kvstore"):
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        return KVStoreApplication()
+    if spec.startswith(("unix://", "tcp://")):
+        from tendermint_tpu.proxy import AppConns, ClientCreator
+        return AppConns(ClientCreator.remote(spec))
+    mod, _, fn = spec.partition(":")
+    import importlib
+    m = importlib.import_module(mod)
+    return getattr(m, fn or "make_app")()
+
+
+def cmd_testnet(args):
+    """Reference commands/testnet.go: write N validator home dirs sharing
+    one genesis, with persistent_peers wired full-mesh."""
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.v
+    out = os.path.abspath(args.o)
+    base_p2p = args.starting_p2p_port
+    base_rpc = args.starting_rpc_port
+    homes, pvs, keys = [], [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config(home=home, moniker=f"node{i}")
+        cfg.ensure_dirs()
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                     cfg.priv_validator_state_file())
+        nk = NodeKey.load_or_generate(cfg.node_key_file())
+        homes.append(home)
+        pvs.append(pv)
+        keys.append(nk)
+    gdoc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=Timestamp(int(time.time()), 0),
+        validators=[GenesisValidator(
+            address=pv.get_pub_key().address(),
+            pub_key_type=pv.get_pub_key().type_name,
+            pub_key_bytes=pv.get_pub_key().bytes(), power=10)
+            for pv in pvs])
+    gjson = gdoc.to_json()
+    for i, home in enumerate(homes):
+        cfg = Config(home=home, moniker=f"node{i}")
+        cfg.p2p.laddr = f"127.0.0.1:{base_p2p + i}"
+        cfg.rpc.laddr = f"127.0.0.1:{base_rpc + i}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"{keys[j].node_id}@127.0.0.1:{base_p2p + j}"
+            for j in range(n) if j != i)
+        cfg.save()
+        with open(cfg.genesis_file(), "w") as f:
+            f.write(gjson)
+    print(f"Successfully initialized {n} node directories in {out}")
+
+
+def cmd_show_node_id(args):
+    from tendermint_tpu.p2p.key import NodeKey
+    cfg = Config(home=_home(args))
+    print(NodeKey.load_or_generate(cfg.node_key_file()).node_id)
+
+
+def cmd_show_validator(args):
+    from tendermint_tpu.privval.file_pv import FilePV
+    cfg = Config(home=_home(args))
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.type_name, "value":
+                      pub.bytes().hex()}))
+
+
+def cmd_unsafe_reset_all(args):
+    """Reference commands/reset.go: wipe data, keep config + keys."""
+    cfg = Config(home=_home(args))
+    if os.path.isdir(cfg.data_dir()):
+        shutil.rmtree(cfg.data_dir())
+    os.makedirs(cfg.data_dir(), exist_ok=True)
+    # reset privval state (sign-state only; key survives)
+    st = cfg.priv_validator_state_file()
+    if os.path.exists(st):
+        os.remove(st)
+    print(f"Reset {cfg.data_dir()}")
+
+
+def cmd_version(args):
+    print(__version__)
+
+
+def cmd_abci_kvstore(args):
+    """Run the example kvstore as a standalone ABCI server process
+    (reference abci/cmd/abci-cli kvstore)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.server import ABCIServer
+
+    srv = ABCIServer(KVStoreApplication(), args.address)
+    srv.start()
+    print(f"ABCI kvstore serving on {srv.addr}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="tendermint_tpu")
+    p.add_argument("--home", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="initialize a node home dir")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run a node")
+    sp.add_argument("--app", default="kvstore")
+    sp.add_argument("--p2p-laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--persistent-peers", dest="persistent_peers",
+                    default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="initialize a local testnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--o", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-p2p-port", type=int, default=26656)
+    sp.add_argument("--starting-rpc-port", type=int, default=26657)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("show-node-id")
+    sp.set_defaults(fn=cmd_show_node_id)
+    sp = sub.add_parser("show-validator")
+    sp.set_defaults(fn=cmd_show_validator)
+    sp = sub.add_parser("unsafe-reset-all")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+    sp = sub.add_parser("abci-kvstore",
+                        help="run the kvstore app as an ABCI server")
+    sp.add_argument("--address", default="tcp://127.0.0.1:26658")
+    sp.set_defaults(fn=cmd_abci_kvstore)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

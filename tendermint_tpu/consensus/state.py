@@ -120,6 +120,16 @@ class ConsensusState:
 
     # ------------------------------------------------------------------ API
 
+    def switch_to_consensus(self, state: SMState):
+        """Adopt a blocksync-advanced state before starting (reference
+        consensus/reactor.go:93 SwitchToConsensus -> updateToState)."""
+        with self._mtx:
+            self._update_to_state(state)
+            if state.last_block_height > 0:
+                self._reconstruct_last_commit(state)
+        if self.wal is not None:
+            self.wal.write_sync(EndHeightMessage(state.last_block_height))
+
     def start(self):
         if self.wal is not None:
             self._catchup_replay()

@@ -1,0 +1,256 @@
+"""Node assembly (reference node/node.go:704-1001): wire config -> stores
+-> handshake -> mempool/evidence -> executor -> consensus -> p2p reactors
+-> RPC, with the same startup order as NewNode + OnStart."""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from tendermint_tpu.abci.types import (RequestInfo, RequestInitChain,
+                                       ValidatorUpdate)
+from tendermint_tpu.blocksync import BlocksyncReactor
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
+from tendermint_tpu.libs.kvdb import MemDB, SQLiteDB
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State, state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+class NodeError(Exception):
+    pass
+
+
+def handshake(app, state: State, state_store: StateStore,
+              block_store: BlockStore, gdoc: GenesisDoc) -> State:
+    """Handshaker (reference consensus/replay.go:197-310): sync the app
+    with the stores.  Decision table on (store height, app height):
+    fresh chain -> InitChain; app behind store -> replay stored blocks
+    into the app; app equal -> nothing."""
+    info = app.info(RequestInfo())
+    app_height = getattr(info, "last_block_height", 0) or 0
+    store_height = block_store.height()
+
+    if state.last_block_height == 0 and app_height == 0:
+        # InitChain with genesis validators (replay.go:250-287)
+        req = RequestInitChain(
+            time_seconds=gdoc.genesis_time.seconds,
+            chain_id=gdoc.chain_id,
+            validators=[ValidatorUpdate(v.pub_key_type, v.pub_key_bytes,
+                                        v.power)
+                        for v in gdoc.validators],
+            app_state_bytes=gdoc.app_state or b"",
+            initial_height=gdoc.initial_height)
+        resp = app.init_chain(req)
+        if resp.app_hash:
+            state.app_hash = resp.app_hash
+        if resp.validators:
+            # the app replaced the genesis validator set
+            from tendermint_tpu.state.execution import (
+                validator_updates_to_validators)
+            from tendermint_tpu.types.validator_set import ValidatorSet
+            vals = validator_updates_to_validators(resp.validators)
+            state.validators = ValidatorSet(vals)
+            state.next_validators = state.validators.copy()
+        state_store.save(state)
+    elif app_height < store_height:
+        # replay stored blocks the app missed (replay.go:420-516); the
+        # in-process apps here persist nothing, so this is the restart path
+        executor = BlockExecutor(None, app)
+        for h in range(app_height + 1, store_height + 1):
+            block = block_store.load_block(h)
+            if block is None:
+                raise NodeError(f"handshake: missing block {h}")
+            executor._exec_block_on_app(state, block)
+            app.commit()
+    return state
+
+
+class Node:
+    """A full node (reference node/node.go:704 NewNode + :938 OnStart)."""
+
+    def __init__(self, config: Config, app, genesis: Optional[GenesisDoc]
+                 = None, in_memory: bool = False):
+        from tendermint_tpu.proxy import AppConns, ClientCreator
+        self.config = config
+        # four logical app connections (reference proxy/multi_app_conn.go);
+        # a plain in-process Application shares one instance across all
+        self.app_conns = app if isinstance(app, AppConns) \
+            else AppConns(ClientCreator.local(app))
+        self.app = self.app_conns.query
+        cfg = config
+
+        # -- keys / genesis (node.go:755-780) --------------------------
+        self.node_key = NodeKey.load_or_generate(cfg.node_key_file())
+        self.genesis = genesis or GenesisDoc.from_json(
+            open(cfg.genesis_file()).read())
+        self.genesis.validate_and_complete()
+
+        # -- stores (node.go:723-733) ----------------------------------
+        if in_memory:
+            block_db, state_db, ev_db = MemDB(), MemDB(), MemDB()
+        else:
+            os.makedirs(cfg.data_dir(), exist_ok=True)
+            block_db = SQLiteDB(cfg.block_db_file())
+            state_db = SQLiteDB(cfg.state_db_file())
+            ev_db = SQLiteDB(os.path.join(cfg.data_dir(), "evidence.db"))
+        self.block_store = BlockStore(block_db)
+        self.state_store = StateStore(state_db)
+
+        # -- state + handshake (node.go:783-802) -----------------------
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(self.genesis)
+        self.state = handshake(self.app_conns.consensus, state,
+                               self.state_store,
+                               self.block_store, self.genesis)
+
+        # -- privval (node.go:808-826) ---------------------------------
+        self.priv_validator: Optional[FilePV] = None
+        if os.path.exists(cfg.priv_validator_key_file()):
+            self.priv_validator = FilePV.load_or_generate(
+                cfg.priv_validator_key_file(),
+                cfg.priv_validator_state_file())
+
+        # -- event bus / mempool / evidence / indexers (node.go:832-860) --
+        self.event_bus = EventBus()
+        from tendermint_tpu.state.indexer import (BlockIndexer,
+                                                  IndexerService, TxIndexer)
+        ix_db = MemDB() if in_memory else SQLiteDB(
+            os.path.join(cfg.data_dir(), "tx_index.db"))
+        self.tx_indexer = TxIndexer(ix_db)
+        self.block_indexer = BlockIndexer(ix_db)
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus)
+        self.mempool = Mempool(self.app_conns.mempool,
+                               max_tx_bytes=cfg.mempool.max_tx_bytes,
+                               size_limit=cfg.mempool.size)
+        self.evidence_pool = EvidencePool(ev_db, self.state_store,
+                                          self.block_store)
+
+        # -- executor + consensus (node.go:862-906) --------------------
+        self.executor = BlockExecutor(
+            self.state_store, self.app_conns.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool, event_bus=self.event_bus,
+            block_store=self.block_store)
+        self.consensus = ConsensusState(
+            cfg.consensus, self.state, self.executor, self.block_store,
+            mempool=self.mempool, priv_validator=self.priv_validator,
+            wal_path=cfg.wal_file(), event_bus=self.event_bus,
+            name=cfg.moniker, evidence_pool=self.evidence_pool)
+        self.mempool.on_new_tx(self.consensus.notify_txs_available)
+
+        # -- p2p switch + reactors (node.go:908-936) -------------------
+        self.switch = Switch(self.node_key, cfg.p2p.laddr,
+                             network=self.genesis.chain_id,
+                             moniker=cfg.moniker)
+        self.consensus_reactor = ConsensusReactor(self.consensus)
+        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+        # fastSync := config.FastSyncMode && !onlyValidatorIsUs
+        # (reference node/node.go:712-722)
+        fast_sync = cfg.block_sync.enable and not self._only_validator_is_us()
+        self.blocksync_reactor = BlocksyncReactor(
+            self.executor, self.block_store, self.state,
+            fast_sync=fast_sync, on_caught_up=self._on_caught_up)
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        # -- RPC (node.go:996 StartRPC) --------------------------------
+        self.rpc_server = None
+        if cfg.rpc.enabled:
+            from tendermint_tpu.rpc.server import RPCServer
+            self.rpc_server = RPCServer(self, cfg.rpc.laddr)
+
+        self._started = False
+        self._consensus_started = threading.Event()
+
+    def _only_validator_is_us(self) -> bool:
+        """Reference node/node.go:640-652."""
+        if self.priv_validator is None:
+            return False
+        if self.state.validators.size() != 1:
+            return False
+        addr, _ = self.state.validators.get_by_index(0)
+        return addr == self.priv_validator.get_pub_key().address()
+
+    # -- lifecycle (node.go:938-1001) --------------------------------------
+
+    def start(self, wait_for_sync: bool = False):
+        if self._started:
+            raise NodeError("node already started")
+        self._started = True
+        self.indexer_service.start()
+        self.switch.start()
+        for addr in filter(None,
+                           self.config.p2p.persistent_peers.split(",")):
+            self.switch.dial_peer(addr.strip(), persistent=True)
+        self.evidence_reactor.start()
+        if self.blocksync_reactor.fast_sync:
+            self.blocksync_reactor.start()
+        else:
+            self._on_caught_up(self.state)
+        if self.rpc_server is not None:
+            self.rpc_server.start()
+        if wait_for_sync:
+            self._consensus_started.wait()
+
+    def _on_caught_up(self, state):
+        """SwitchToConsensus (reference blocksync/reactor.go:316)."""
+        self.state = state
+        if state.last_block_height > \
+                (self.consensus.state.last_block_height
+                 if self.consensus.state else 0):
+            self.consensus.switch_to_consensus(state)
+        self.consensus.start()
+        self._consensus_started.set()
+
+    def stop(self):
+        self.indexer_service.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.blocksync_reactor.stop()
+        self.consensus_reactor.stop()
+        self.evidence_reactor.stop()
+        if self._consensus_started.is_set():
+            self.consensus.stop()
+        self.switch.stop()
+
+    # -- info for RPC -------------------------------------------------------
+
+    def status(self) -> dict:
+        latest = self.block_store.height()
+        meta = self.block_store.load_block_meta(latest) if latest else None
+        return {
+            "node_info": {
+                "id": self.node_key.node_id,
+                "listen_addr": self.switch.actual_listen_addr(),
+                "network": self.genesis.chain_id,
+                "moniker": self.config.moniker,
+            },
+            "sync_info": {
+                "latest_block_height": latest,
+                "latest_block_hash":
+                    meta.block_id.hash.hex() if meta else "",
+                "latest_app_hash": self.state.app_hash.hex(),
+                "catching_up": not self._consensus_started.is_set(),
+            },
+            "validator_info": {
+                "address": self.priv_validator.get_pub_key().address().hex()
+                if self.priv_validator else "",
+            },
+        }

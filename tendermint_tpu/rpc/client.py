@@ -1,0 +1,95 @@
+"""HTTP JSON-RPC client (reference rpc/client/http/http.go) — the operator
+/ light-client transport to a node's RPC server."""
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+from typing import Optional
+
+
+class RPCClientError(Exception):
+    pass
+
+
+class HTTPClient:
+    def __init__(self, addr: str, timeout: float = 10.0):
+        # accept host:port or full URL
+        if not addr.startswith("http"):
+            addr = "http://" + addr
+        self.base = addr.rstrip("/")
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, **params):
+        self._id += 1
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": self._id, "method": method,
+            "params": params}).encode()
+        req = urllib.request.Request(
+            self.base, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            payload = json.loads(resp.read())
+        if "error" in payload:
+            e = payload["error"]
+            raise RPCClientError(f"{e.get('code')}: {e.get('message')}")
+        return payload["result"]
+
+    # -- typed helpers (reference rpc/client/http methods) ----------------
+
+    def status(self):
+        return self.call("status")
+
+    def health(self):
+        return self.call("health")
+
+    def net_info(self):
+        return self.call("net_info")
+
+    def genesis(self):
+        return self.call("genesis")
+
+    def block(self, height: Optional[int] = None):
+        return self.call("block", **({} if height is None
+                                     else {"height": height}))
+
+    def block_results(self, height: Optional[int] = None):
+        return self.call("block_results", **({} if height is None
+                                             else {"height": height}))
+
+    def commit(self, height: Optional[int] = None):
+        return self.call("commit", **({} if height is None
+                                      else {"height": height}))
+
+    def validators(self, height: Optional[int] = None, page: int = 1,
+                   per_page: int = 100):
+        kw = {"page": page, "per_page": per_page}
+        if height is not None:
+            kw["height"] = height
+        return self.call("validators", **kw)
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync",
+                         tx=base64.b64encode(tx).decode())
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self.call("broadcast_tx_async",
+                         tx=base64.b64encode(tx).decode())
+
+    def broadcast_tx_commit(self, tx: bytes, timeout: float = 30.0):
+        return self.call("broadcast_tx_commit",
+                         tx=base64.b64encode(tx).decode(), timeout=timeout)
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def abci_query(self, path: str = "", data: bytes = b""):
+        return self.call("abci_query", path=path, data=data.hex())
+
+    def tx(self, tx_hash: bytes):
+        return self.call("tx", hash=tx_hash.hex())
+
+    def tx_search(self, query: str, page: int = 1, per_page: int = 30):
+        return self.call("tx_search", query=query, page=page,
+                         per_page=per_page)

