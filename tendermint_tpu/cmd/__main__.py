@@ -20,7 +20,6 @@ def _home(args) -> str:
 
 def cmd_init(args):
     """Reference commands/init.go: private validator, node key, genesis."""
-    from tendermint_tpu.crypto import ed25519 as edkeys
     from tendermint_tpu.p2p.key import NodeKey
     from tendermint_tpu.privval.file_pv import FilePV
     from tendermint_tpu.types.basic import Timestamp
@@ -49,7 +48,6 @@ def cmd_init(args):
 
 def cmd_start(args):
     """Reference commands/run_node.go: assemble + start a node and block."""
-    from tendermint_tpu.abci.kvstore import KVStoreApplication
     from tendermint_tpu.node import Node
 
     cfg = Config.load(_home(args))
@@ -93,7 +91,6 @@ def _load_app(spec: str):
 def cmd_testnet(args):
     """Reference commands/testnet.go: write N validator home dirs sharing
     one genesis, with persistent_peers wired full-mesh."""
-    from tendermint_tpu.crypto import ed25519 as edkeys
     from tendermint_tpu.p2p.key import NodeKey
     from tendermint_tpu.privval.file_pv import FilePV
     from tendermint_tpu.types.basic import Timestamp

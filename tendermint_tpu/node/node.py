@@ -64,15 +64,28 @@ def handshake(app, state: State, state_store: StateStore,
             state.validators = ValidatorSet(vals)
             state.next_validators = state.validators.copy()
         state_store.save(state)
+    elif app_height > store_height:
+        # reference replay.go errors: the app cannot be ahead of the store
+        # (happens after unsafe-reset-all with a persistent external app)
+        raise NodeError(
+            f"handshake: app block height {app_height} is higher than "
+            f"store height {store_height}; reset the app or restore data")
     elif app_height < store_height:
         # replay stored blocks the app missed (replay.go:420-516); the
         # in-process apps here persist nothing, so this is the restart path
+        import copy
         executor = BlockExecutor(None, app)
         for h in range(app_height + 1, store_height + 1):
             block = block_store.load_block(h)
             if block is None:
                 raise NodeError(f"handshake: missing block {h}")
-            executor._exec_block_on_app(state, block)
+            # last_commit signature indices resolve against the validator
+            # set of h-1, which may differ from the latest state's
+            replay_state = copy.copy(state)
+            lvals = state_store.load_validators(h - 1) if h > 1 else None
+            if lvals is not None:
+                replay_state.last_validators = lvals
+            executor._exec_block_on_app(replay_state, block)
             app.commit()
     return state
 
@@ -229,6 +242,7 @@ class Node:
         if self._consensus_started.is_set():
             self.consensus.stop()
         self.switch.stop()
+        self.app_conns.stop()  # last: consensus/mempool use these
 
     # -- info for RPC -------------------------------------------------------
 

@@ -1,10 +1,8 @@
 """JSON-RPC 2.0 server over HTTP (reference rpc/jsonrpc/server +
 rpc/core/routes.go:10-49).
 
-Supports POST (JSON-RPC body) and GET (/method?arg=val) like the reference.
-Handlers close over the Node.  Event subscriptions are served over
-long-polling (`subscribe_poll`) rather than websockets — same event-bus
-semantics, HTTP-only transport.
+Supports POST (JSON-RPC body) and GET (/method?arg=val) like the
+reference.  Handlers close over the Node.
 """
 from __future__ import annotations
 
@@ -106,7 +104,17 @@ class RPCServer:
                 method = u.path.strip("/")
                 params = {}
                 for k, v in parse_qsl(u.query):
-                    params[k] = json.loads(v) if v and v[0] in '["{' else v
+                    if v in ("true", "false"):
+                        params[k] = v == "true"
+                    elif v and v[0] in '["{':
+                        try:
+                            params[k] = json.loads(v)
+                        except json.JSONDecodeError:
+                            self._reply(server._err(
+                                -1, -32602, f"malformed param {k}={v!r}"))
+                            return
+                    else:
+                        params[k] = v
                 if method == "":
                     self._reply({"jsonrpc": "2.0", "id": -1, "result": {
                         "routes": sorted(server.routes)}})
@@ -195,12 +203,10 @@ class RPCServer:
 
     def block_by_hash(self, hash=None):
         want = bytes.fromhex(hash) if hash else b""
-        store = self.node.block_store
-        for h in range(store.height(), store.base() - 1, -1):
-            m = store.load_block_meta(h)
-            if m is not None and m.block_id.hash == want:
-                return self.block(h)
-        raise RPCError(-32603, "block not found")
+        h = self.node.block_store.height_by_hash(want)
+        if h is None:
+            raise RPCError(-32603, "block not found")
+        return self.block(h)
 
     def block_results(self, height=None):
         h = _int_arg(height, self.node.block_store.height())

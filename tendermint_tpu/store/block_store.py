@@ -110,10 +110,12 @@ class BlockStore:
         return Block.from_proto(ps.assemble())
 
     def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+        h = self.height_by_hash(block_hash)
+        return self.load_block(h) if h is not None else None
+
+    def height_by_hash(self, block_hash: bytes) -> Optional[int]:
         raw = self.db.get(_hash_key(block_hash))
-        if raw is None:
-            return None
-        return self.load_block(int(raw))
+        return int(raw) if raw is not None else None
 
     def load_block_part(self, height: int, index: int):
         raw = self.db.get(_part_key(height, index))

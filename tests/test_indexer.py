@@ -1,0 +1,83 @@
+"""Query language + tx/block indexers (reference libs/pubsub/query/
+query_test.go, state/txindex/kv/kv_test.go)."""
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.abci.types import Event, ResponseDeliverTx
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.libs.pubsub_query import Query, QueryError
+from tendermint_tpu.state.indexer import BlockIndexer, TxIndexer
+from tendermint_tpu.types.block import tx_hash
+
+
+def test_query_parse_and_match():
+    q = Query("tm.event = 'Tx' AND tx.height > 5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["7"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["3"]})
+    assert not q.matches({"tx.height": ["7"]})
+
+    q = Query("account.owner CONTAINS 'ivan'")
+    assert q.matches({"account.owner": ["ivan the great"]})
+    assert not q.matches({"account.owner": ["peter"]})
+
+    q = Query("fee.amount EXISTS")
+    assert q.matches({"fee.amount": ["100"]})
+    assert not q.matches({"other": ["1"]})
+
+    q = Query("tx.height >= 3 AND tx.height <= 5")
+    assert q.matches({"tx.height": ["4"]})
+    assert not q.matches({"tx.height": ["6"]})
+
+
+def test_query_parse_errors():
+    for bad in ("", "AND", "tx.height >", "tx.height 5",
+                "a = 'x' OR b = 'y'", "a CONTAINS 5"):
+        with pytest.raises(QueryError):
+            Query(bad)
+
+
+def _mk_result(code=0, events=None):
+    return ResponseDeliverTx(code=code, events=events or [])
+
+
+def test_tx_indexer_get_and_search():
+    ix = TxIndexer(MemDB())
+    txs = [b"tx-a", b"tx-b", b"tx-c"]
+    results = [
+        _mk_result(events=[Event("transfer", {"sender": "alice",
+                                              "amount": "10"})]),
+        _mk_result(events=[Event("transfer", {"sender": "bob",
+                                              "amount": "5"})]),
+        _mk_result(code=1),
+    ]
+    ix.index_block_txs(7, txs, results)
+    ix.index_block_txs(8, [b"tx-d"], [
+        _mk_result(events=[Event("transfer", {"sender": "alice",
+                                              "amount": "3"})])])
+
+    got = ix.get(tx_hash(b"tx-b"))
+    assert got["height"] == 7 and got["index"] == 1
+
+    r = ix.search("transfer.sender = 'alice'")
+    assert r["total_count"] == 2
+    assert [t["height"] for t in r["txs"]] == [7, 8]
+
+    r = ix.search("transfer.sender = 'alice' AND transfer.amount > 5")
+    assert r["total_count"] == 1 and r["txs"][0]["height"] == 7
+
+    r = ix.search("tx.height = '8'")
+    assert r["total_count"] == 1
+
+    r = ix.search(f"tx.hash = '{tx_hash(b'tx-c').hex().upper()}'")
+    assert r["total_count"] == 1 and r["txs"][0]["tx_result"]["code"] == 1
+
+
+def test_block_indexer_search():
+    bx = BlockIndexer(MemDB())
+    for h in range(1, 6):
+        bx.index(h, [Event("rollup", {"batch": str(h * 10)})], [])
+    r = bx.search("rollup.batch >= 30")
+    assert r["blocks"] == [3, 4, 5]
+    r = bx.search("block.height = '2'")
+    assert r["blocks"] == [2]
