@@ -90,15 +90,17 @@ class SocketClient(abci.Application):
 
     def commit(self): return self._call("commit", None)
 
-    def list_snapshots(self, req): return self._call("list_snapshots", req)
+    def list_snapshots(self):
+        return self._call("list_snapshots", None)
 
-    def offer_snapshot(self, req): return self._call("offer_snapshot", req)
+    def offer_snapshot(self, snapshot, app_hash):
+        return self._call("offer_snapshot", (snapshot, app_hash))
 
-    def load_snapshot_chunk(self, req):
-        return self._call("load_snapshot_chunk", req)
+    def load_snapshot_chunk(self, height, format_, index):
+        return self._call("load_snapshot_chunk", (height, format_, index))
 
-    def apply_snapshot_chunk(self, req):
-        return self._call("apply_snapshot_chunk", req)
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        return self._call("apply_snapshot_chunk", (index, chunk, sender))
 
     def prepare_proposal(self, req):
         return self._call("prepare_proposal", req)

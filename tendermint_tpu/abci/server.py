@@ -138,8 +138,11 @@ class ABCIServer:
                         resp = self.app.deliver_tx(req)
                     elif method == "end_block":
                         resp = self.app.end_block(req)
-                    elif method == "commit":
-                        resp = self.app.commit()
+                    elif method in ("commit", "list_snapshots"):
+                        resp = getattr(self.app, method)()
+                    elif method in ("offer_snapshot", "load_snapshot_chunk",
+                                    "apply_snapshot_chunk"):
+                        resp = getattr(self.app, method)(*req)
                     else:
                         resp = getattr(self.app, method)(req)
                 write_frame(conn, (method, resp))

@@ -25,6 +25,7 @@ import time
 import traceback
 from typing import Callable, List, Optional
 
+from tendermint_tpu.crypto.batch import BatchVerifier
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State as SMState
@@ -177,26 +178,106 @@ class ConsensusState:
 
     # --------------------------------------------------- receive routine
 
+    # how many queued peer messages one loop iteration drains for the
+    # coalescing window, and the minimum vote count worth a batch launch
+    DRAIN_CAP = 2048
+    BATCH_MIN_VOTES = 8
+
     def _receive_routine(self):
         while not self._stop.is_set():
             try:
-                msg, peer_id = None, ""
+                batch = []  # [(msg, peer_id)] in arrival order
                 # prioritize internal messages (own votes/proposals)
                 try:
-                    msg, peer_id = self._internal_queue.get_nowait()
+                    batch.append(self._internal_queue.get_nowait())
                 except queue.Empty:
                     try:
-                        msg, peer_id = self._peer_queue.get(timeout=0.02)
+                        batch.append(self._peer_queue.get(timeout=0.02))
                     except queue.Empty:
                         continue
+                    # coalescing window (SURVEY §7 hard part 2): drain
+                    # whatever ELSE is already waiting — zero added
+                    # latency, natural batching under vote storms
+                    while len(batch) < self.DRAIN_CAP:
+                        try:
+                            batch.append(self._peer_queue.get_nowait())
+                        except queue.Empty:
+                            break
+                if len(batch) > 1:
+                    self._preverify_votes(batch)
                 with self._mtx:
-                    self._handle_msg(msg, peer_id)
+                    for msg, peer_id in batch:
+                        self._handle_msg(msg, peer_id)
             except Exception:  # noqa: BLE001 - consensus failure is fatal
                 traceback.print_exc()
                 # reference panics with "CONSENSUS FAILURE!!!"
                 # (consensus/state.go:735): safety over availability.
                 self._stop.set()
                 return
+
+    def _preverify_votes(self, batch):
+        """Verify every queued vote's signature in ONE batched launch and
+        publish the valid ones to the signature cache, so the in-order
+        apply below hits the cache instead of verifying serially
+        (replaces the reference's per-vote verify at the consensus
+        boundary, types/vote_set.go:121).  Attribution stays exact: an
+        invalid vote simply misses the cache and fails the serial check."""
+        votes = [m.vote for m, _ in batch if isinstance(m, VoteMessage)]
+        if len(votes) < self.BATCH_MIN_VOTES:
+            return
+        with self._mtx:
+            state = self.state
+            if state is None:
+                return
+            vals_now = state.validators
+            vals_last = state.last_validators
+            height = self.rs.height
+            cur_votes = self.rs.votes
+        bv = BatchVerifier()
+        chain_id = state.chain_id
+        seen = set()
+        for v in votes:
+            # every field here is peer-controlled and type-unchecked; a
+            # malformed vote must fall through to the serial path's
+            # rejection, never take down the receive loop
+            try:
+                # only votes the apply path will actually verify: current
+                # height, or height-1 precommits entering last_commit
+                if v.height == height:
+                    vals = vals_now
+                elif (v.height == height - 1
+                        and v.type == SignedMsgType.PRECOMMIT):
+                    vals = vals_last
+                else:
+                    continue
+                if vals is None or not isinstance(v.validator_index, int) \
+                        or not (0 <= v.validator_index < vals.size()):
+                    continue
+                _, val = vals.get_by_index(v.validator_index)
+                if val is None or val.address != v.validator_address:
+                    continue
+                if not isinstance(v.round, int) or not 0 <= v.round < 4096:
+                    continue
+                # skip votes the set already holds (replay amplification)
+                if (v.height == height and cur_votes is not None):
+                    vs = (cur_votes.prevotes(v.round)
+                          if v.type == SignedMsgType.PREVOTE
+                          else cur_votes.precommits(v.round))
+                    if vs is not None and vs.votes[v.validator_index] \
+                            is not None:
+                        continue
+                key = (v.validator_index, v.signature)
+                if key in seen:
+                    continue
+                seen.add(key)
+                bv.add(val.pub_key, v.sign_bytes(chain_id), v.signature)
+            except Exception:
+                continue
+        if len(bv):
+            try:
+                bv.verify()  # populates crypto.batch.verified_sigs
+            except Exception:
+                pass
 
     def _handle_msg(self, msg, peer_id: str):
         if self.wal is not None:

@@ -125,6 +125,11 @@ def _parse(s: str) -> List[Condition]:
                 raise QueryError(f"bad operand {val!r}")
             if op == "CONTAINS" and not isinstance(operand, str):
                 raise QueryError("CONTAINS needs a string operand")
+            if op in ("<", "<=", ">", ">=") and not isinstance(operand,
+                                                              float):
+                raise QueryError(
+                    f"{op} needs a numeric operand (string ordering is "
+                    f"not supported)")
             conds.append(Condition(key, op, operand))
             i += 3
         else:

@@ -1,0 +1,125 @@
+"""State sync reactor (reference statesync/reactor.go): snapshot discovery
+on channel 0x60, chunk transfer on 0x61; the serving side answers from its
+app's snapshot store."""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+
+from .syncer import StateSyncError, Syncer
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+CHUNK_TIMEOUT_S = 15.0
+
+
+@register
+@dataclass
+class SnapshotsRequest:
+    pass
+
+
+@register
+@dataclass
+class SnapshotsResponse:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes
+
+
+@register
+@dataclass
+class ChunkRequest:
+    height: int
+    format: int
+    index: int
+
+
+@register
+@dataclass
+class ChunkResponse:
+    height: int
+    format: int
+    index: int
+    chunk: bytes
+    missing: bool = False
+
+
+class StateSyncReactor(Reactor):
+    def __init__(self, app, state_provider=None):
+        super().__init__("STATESYNC")
+        self.app = app
+        self.syncer: Optional[Syncer] = None
+        if state_provider is not None:
+            self.syncer = Syncer(app, state_provider, self._fetch_chunk)
+        self._chunks: "queue.Queue" = queue.Queue()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=16),
+        ]
+
+    def add_peer(self, peer: Peer):
+        if self.syncer is not None:
+            peer.try_send(SNAPSHOT_CHANNEL, SnapshotsRequest())
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
+        msg = loads(msg_bytes)
+        if ch_id == SNAPSHOT_CHANNEL:
+            if isinstance(msg, SnapshotsRequest):
+                for s in (self.app.list_snapshots() or [])[-10:]:
+                    peer.try_send(SNAPSHOT_CHANNEL, SnapshotsResponse(
+                        s.height, s.format, s.chunks, s.hash, s.metadata))
+            elif isinstance(msg, SnapshotsResponse) and self.syncer:
+                self.syncer.add_snapshot(
+                    abci.Snapshot(msg.height, msg.format, msg.chunks,
+                                  msg.hash, msg.metadata), peer.id)
+        elif ch_id == CHUNK_CHANNEL:
+            if isinstance(msg, ChunkRequest):
+                chunk = self.app.load_snapshot_chunk(msg.height, msg.format,
+                                                     msg.index)
+                peer.try_send(CHUNK_CHANNEL, ChunkResponse(
+                    msg.height, msg.format, msg.index, chunk or b"",
+                    missing=not chunk))
+            elif isinstance(msg, ChunkResponse):
+                self._chunks.put((msg, peer.id))
+
+    # -- chunk fetch over p2p (the Syncer's fetcher) -----------------------
+
+    def _fetch_chunk(self, snapshot: abci.Snapshot, index: int,
+                     peer_hint: str):
+        sw = self.switch
+        peer = sw.peers.get(peer_hint) if sw else None
+        if peer is None and sw and sw.peers:
+            peer = next(iter(sw.peers.values()))
+        if peer is None:
+            raise StateSyncError("no peers to fetch chunks from")
+        peer.try_send(CHUNK_CHANNEL, ChunkRequest(
+            snapshot.height, snapshot.format, index))
+        import time as _t
+        deadline = _t.monotonic() + CHUNK_TIMEOUT_S
+        while True:
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                raise StateSyncError(f"chunk {index} timed out")
+            try:
+                msg, sender = self._chunks.get(timeout=remaining)
+            except queue.Empty:
+                raise StateSyncError(f"chunk {index} timed out")
+            if (msg.height, msg.format, msg.index) == (
+                    snapshot.height, snapshot.format, index):
+                if msg.missing:
+                    raise StateSyncError(f"peer lacks chunk {index}")
+                return msg.chunk, sender

@@ -1,0 +1,119 @@
+"""State sync syncer (reference statesync/syncer.go:141): discover
+snapshots from peers, offer to the app, fetch + apply chunks, verify the
+restored app hash against a light-client-verified header, and bootstrap
+consensus state at the snapshot height."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.state.state import State
+
+from .stateprovider import StateProvider
+
+
+class StateSyncError(Exception):
+    pass
+
+
+class SnapshotRejected(StateSyncError):
+    pass
+
+
+class Syncer:
+    """chunk_fetcher(snapshot, index, sender_hint) -> (bytes, sender_id);
+    in the reactor this requests over p2p, in tests it reads a serving
+    app directly."""
+
+    def __init__(self, app, state_provider: StateProvider,
+                 chunk_fetcher: Callable):
+        self.app = app
+        self.state_provider = state_provider
+        self.chunk_fetcher = chunk_fetcher
+        self._snapshots: List[Tuple[abci.Snapshot, str]] = []
+        self._rejected: set = set()
+        self._lock = threading.Lock()
+
+    # -- discovery ---------------------------------------------------------
+
+    def add_snapshot(self, snapshot: abci.Snapshot, peer_id: str) -> bool:
+        key = (snapshot.height, snapshot.format, snapshot.hash)
+        with self._lock:
+            if key in self._rejected:
+                return False
+            if any((s.height, s.format, s.hash) == key
+                   for s, _ in self._snapshots):
+                return False
+            self._snapshots.append((snapshot, peer_id))
+            return True
+
+    def _best_snapshots(self):
+        with self._lock:
+            return sorted(self._snapshots,
+                          key=lambda sp: (-sp[0].height, -sp[0].format))
+
+    # -- sync (reference syncer.go:141 SyncAny) ----------------------------
+
+    def sync_any(self) -> Tuple[State, "object"]:
+        """Try discovered snapshots best-first.  Returns (bootstrapped
+        state, certifying commit for the snapshot height)."""
+        for snapshot, peer_id in self._best_snapshots():
+            try:
+                return self._sync_one(snapshot, peer_id)
+            except SnapshotRejected:
+                with self._lock:
+                    self._rejected.add(
+                        (snapshot.height, snapshot.format, snapshot.hash))
+                continue
+        raise StateSyncError("no viable snapshots")
+
+    def _sync_one(self, snapshot: abci.Snapshot, peer_id: str):
+        # trusted app hash for the snapshot height comes from the light
+        # client (header H+1 carries the post-H app hash,
+        # reference syncer.go:287 verifyApp).  Bootstrapping height H needs
+        # verified headers up to H+2 — a snapshot taken at the chain head
+        # is rejected until the chain outgrows it.  State/commit are
+        # verified once here and reused after the restore.
+        try:
+            app_hash = self.state_provider.app_hash(snapshot.height)
+            state = self.state_provider.state(snapshot.height)
+            commit = self.state_provider.commit(snapshot.height)
+        except Exception as e:
+            raise SnapshotRejected(
+                f"cannot verify snapshot height {snapshot.height}: {e}")
+        try:
+            resp = self.app.offer_snapshot(snapshot, app_hash)
+            if resp.result != abci.ResponseOfferSnapshot.ACCEPT:
+                raise SnapshotRejected(f"offer result {resp.result}")
+            # fetch + apply chunks in order (reference syncer.go:395)
+            index = 0
+            attempts = 0
+            while index < snapshot.chunks:
+                chunk, sender = self.chunk_fetcher(snapshot, index, peer_id)
+                r = self.app.apply_snapshot_chunk(index, chunk, sender)
+                if r.result == abci.ResponseApplySnapshotChunk.ACCEPT:
+                    index += 1
+                    attempts = 0
+                    continue
+                if r.result == abci.ResponseApplySnapshotChunk.RETRY:
+                    attempts += 1
+                    if attempts > 3:
+                        raise SnapshotRejected("chunk retry limit")
+                    continue
+                raise SnapshotRejected(f"apply result {r.result}")
+            # verify the restored app (reference syncer.go:544 verifyApp)
+            info = self.app.info(abci.RequestInfo())
+        except SnapshotRejected:
+            raise
+        except Exception as e:
+            # app/fetch blew up on peer-shaped data: this snapshot is bad,
+            # not the whole sync
+            raise SnapshotRejected(f"restore failed: {e}")
+        if info.last_block_height != snapshot.height:
+            raise SnapshotRejected(
+                f"app restored to height {info.last_block_height}, "
+                f"wanted {snapshot.height}")
+        if info.last_block_app_hash != app_hash:
+            raise SnapshotRejected("restored app hash mismatch")
+        return state, commit

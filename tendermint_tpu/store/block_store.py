@@ -89,6 +89,11 @@ class BlockStore:
             self.db.write_batch(sets)
             self._base, self._height = new_base, height
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """Persist a certifying commit without its block — the statesync
+        bootstrap anchor (reference store/store.go:415 SaveSeenCommit)."""
+        self.db.set(_seen_commit_key(height), safe_codec.dumps(commit))
+
     # -- load (reference store/store.go:93-246) ----------------------------
 
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:

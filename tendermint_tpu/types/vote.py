@@ -69,10 +69,15 @@ class Vote:
             signature=pd.get_bytes(f, 8))
 
     def verify(self, chain_id: str, pub_key) -> bool:
-        """Single-vote verification (reference types/vote.go:147); the
-        batched path goes through VoteSet -> crypto.batch instead."""
-        return pub_key.verify_signature(self.sign_bytes(chain_id),
-                                        self.signature)
+        """Single-vote verification (reference types/vote.go:147).  Checks
+        the verified-signature cache first: when the consensus receive loop
+        has already batch-verified this vote in a coalesced launch, this is
+        a hash lookup, not a signature check."""
+        from tendermint_tpu.crypto.batch import verified_sigs
+        msg = self.sign_bytes(chain_id)
+        if verified_sigs.hit(pub_key.bytes(), msg, self.signature):
+            return True
+        return pub_key.verify_signature(msg, self.signature)
 
     def validate_basic(self):
         if self.type not in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):

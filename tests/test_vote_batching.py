@@ -1,0 +1,81 @@
+"""Live-vote coalescing window (SURVEY §7 hard part 2 / VERDICT r1 #7):
+votes queued at the consensus boundary are signature-verified in one
+batched launch; the in-order apply then hits the verified-signature cache
+instead of verifying serially."""
+from __future__ import annotations
+
+import pytest
+
+from helpers import Node, make_genesis
+from tendermint_tpu.consensus.round_types import VoteMessage
+from tendermint_tpu.crypto import batch as cbatch
+from tendermint_tpu.types.basic import (BlockID, PartSetHeader,
+                                        SignedMsgType, Timestamp)
+from tendermint_tpu.types.vote import Vote
+
+N_VALS = 150
+
+
+def _signed_prevotes(gdoc, privs, state, height, round_=0):
+    bid = BlockID(hash=bytes([5] * 32),
+                  part_set_header=PartSetHeader(1, bytes([6] * 32)))
+    votes = []
+    vals = state.validators
+    by_addr = {p.pub_key().address(): p for p in privs}
+    for idx in range(vals.size()):
+        addr, val = vals.get_by_index(idx)
+        v = Vote(type=SignedMsgType.PREVOTE, height=height, round=round_,
+                 block_id=bid, timestamp=Timestamp(1700000100, idx),
+                 validator_address=addr, validator_index=idx)
+        v.signature = by_addr[addr].sign(v.sign_bytes(gdoc.chain_id))
+        votes.append(v)
+    return votes
+
+
+def test_vote_storm_rides_the_batch_path():
+    gdoc, privs = make_genesis(N_VALS)
+    node = Node(gdoc, privs[0])
+    cs = node.cs
+    state = cs.state
+    votes = _signed_prevotes(gdoc, privs, state, height=cs.rs.height)
+
+    batch = [(VoteMessage(v), f"peer{i}") for i, v in enumerate(votes)]
+    h0, m0 = cbatch.verified_sigs.hits, cbatch.verified_sigs.misses
+    cs._preverify_votes(batch)
+    with cs._mtx:
+        for msg, peer_id in batch:
+            cs._apply_msg(msg, peer_id)
+
+    # every vote landed
+    prevotes = cs.rs.votes.prevotes(cs.rs.round)
+    assert prevotes.has_two_thirds_majority()
+    assert sum(1 for v in prevotes.votes if v is not None) == N_VALS
+
+    # >90% of the serial verifies were cache hits from the one batch launch
+    hits = cbatch.verified_sigs.hits - h0
+    misses = cbatch.verified_sigs.misses - m0
+    # misses include the batch's own pre-insertion lookups; only the apply
+    # phase counts hits, one per vote
+    assert hits >= 0.9 * N_VALS, (hits, misses)
+
+
+def test_invalid_vote_in_storm_still_rejected():
+    gdoc, privs = make_genesis(8)
+    node = Node(gdoc, privs[0])
+    cs = node.cs
+    votes = _signed_prevotes(gdoc, privs, cs.state, height=cs.rs.height)
+    bad = votes[3]
+    bad.signature = bytes([bad.signature[0] ^ 1]) + bad.signature[1:]
+    batch = [(VoteMessage(v), "p") for v in votes]
+    cs._preverify_votes(batch)
+    applied = 0
+    with cs._mtx:
+        for msg, peer_id in batch:
+            try:
+                cs._apply_msg(msg, peer_id)
+                applied += 1
+            except Exception:
+                pass
+    prevotes = cs.rs.votes.prevotes(cs.rs.round)
+    present = [i for i, v in enumerate(prevotes.votes) if v is not None]
+    assert 3 not in present and len(present) == 7
