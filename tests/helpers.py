@@ -46,20 +46,27 @@ class Node:
     def __init__(self, gdoc: GenesisDoc, priv: Optional[edkeys.PrivKey],
                  name: str = "", wal_path: Optional[str] = None,
                  config=None):
+        from tendermint_tpu.evidence import EvidencePool
+
         self.app = KVStoreApplication()
         self.mempool = Mempool(self.app)
         self.state_store = StateStore(MemDB())
         self.block_store = BlockStore(MemDB())
         self.event_bus = EventBus()
+        state = state_from_genesis(gdoc)
+        self.state_store.save(state)  # before EvidencePool: it caches state
+        self.evidence_pool = EvidencePool(MemDB(), self.state_store,
+                                          self.block_store)
         self.exec = BlockExecutor(self.state_store, self.app,
                                   mempool=self.mempool,
+                                  evidence_pool=self.evidence_pool,
                                   event_bus=self.event_bus)
-        state = state_from_genesis(gdoc)
         self.pv = FilePV(priv) if priv is not None else None
         self.cs = ConsensusState(
             config or test_config(), state, self.exec, self.block_store,
             mempool=self.mempool, priv_validator=self.pv,
-            wal_path=wal_path, event_bus=self.event_bus, name=name)
+            wal_path=wal_path, event_bus=self.event_bus, name=name,
+            evidence_pool=self.evidence_pool)
         self.mempool.on_new_tx(self.cs.notify_txs_available)
 
     def start(self):

