@@ -166,6 +166,28 @@ def cmd_version(args):
     print(__version__)
 
 
+def cmd_remote_signer(args):
+    """Run this home dir's FilePV as a remote signer process that dials
+    the node's priv_validator_laddr (reference privval signer harness /
+    tmkms topology)."""
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.privval.signer import SignerServer
+
+    cfg = Config.load(_home(args))
+    cfg.home = _home(args)
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    srv = SignerServer(pv, args.node_addr, max_dial_retries=10 ** 9)
+    srv.start()
+    print(f"remote signer for {pv.get_pub_key().address().hex()} "
+          f"dialing {args.node_addr}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
 def cmd_abci_kvstore(args):
     """Run the example kvstore as a standalone ABCI server process
     (reference abci/cmd/abci-cli kvstore)."""
@@ -215,6 +237,12 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_unsafe_reset_all)
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)
+    sp = sub.add_parser("remote-signer",
+                        help="serve this home's validator key to a node")
+    sp.add_argument("--node-addr", required=True,
+                    help="the node's priv_validator_laddr to dial")
+    sp.set_defaults(fn=cmd_remote_signer)
+
     sp = sub.add_parser("abci-kvstore",
                         help="run the kvstore app as an ABCI server")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")

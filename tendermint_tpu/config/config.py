@@ -18,6 +18,8 @@ class P2PConfig:
     laddr: str = "127.0.0.1:26656"
     persistent_peers: str = ""  # comma-separated id@host:port
     max_num_peers: int = 50
+    pex: bool = True            # run the PEX reactor / addr book
+    seeds: str = ""             # comma-separated id@host:port to crawl
 
 
 @dataclass
@@ -49,6 +51,10 @@ class BatchVerifierConfig:
 class Config:
     home: str = ""
     moniker: str = "node"
+    # if set ("unix:///..." or "tcp://host:port"), the node listens here
+    # and uses the remote signer that dials in instead of the file PV
+    # (reference config.go PrivValidatorListenAddr)
+    priv_validator_laddr: str = ""
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
@@ -80,6 +86,9 @@ class Config:
     def wal_file(self) -> str:
         return os.path.join(self.data_dir(), "cs.wal")
 
+    def addr_book_file(self) -> str:
+        return os.path.join(self.config_dir(), "addrbook.json")
+
     def block_db_file(self) -> str:
         return os.path.join(self.data_dir(), "blockstore.db")
 
@@ -97,11 +106,14 @@ class Config:
         c = self.consensus
         text = f"""# tendermint_tpu node configuration
 moniker = "{self.moniker}"
+priv_validator_laddr = "{self.priv_validator_laddr}"
 
 [p2p]
 laddr = "{self.p2p.laddr}"
 persistent_peers = "{self.p2p.persistent_peers}"
 max_num_peers = {self.p2p.max_num_peers}
+pex = {str(self.p2p.pex).lower()}
+seeds = "{self.p2p.seeds}"
 
 [mempool]
 size = {self.mempool.size}
@@ -143,11 +155,14 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
         with open(path, "rb") as f:
             d = tomllib.load(f)
         cfg.moniker = d.get("moniker", cfg.moniker)
+        cfg.priv_validator_laddr = d.get("priv_validator_laddr", "")
         p = d.get("p2p", {})
         cfg.p2p = P2PConfig(
             laddr=p.get("laddr", cfg.p2p.laddr),
             persistent_peers=p.get("persistent_peers", ""),
-            max_num_peers=p.get("max_num_peers", 50))
+            max_num_peers=p.get("max_num_peers", 50),
+            pex=p.get("pex", True),
+            seeds=p.get("seeds", ""))
         m = d.get("mempool", {})
         cfg.mempool = MempoolConfig(
             size=m.get("size", 5000), cache_size=m.get("cache_size", 10000),

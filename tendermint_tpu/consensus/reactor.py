@@ -19,6 +19,7 @@ from tendermint_tpu.libs.safe_codec import loads, register
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.basic import SignedMsgType
+from tendermint_tpu.types.vote import Vote
 
 from .round_types import Step
 from .state import ConsensusState
@@ -62,6 +63,7 @@ class ConsensusReactor(Reactor):
         super().__init__("CONSENSUS")
         self.cs = cs
         self._peer_state: Dict[str, NewRoundStepMessage] = {}
+        self._catchup_sent: Dict[str, tuple] = {}  # peer -> (height, time)
         self._lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -124,6 +126,7 @@ class ConsensusReactor(Reactor):
     def remove_peer(self, peer: Peer, reason):
         with self._lock:
             self._peer_state.pop(peer.id, None)
+            self._catchup_sent.pop(peer.id, None)
 
     # -- inbound -----------------------------------------------------------
 
@@ -142,6 +145,60 @@ class ConsensusReactor(Reactor):
         elif ch_id == VOTE_CHANNEL:
             if isinstance(msg, VoteGossip):
                 self.cs.add_vote(msg.vote, peer_id=peer.id)
+
+    # -- store-backed catch-up for peers behind our height -----------------
+
+    CATCHUP_HEIGHTS_PER_TICK = 8
+
+    CATCHUP_RESEND_S = 1.0
+
+    def _serve_catchup(self, peer: Peer, peer_height: int):
+        """Send the peer everything it needs to commit heights
+        [peer_height, peer_height + window): the certifying precommits
+        (reconstructed from the stored Commit; signature order IS
+        validator-set order at that height, so positional indices are
+        valid on both ends) and the stored block parts.
+
+        Throttled per peer: a window is re-sent only once the peer's
+        reported height advances past the last window start, or after
+        CATCHUP_RESEND_S (covers try_send drops) — otherwise the 0.1 s
+        tick would re-read and re-queue megabytes per tick."""
+        store = self.cs.block_store
+        if store is None:
+            return
+        last = self._catchup_sent.get(peer.id)
+        now = time.monotonic()
+        if last is not None and peer_height <= last[0] \
+                and now - last[1] < self.CATCHUP_RESEND_S:
+            return
+        self._catchup_sent[peer.id] = (peer_height, now)
+        base = store.base()
+        top = store.height()
+        for h in range(peer_height,
+                       min(peer_height + self.CATCHUP_HEIGHTS_PER_TICK,
+                           top + 1)):
+            if h < base:
+                return  # pruned away; blocksync from another peer
+            commit = store.load_block_commit(h) or store.load_seen_commit(h)
+            if commit is None:
+                return
+            for i, sig in enumerate(commit.signatures):
+                if not sig.for_block():
+                    continue
+                v = Vote(type=SignedMsgType.PRECOMMIT, height=h,
+                         round=commit.round, block_id=commit.block_id,
+                         timestamp=sig.timestamp,
+                         validator_address=sig.validator_address,
+                         validator_index=i, signature=sig.signature)
+                peer.try_send(VOTE_CHANNEL, VoteGossip(v))
+            meta = store.load_block_meta(h)
+            if meta is None:
+                return
+            for i in range(meta.block_id.part_set_header.total):
+                part = store.load_block_part(h, i)
+                if part is not None:
+                    peer.try_send(DATA_CHANNEL,
+                                  BlockPartGossip(h, commit.round, part))
 
     # -- catch-up gossip (simplified gossipVotesRoutine) -------------------
 
@@ -167,7 +224,22 @@ class ConsensusReactor(Reactor):
                 precommits = list(votes.precommits(round_).votes)
             for pid, ps in peer_states.items():
                 peer = self.switch.peers.get(pid)
-                if peer is None or ps.height != height:
+                if peer is None:
+                    continue
+                if ps.height < height:
+                    # peer fell behind consensus while we're past its
+                    # height: serve the decided block from the store —
+                    # stored-commit precommits first (so the peer's
+                    # enterCommit builds the PartSet from the commit's
+                    # BlockID), then the parts (reference
+                    # consensus/reactor.go gossipDataForCatchup + the
+                    # LoadBlockCommit branch of gossipVotesRoutine).
+                    try:
+                        self._serve_catchup(peer, ps.height)
+                    except Exception:  # noqa: BLE001 - keep routine alive
+                        pass
+                    continue
+                if ps.height != height:
                     continue
                 # re-send current-round votes the peer may be missing
                 candidates = [v for v in prevotes + precommits

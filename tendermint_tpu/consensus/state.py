@@ -486,7 +486,10 @@ class ConsensusState:
                             pol_round=rs.valid_round, block_id=block_id,
                             timestamp=Timestamp.now())
         try:
-            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+            # use the returned message: a remote signer (SignerClient)
+            # hands back a signed COPY, not the mutated original
+            proposal = self.priv_validator.sign_proposal(
+                self.state.chain_id, proposal)
         except Exception:
             return
         # send to ourselves via internal queue, then gossip
@@ -903,7 +906,7 @@ class ConsensusState:
             timestamp=self._vote_time(),
             validator_address=addr, validator_index=idx)
         try:
-            self.priv_validator.sign_vote(self.state.chain_id, vote)
+            vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
         except Exception:
             return
         self._internal_queue.put((VoteMessage(vote), ""))

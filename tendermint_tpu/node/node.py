@@ -129,9 +129,12 @@ class Node:
                                self.state_store,
                                self.block_store, self.genesis)
 
-        # -- privval (node.go:808-826) ---------------------------------
-        self.priv_validator: Optional[FilePV] = None
-        if os.path.exists(cfg.priv_validator_key_file()):
+        # -- privval (node.go:808-826; remote signer node.go:591) ------
+        self.priv_validator = None
+        if cfg.priv_validator_laddr:
+            from tendermint_tpu.privval.signer import SignerClient
+            self.priv_validator = SignerClient(cfg.priv_validator_laddr)
+        elif os.path.exists(cfg.priv_validator_key_file()):
             self.priv_validator = FilePV.load_or_generate(
                 cfg.priv_validator_key_file(),
                 cfg.priv_validator_state_file())
@@ -182,6 +185,16 @@ class Node:
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+        # PEX + addr book (node.go:908 createPEXReactorAndAddToSwitch)
+        self.pex_reactor = None
+        if cfg.p2p.pex:
+            from tendermint_tpu.p2p.pex import AddrBook, PexReactor
+            book = AddrBook(None if in_memory else cfg.addr_book_file(),
+                            our_ids=(self.node_key.node_id,))
+            self.pex_reactor = PexReactor(
+                book, target_out_peers=max(2, cfg.p2p.max_num_peers // 5),
+                seeds=cfg.p2p.seeds)
+            self.switch.add_reactor("PEX", self.pex_reactor)
 
         # -- RPC (node.go:996 StartRPC) --------------------------------
         self.rpc_server = None
@@ -213,6 +226,8 @@ class Node:
                            self.config.p2p.persistent_peers.split(",")):
             self.switch.dial_peer(addr.strip(), persistent=True)
         self.evidence_reactor.start()
+        if self.pex_reactor is not None:
+            self.pex_reactor.start()
         if self.blocksync_reactor.fast_sync:
             self.blocksync_reactor.start()
         else:
@@ -239,8 +254,12 @@ class Node:
         self.blocksync_reactor.stop()
         self.consensus_reactor.stop()
         self.evidence_reactor.stop()
+        if self.pex_reactor is not None:
+            self.pex_reactor.stop()
         if self._consensus_started.is_set():
             self.consensus.stop()
+        if hasattr(self.priv_validator, "close"):
+            self.priv_validator.close()
         self.switch.stop()
         self.app_conns.stop()  # last: consensus/mempool use these
 

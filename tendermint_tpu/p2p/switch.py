@@ -266,9 +266,12 @@ class Switch:
         peer_box[0] = peer
         with self._lock:
             self.peers[peer.id] = peer
-        mconn.start()
+        # introduce the peer to every reactor BEFORE the recv thread can
+        # dispatch its messages (sends queue until mconn.start drains
+        # them), so no reactor ever receives from an unknown peer
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
+        mconn.start()
         return peer
 
     # -- peer management ---------------------------------------------------

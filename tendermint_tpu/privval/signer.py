@@ -1,0 +1,312 @@
+"""Remote signer: the validator key lives in a separate process
+(reference privval/signer_client.go:94, signer_server.go,
+signer_listener_endpoint.go, signer_dialer_endpoint.go).
+
+Topology matches the reference: the NODE listens on
+`priv_validator_laddr`; the SIGNER process dials in and then serves
+signing requests over the single connection.
+
+  node side:   SignerListener (accepts) + SignerClient (PrivValidator
+               interface: get_pub_key / sign_vote / sign_proposal)
+  signer side: SignerServer (dials, loops: read request -> ask the
+               wrapped FilePV -> respond)
+
+Framing: 4-byte big-endian length + allowlisted-codec payload — the same
+trusted-local-channel convention as the ABCI socket (abci/server.py).
+Double-sign protection stays with the key: the remote FilePV enforces its
+HRS monotonicity and the refusal travels back as a RemoteSignerError.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.libs import safe_codec
+from tendermint_tpu.libs.safe_codec import register
+
+from tendermint_tpu.abci.server import parse_addr
+
+
+@register
+@dataclass
+class PingRequest:
+    pass
+
+
+@register
+@dataclass
+class PingResponse:
+    pass
+
+
+@register
+@dataclass
+class PubKeyRequest:
+    chain_id: str = ""
+
+
+@register
+@dataclass
+class PubKeyResponse:
+    key_type: str = ""
+    key_bytes: bytes = b""
+    error: str = ""
+
+
+@register
+@dataclass
+class SignVoteRequest:
+    chain_id: str
+    vote: object
+
+
+@register
+@dataclass
+class SignedVoteResponse:
+    vote: object = None
+    error: str = ""
+
+
+@register
+@dataclass
+class SignProposalRequest:
+    chain_id: str
+    proposal: object
+
+
+@register
+@dataclass
+class SignedProposalResponse:
+    proposal: object = None
+    error: str = ""
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _read_frame(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    if n > 16 * 1024 * 1024:
+        raise ConnectionError("privval frame too large")
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return safe_codec.loads(body)
+
+
+def _write_frame(sock: socket.socket, obj):
+    data = safe_codec.dumps(obj)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+# ---------------------------------------------------------------------------
+# node side
+# ---------------------------------------------------------------------------
+
+class SignerClient:
+    """PrivValidator backed by a remote signer connection (reference
+    privval/signer_client.go).  Blocks on start until the signer dials
+    in; requests are serialized over the one connection."""
+
+    def __init__(self, laddr: str, timeout_s: float = 5.0,
+                 accept_timeout_s: float = 30.0):
+        self.laddr = laddr
+        self.timeout_s = timeout_s
+        kind, target = parse_addr(laddr)
+        if kind == "unix":
+            import os
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX)
+            self._listener.bind(target)
+        else:
+            self._listener = socket.create_server(target)
+        self._listener.listen(1)
+        self._listener.settimeout(accept_timeout_s)
+        self._sock: Optional[socket.socket] = None
+        self._mtx = threading.Lock()
+        self._closed = False
+
+    # -- connection management (signer_listener_endpoint.go) ---------------
+
+    def _ensure_conn(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        sock, _ = self._listener.accept()
+        sock.settimeout(self.timeout_s)
+        self._sock = sock
+        return sock
+
+    def _call(self, req):
+        with self._mtx:
+            if self._closed:
+                raise RemoteSignerError("signer client closed")
+            try:
+                sock = self._ensure_conn()
+                _write_frame(sock, req)
+                resp = _read_frame(sock)
+            except (OSError, ConnectionError, socket.timeout) as e:
+                # drop the connection; the signer will redial
+                self._drop()
+                raise RemoteSignerError(f"remote signer io: {e}") from e
+            if resp is None:
+                self._drop()
+                raise RemoteSignerError("remote signer closed connection")
+            return resp
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        """Deliberately does NOT take _mtx: a _call may be blocked up to
+        accept_timeout_s in listener.accept(); closing the sockets from
+        here makes that accept/recv raise OSError immediately, so both
+        close() and the blocked call return promptly."""
+        self._closed = True
+        self._listener.close()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- PrivValidator interface -------------------------------------------
+
+    def ping(self) -> bool:
+        return isinstance(self._call(PingRequest()), PingResponse)
+
+    def get_pub_key(self):
+        resp = self._call(PubKeyRequest())
+        if not isinstance(resp, PubKeyResponse) or resp.error:
+            raise RemoteSignerError(getattr(resp, "error", "bad response"))
+        from tendermint_tpu.crypto import pubkey_from_type_name
+        return pubkey_from_type_name(resp.key_type, resp.key_bytes)
+
+    def sign_vote(self, chain_id: str, vote):
+        resp = self._call(SignVoteRequest(chain_id, vote))
+        if not isinstance(resp, SignedVoteResponse):
+            raise RemoteSignerError("bad sign_vote response")
+        if resp.error:
+            raise RemoteSignerError(resp.error)
+        return resp.vote
+
+    def sign_proposal(self, chain_id: str, proposal):
+        resp = self._call(SignProposalRequest(chain_id, proposal))
+        if not isinstance(resp, SignedProposalResponse):
+            raise RemoteSignerError("bad sign_proposal response")
+        if resp.error:
+            raise RemoteSignerError(resp.error)
+        return resp.proposal
+
+
+# ---------------------------------------------------------------------------
+# signer side
+# ---------------------------------------------------------------------------
+
+class SignerServer:
+    """Wraps a local PrivValidator (FilePV) and serves it to a node
+    (reference privval/signer_server.go + signer_dialer_endpoint.go:
+    dial the node's listener, serve, redial with backoff on error)."""
+
+    def __init__(self, pv, node_addr: str, retry_wait_s: float = 0.2,
+                 max_dial_retries: int = 100):
+        self.pv = pv
+        self.node_addr = node_addr
+        self.retry_wait_s = retry_wait_s
+        self.max_dial_retries = max_dial_retries
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="signer-server")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _dial(self) -> Optional[socket.socket]:
+        kind, target = parse_addr(self.node_addr)
+        for _ in range(self.max_dial_retries):
+            if self._stop.is_set():
+                return None
+            try:
+                if kind == "unix":
+                    s = socket.socket(socket.AF_UNIX)
+                    s.connect(target)
+                else:
+                    s = socket.create_connection(target, timeout=5)
+                s.settimeout(None)
+                return s
+            except OSError:
+                time.sleep(self.retry_wait_s)
+        return None
+
+    def _run(self):
+        while not self._stop.is_set():
+            sock = self._dial()
+            if sock is None:
+                return
+            try:
+                self._serve(sock)
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _serve(self, sock: socket.socket):
+        while not self._stop.is_set():
+            req = _read_frame(sock)
+            if req is None:
+                return
+            _write_frame(sock, self._handle(req))
+
+    def _handle(self, req):
+        """Reference privval/signer_server.go:?? HandleRequest: double-sign
+        refusals travel back as error strings, not connection failures."""
+        try:
+            if isinstance(req, PingRequest):
+                return PingResponse()
+            if isinstance(req, PubKeyRequest):
+                pub = self.pv.get_pub_key()
+                return PubKeyResponse(key_type=pub.type_name,
+                                      key_bytes=pub.bytes())
+            if isinstance(req, SignVoteRequest):
+                return SignedVoteResponse(
+                    vote=self.pv.sign_vote(req.chain_id, req.vote))
+            if isinstance(req, SignProposalRequest):
+                return SignedProposalResponse(
+                    proposal=self.pv.sign_proposal(req.chain_id,
+                                                   req.proposal))
+            return PubKeyResponse(error=f"unknown request {type(req).__name__}")
+        except Exception as e:  # noqa: BLE001 - refusal -> error response
+            if isinstance(req, SignVoteRequest):
+                return SignedVoteResponse(error=str(e))
+            if isinstance(req, SignProposalRequest):
+                return SignedProposalResponse(error=str(e))
+            return PubKeyResponse(error=str(e))

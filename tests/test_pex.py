@@ -1,0 +1,159 @@
+"""PEX address book + reactor (reference p2p/pex/addrbook_test.go,
+pex_reactor_test.go intent): bucket bookkeeping, selection, persistence,
+and socket-level address discovery -> dial."""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.pex import (AddrBook, KnownAddress, PexReactor,
+                                    MAX_GET_SELECTION)
+from tendermint_tpu.p2p.switch import Switch
+
+
+def _nid(i: int) -> str:
+    return f"{i:040x}"
+
+
+def test_addrbook_add_pick_good_bad():
+    book = AddrBook()
+    assert book.is_empty()
+    for i in range(100):
+        assert book.add_address(_nid(i), f"10.0.{i}.1:26656",
+                                src_id=_nid(1000 + i % 3))
+    assert book.size() == 100
+
+    ka = book.pick_address(new_bias_pct=100)
+    assert ka is not None and not ka.is_old()
+
+    # promotion to old on mark_good
+    book.mark_good(_nid(7))
+    assert book._addrs[_nid(7)].is_old()
+    # old addresses survive pick with bias 0
+    ka = book.pick_address(new_bias_pct=0)
+    assert ka.is_old()
+
+    # repeated failed attempts with no success evict
+    for _ in range(4):
+        book.mark_attempt(_nid(8))
+    assert not book.has(_nid(8))
+    # but a proven-good address survives attempts
+    for _ in range(4):
+        book.mark_attempt(_nid(7))
+    assert book.has(_nid(7))
+
+    # our own id never enters
+    book.add_our_id(_nid(42))
+    assert not book.has(_nid(42))
+    assert not book.add_address(_nid(42), "1.2.3.4:1")
+
+
+def test_addrbook_selection_and_ban():
+    book = AddrBook()
+    for i in range(500):
+        # diverse /16 groups so group-bucket eviction doesn't kick in
+        book.add_address(_nid(i), f"{10 + i % 100}.{i % 250}.0.1:26656")
+    assert book.size() == 500
+    sel = book.get_selection()
+    # 23% of 500 = 115, within [32, 250]
+    assert 32 <= len(sel) <= MAX_GET_SELECTION
+    assert len(sel) == 115
+    assert len({nid for nid, _ in sel}) == len(sel)
+
+    # one group cannot own the table: same-/16 flood tops out at the
+    # per-group bucket capacity instead of growing unboundedly
+    flood = AddrBook()
+    for i in range(500):
+        flood.add_address(_nid(1000 + i), f"10.0.{i % 250}.1:26656")
+    assert flood.size() < 200
+
+    book.mark_bad(_nid(3))
+    assert not book.has(_nid(3))
+    assert book.is_banned(_nid(3))
+
+
+def test_addrbook_persistence_roundtrip():
+    tmp = os.path.join(tempfile.mkdtemp(prefix="tm_pex_"), "addrbook.json")
+    book = AddrBook(tmp)
+    for i in range(40):
+        book.add_address(_nid(i), f"10.0.{i}.1:26656")
+    book.mark_good(_nid(5))
+    book.save()
+
+    book2 = AddrBook(tmp)
+    assert book2.size() == 40
+    assert book2._addrs[_nid(5)].is_old()
+    # bucket membership was rebuilt
+    assert any(_nid(5) in b for b in book2._old)
+
+
+def _mk_switch(i: int, reactor: PexReactor) -> Switch:
+    sw = Switch(NodeKey.generate(), "127.0.0.1:0", network="pex-chain",
+                moniker=f"pex{i}")
+    sw.add_reactor("PEX", reactor)
+    reactor.book.add_our_id(sw.node_key.node_id)
+    sw.start()
+    reactor.start()
+    return sw
+
+
+def test_pex_discovery_over_sockets():
+    """A knows only B; C is connected to B.  A must learn C's address via
+    a PEX exchange with B and dial it."""
+    books = [AddrBook() for _ in range(3)]
+    reactors = [PexReactor(books[i], ensure_period_s=0.5,
+                           target_out_peers=4) for i in range(3)]
+    switches = [_mk_switch(i, reactors[i]) for i in range(3)]
+    try:
+        addr = [sw.actual_listen_addr() for sw in switches]
+        nid = [sw.node_key.node_id for sw in switches]
+        # C dials B and registers its own listen addr so B can share it
+        assert switches[2].dial_peer(f"{nid[1]}@{addr[1]}") is not None
+        # B's book learns C (add_peer hook uses NodeInfo.listen_addr,
+        # which for an inbound peer is its *listener*, not the ephemeral
+        # socket) — fix it up directly to the routable one for the test
+        books[1].add_address(nid[2], addr[2], src_id=nid[2])
+        # A dials B; discovery must pull C's address into A's book and
+        # the ensure-peers routine must then dial C
+        assert switches[0].dial_peer(f"{nid[1]}@{addr[1]}") is not None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if nid[2] in switches[0].peers:
+                break
+            time.sleep(0.1)
+        assert books[0].has(nid[2]), "A never learned C's address"
+        assert nid[2] in switches[0].peers, "A never dialed C"
+    finally:
+        for sw in switches:
+            sw.stop()
+
+
+def test_pex_request_flood_disconnects():
+    """More than one PexRequest per ensure period -> peer dropped and
+    banned (reference pex_reactor.go:83 receiveRequest flood guard)."""
+    books = [AddrBook(), AddrBook()]
+    reactors = [PexReactor(books[0], ensure_period_s=30.0),
+                PexReactor(books[1], ensure_period_s=30.0)]
+    switches = [_mk_switch(i, reactors[i]) for i in range(2)]
+    try:
+        addr1 = switches[1].actual_listen_addr()
+        nid1 = switches[1].node_key.node_id
+        peer = switches[0].dial_peer(f"{nid1}@{addr1}")
+        assert peer is not None
+        # first request is fine (add_peer may already have sent one —
+        # send two more, fast, to trip the guard regardless)
+        from tendermint_tpu.p2p.pex import PEX_CHANNEL, PexRequest
+        peer.send(PEX_CHANNEL, PexRequest())
+        peer.send(PEX_CHANNEL, PexRequest())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if switches[1].num_peers() == 0:
+                break
+            time.sleep(0.1)
+        assert switches[1].num_peers() == 0, "flooding peer not dropped"
+        assert books[1].is_banned(switches[0].node_key.node_id)
+    finally:
+        for sw in switches:
+            sw.stop()

@@ -1,0 +1,132 @@
+"""Remote signer over a socket (reference privval/signer_client_test.go
+intent): pubkey fetch, vote/proposal signing, the HRS double-sign guard
+refusing REMOTELY, and signer redial after a connection drop."""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.privval.signer import (RemoteSignerError, SignerClient,
+                                           SignerServer)
+from tendermint_tpu.types.basic import (BlockID, PartSetHeader,
+                                        SignedMsgType, Timestamp)
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "signer-chain"
+
+
+def _mk_vote(h, r, blk=b"\x11" * 32):
+    return Vote(type=SignedMsgType.PREVOTE, height=h, round=r,
+                block_id=BlockID(hash=blk,
+                                 part_set_header=PartSetHeader(1, b"\x22" * 32)),
+                timestamp=Timestamp.now(), validator_address=b"\x00" * 20,
+                validator_index=0)
+
+
+def _pair(tmp):
+    pv = FilePV(edkeys.PrivKey.generate())
+    addr = f"unix://{os.path.join(tmp, 'pv.sock')}"
+    client = SignerClient(addr, timeout_s=5.0)
+    server = SignerServer(pv, addr)
+    server.start()
+    return pv, client, server
+
+
+def test_remote_sign_and_double_sign_guard():
+    tmp = tempfile.mkdtemp(prefix="tm_signer_")
+    pv, client, server = _pair(tmp)
+    try:
+        assert client.ping()
+        assert client.get_pub_key() == pv.get_pub_key()
+
+        v = _mk_vote(3, 0)
+        signed = client.sign_vote(CHAIN, v)
+        assert signed.signature
+        assert pv.get_pub_key().verify_signature(
+            signed.sign_bytes(CHAIN), signed.signature)
+
+        # same HRS, different block -> the REMOTE guard must refuse
+        v2 = _mk_vote(3, 0, blk=b"\x99" * 32)
+        with pytest.raises(RemoteSignerError):
+            client.sign_vote(CHAIN, v2)
+
+        # proposals flow too
+        p = Proposal(height=4, round=0, pol_round=-1,
+                     block_id=BlockID(hash=b"\x33" * 32,
+                                      part_set_header=PartSetHeader(
+                                          1, b"\x44" * 32)),
+                     timestamp=Timestamp.now())
+        sp = client.sign_proposal(CHAIN, p)
+        assert sp.signature
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_node_with_remote_signer_commits_blocks():
+    """A single-validator node whose key lives in a separate SignerServer
+    (priv_validator_laddr) must still propose/commit blocks."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.cmd.__main__ import main as cli_main
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.consensus.config import test_config as fast_config
+    from tendermint_tpu.node import Node
+
+    tmp = tempfile.mkdtemp(prefix="tm_signer_node_")
+    home = os.path.join(tmp, "node0")
+    cli_main(["--home", home, "init", "--chain-id", "rs-chain"])
+    cfg = Config.load(home)
+    cfg.home = home
+    cfg.consensus = fast_config()
+    cfg.rpc.enabled = False
+    cfg.p2p.laddr = "127.0.0.1:0"
+    cfg.priv_validator_laddr = f"unix://{os.path.join(tmp, 'pv.sock')}"
+    cfg.save()
+
+    # the signer process-equivalent: serves the SAME key `init` created
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    server = SignerServer(pv, cfg.priv_validator_laddr)
+    server.start()
+
+    node = Node(Config.load(home), KVStoreApplication())
+    try:
+        node.start()
+        deadline = time.time() + 60
+        while time.time() < deadline and node.block_store.height() < 3:
+            time.sleep(0.2)
+        assert node.block_store.height() >= 3, "no blocks with remote signer"
+    finally:
+        node.stop()
+        server.stop()
+
+
+def test_signer_redials_after_drop():
+    tmp = tempfile.mkdtemp(prefix="tm_signer_")
+    pv, client, server = _pair(tmp)
+    try:
+        assert client.ping()
+        # simulate a node-side connection failure
+        client._drop()
+        # the signer's serve loop notices EOF and redials; the client
+        # accepts the fresh connection on the next call
+        deadline = time.time() + 10
+        ok = False
+        while time.time() < deadline and not ok:
+            try:
+                ok = client.ping()
+            except RemoteSignerError:
+                time.sleep(0.1)
+        assert ok, "signer did not redial"
+        signed = client.sign_vote(CHAIN, _mk_vote(9, 1))
+        assert signed.signature
+    finally:
+        client.close()
+        server.stop()
