@@ -166,12 +166,13 @@ class ConsensusReactor(Reactor):
         store = self.cs.block_store
         if store is None:
             return
-        last = self._catchup_sent.get(peer.id)
         now = time.monotonic()
-        if last is not None and peer_height <= last[0] \
-                and now - last[1] < self.CATCHUP_RESEND_S:
-            return
-        self._catchup_sent[peer.id] = (peer_height, now)
+        with self._lock:  # vs remove_peer: don't resurrect a gone peer's slot
+            last = self._catchup_sent.get(peer.id)
+            if last is not None and peer_height <= last[0] \
+                    and now - last[1] < self.CATCHUP_RESEND_S:
+                return
+            self._catchup_sent[peer.id] = (peer_height, now)
         base = store.base()
         top = store.height()
         for h in range(peer_height,

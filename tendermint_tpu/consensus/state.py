@@ -294,9 +294,20 @@ class ConsensusState:
             self._try_peer_msg(peer_id,
                                lambda: self._set_proposal(msg.proposal))
         elif isinstance(msg, BlockPartMessage):
-            self._try_peer_msg(
-                peer_id,
-                lambda: self._add_proposal_block_part(msg, peer_id))
+            def _add_part_ignoring_stale_round():
+                try:
+                    self._add_proposal_block_part(msg, peer_id)
+                except (VoteSetError, ValueError):
+                    # A part from a different round than the current one can
+                    # legitimately fail the proof check against the current
+                    # round's part-set header (e.g. our own parts from round
+                    # r queued behind a round change).  The reference
+                    # squelches exactly this case (consensus/state.go:837-841
+                    # "received block part from wrong round").
+                    if msg.round != self.rs.round:
+                        return
+                    raise
+            self._try_peer_msg(peer_id, _add_part_ignoring_stale_round)
         elif isinstance(msg, TimeoutInfo):
             self._handle_timeout(msg)
         else:

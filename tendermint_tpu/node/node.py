@@ -138,6 +138,7 @@ class Node:
             self.priv_validator = FilePV.load_or_generate(
                 cfg.priv_validator_key_file(),
                 cfg.priv_validator_state_file())
+        self._pv_addr_cache: Optional[bytes] = None
 
         # -- event bus / mempool / evidence / indexers (node.go:832-860) --
         self.event_bus = EventBus()
@@ -205,6 +206,17 @@ class Node:
         self._started = False
         self._consensus_started = threading.Event()
 
+    def _pv_address(self) -> Optional[bytes]:
+        """Our validator address, cached after the first successful fetch.
+        With a remote signer get_pub_key is a blocking socket round trip;
+        the key is fixed for the node's lifetime, so RPC handlers (/status)
+        must not re-fetch it per request."""
+        if self.priv_validator is None:
+            return None
+        if self._pv_addr_cache is None:
+            self._pv_addr_cache = self.priv_validator.get_pub_key().address()
+        return self._pv_addr_cache
+
     def _only_validator_is_us(self) -> bool:
         """Reference node/node.go:640-652."""
         if self.priv_validator is None:
@@ -212,7 +224,7 @@ class Node:
         if self.state.validators.size() != 1:
             return False
         addr, _ = self.state.validators.get_by_index(0)
-        return addr == self.priv_validator.get_pub_key().address()
+        return addr == self._pv_address()
 
     # -- lifecycle (node.go:938-1001) --------------------------------------
 
@@ -283,7 +295,6 @@ class Node:
                 "catching_up": not self._consensus_started.is_set(),
             },
             "validator_info": {
-                "address": self.priv_validator.get_pub_key().address().hex()
-                if self.priv_validator else "",
+                "address": (self._pv_address() or b"").hex(),
             },
         }

@@ -213,7 +213,12 @@ class AddrBook:
                 for b in ka.buckets:
                     self._old[b].pop(node_id, None)
                 ka.bucket_type = "new"
-                ka.attempts = NUM_RETRIES  # still bad-ish; evicts next fail
+                # still bad-ish; evicts next fail.  last_success must be
+                # cleared or is_bad's NUM_RETRIES branch never fires for a
+                # once-good address and eviction needs MAX_FAILURES more
+                # dead dials.
+                ka.attempts = NUM_RETRIES
+                ka.last_success = 0.0
                 nb = _hash_mod(f"{_group(ka.addr)}|{_group(ka.addr)}|0",
                                NEW_BUCKET_COUNT)
                 ka.buckets = [nb]
@@ -344,24 +349,32 @@ class AddrBook:
         os.replace(tmp, self.file_path)
 
     def _load(self):
+        # A corrupt or version-skewed book must never prevent node startup
+        # (the reference logs and continues with an empty book) — guard the
+        # whole decode, not just the JSON parse.
         try:
             with open(self.file_path) as f:
                 data = json.load(f)
-        except (OSError, ValueError):
+            self._bans = {nid: float(until)
+                          for nid, until in data.get("bans", {}).items()}
+            for d in data.get("addrs", []):
+                ka = KnownAddress.from_dict(d)
+                if ka.node_id in self.our_ids:
+                    continue
+                self._addrs[ka.node_id] = ka
+                table = self._old if ka.is_old() else self._new
+                count = (OLD_BUCKET_COUNT if ka.is_old()
+                         else NEW_BUCKET_COUNT)
+                ka.buckets = [b for b in ka.buckets if 0 <= b < count] or [
+                    _hash_mod(_group(ka.addr), count)]
+                for b in ka.buckets:
+                    table[b][ka.node_id] = ka
+        except (OSError, ValueError, TypeError, KeyError):
+            self._bans = {}
+            self._addrs = {}
+            self._new = [dict() for _ in range(NEW_BUCKET_COUNT)]
+            self._old = [dict() for _ in range(OLD_BUCKET_COUNT)]
             return
-        self._bans = {nid: float(until)
-                      for nid, until in data.get("bans", {}).items()}
-        for d in data.get("addrs", []):
-            ka = KnownAddress.from_dict(d)
-            if ka.node_id in self.our_ids:
-                continue
-            self._addrs[ka.node_id] = ka
-            table = self._old if ka.is_old() else self._new
-            count = (OLD_BUCKET_COUNT if ka.is_old() else NEW_BUCKET_COUNT)
-            ka.buckets = [b for b in ka.buckets if 0 <= b < count] or [
-                _hash_mod(_group(ka.addr), count)]
-            for b in ka.buckets:
-                table[b][ka.node_id] = ka
 
 
 # ---------------------------------------------------------------------------
@@ -509,7 +522,9 @@ class PexReactor(Reactor):
         sw = self.switch
         if sw is None:
             return
-        out = sum(1 for p in sw.peers.values() if p.outbound)
+        with sw._lock:  # snapshot: accept/dial threads mutate sw.peers
+            peer_list = list(sw.peers.values())
+        out = sum(1 for p in peer_list if p.outbound)
         need = self.target_out_peers - out
         if need <= 0:
             return
@@ -528,7 +543,8 @@ class PexReactor(Reactor):
             if peer is not None:
                 self.book.mark_good(peer.id)
                 need -= 1
-        peers = list(sw.peers.values())
+        with sw._lock:
+            peers = list(sw.peers.values())
         if not peers and self.seeds:
             # isolated (empty book OR a book full of dead addresses):
             # crawl a random seed (reactor.go dialSeeds)

@@ -172,3 +172,31 @@ def test_byzantine_peer_messages_do_not_kill_node():
         assert node.cs.is_running()
     finally:
         node.stop()
+
+
+def test_stale_round_own_part_not_fatal():
+    """A block part queued internally for round r that arrives after the
+    node moved to a different round (different part-set header) fails the
+    merkle proof check — that must be squelched, not treated as consensus
+    failure (reference consensus/state.go:837-841 'received block part from
+    wrong round'; regression for the socket-localnet fatality)."""
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.types.part_set import Part
+
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], name="stale")
+    node.start()
+    try:
+        wait_for_height([node], 2, timeout=30)
+        # internal (peer_id="") part for a round the node is not in: the
+        # proof cannot match the current header, but round mismatch makes
+        # it a stale-message drop, not an invariant violation.
+        stale = Part(index=0, bytes_=b"\xAB" * 64,
+                     proof=merkle.Proof(total=1, index=0,
+                                        leaf_hash=b"\x11" * 32, aunts=[]))
+        h = node.cs.rs.height
+        node.cs.add_block_part(h, 99, stale, peer_id="")
+        wait_for_height([node], h + 1, timeout=30)
+        assert node.cs.is_running()
+    finally:
+        node.stop()
