@@ -96,8 +96,8 @@ def identity(batch=()):
 
 def to_cached(p: Ext) -> Cached:
     return Cached(
-        F.carry(p.y + p.x),
-        F.carry(p.y - p.x),
+        F.carry_lazy(p.y + p.x),
+        F.carry_lazy(p.y - p.x),
         p.z,
         F.mul(p.t, _d2),
     )
@@ -130,10 +130,27 @@ def dbl(p: Ext) -> Ext:
     aa = F.sqr(p.x + p.y)                # (X+Y)^2, operand lazy-add: ok
     e = aa - a - b                       # |limb| < 2L + 2^10 (worst operand)
     g = b - a                            # |limb| < L + 2^10
-    f = F.carry(g - c)                   # would reach 3L: carry back to loose
+    f = F.carry_lazy(g - c)              # would reach 3L: carry back to loose
     h = -a - b                           # |limb| < 2L
     # worst mul: e (10240) x h (9216) = 2.12e9 — inside the mul contract
     return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def dbl_no_t(p: Ext) -> Ext:
+    """dbl without the T3 = E*H output multiply.  Valid whenever the next
+    group op is another doubling (dbl ignores the input T); the returned
+    T is the input's T, which callers must not consume.  Saves 1 of the 8
+    field multiplies in a doubling."""
+    a = F.sqr(p.x)
+    b = F.sqr(p.y)
+    zsq = F.sqr(p.z)
+    c = zsq + zsq
+    aa = F.sqr(p.x + p.y)
+    e = aa - a - b
+    g = b - a
+    f = F.carry_lazy(g - c)
+    h = -a - b
+    return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), p.t)
 
 
 def add_cached(p: Ext, q: Cached) -> Ext:
@@ -146,7 +163,7 @@ def add_cached(p: Ext, q: Cached) -> Ext:
     d2 = d + d                           # lazy: |limb| < 2L
     e = a - b                            # |limb| < L + 2^10
     f = d2 - c                           # |limb| < 2L + 2^10
-    g = F.carry(d2 + c)                  # would reach 3L: carry
+    g = F.carry_lazy(d2 + c)             # would reach 3L: carry
     h = a + b                            # |limb| < 2L
     return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
@@ -159,7 +176,7 @@ def madd_niels(p: Ext, q: Niels) -> Ext:
     d2 = p.z + p.z                       # lazy
     e = a - b
     f = d2 - c
-    g = F.carry(d2 + c)
+    g = F.carry_lazy(d2 + c)
     h = a + b
     return Ext(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
@@ -205,25 +222,25 @@ def decompress(y_limbs, sign_bit):
     ("negative zero") is rejected; non-square x^2 is rejected.
     """
     sign_bit = jnp.asarray(sign_bit, dtype=jnp.bool_)
-    y = F.carry(y_limbs)
+    y = F.carry_lazy(y_limbs)
     yy = F.sqr(y)
     one = F.one(yy.shape[1:])
     u = yy - one                         # lazy
-    v = F.carry(F.mul(yy, _d) + one)     # d*y^2 + 1 (carry the lazy add)
+    v = F.carry_lazy(F.mul(yy, _d) + one)  # d*y^2 + 1 (carry the lazy add)
     v3 = F.mul(F.sqr(v), v)
     v7 = F.mul(F.sqr(v3), v)
     uv7 = F.mul(u, v7)
     # x = u * v^3 * (u * v^7)^((p-5)/8)
     x = F.mul(F.mul(u, v3), F.pow_p58(uv7))
     vxx = F.mul(v, F.sqr(x))
-    ok_plus = F.eq(vxx, F.carry(u))          # v*x^2 == u
-    ok_minus = F.eq(vxx, F.carry(-u))        # v*x^2 == -u  -> x *= sqrt(-1)
+    ok_plus = F.eq(vxx, F.carry_lazy(u))     # v*x^2 == u
+    ok_minus = F.eq(vxx, F.carry_lazy(-u))   # v*x^2 == -u  -> x *= sqrt(-1)
     x = F.select(ok_minus, F.mul(x, _sqrt_m1), x)
     ok = ok_plus | ok_minus
     x_is_zero = F.is_zero(x)
     ok = ok & ~(x_is_zero & sign_bit)        # reject "negative zero"
     # match requested sign
-    x = F.select(F.is_neg(x) != sign_bit, F.carry(-x), x)
+    x = F.select(F.is_neg(x) != sign_bit, F.carry_lazy(-x), x)
     t = F.mul(x, y)
     return Ext(x, y, F.one(y.shape[1:]), t), ok
 

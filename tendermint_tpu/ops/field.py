@@ -152,17 +152,28 @@ def carry(c):
     in (-2^10, L), L = 4608 = 2^12 + 2^9.  NOT canonical (freeze does that),
     but tight enough for the ring ops' int32 budget:
       * one lazy add/sub of loose values: |limb| < 2L
-      * schoolbook column sums: 22 * (2L)^2 = 1.87e9, plus the < 4.5e7
-        fold term in _reduce_wide, < 2^31 with ~10% margin.
-    Convergence of the 4 passes (worst case |limb| < 2^31-ish):
-      pass 1: carries <= 2^19 in-limb; 19-fold <= 19*4095 = 78k at limb 0,
-              19*2^16 = 1.2e6 at limb 1
-      pass 2: carries <= 300; fold <= 78k
-      pass 3: carries <= 19;  fold <= 760
-      pass 4: carries <= 1;   fold <= 57     ->  limbs < 4096 + 512
+      * schoolbook column sums: 22 * (2L)^2 = 1.87e9, plus the < 8.2e7
+        fold-first term in _reduce_wide, < 2^31.
+    THREE passes suffice for any int32 input (exact max-abs interval
+    propagation, machine-checked by
+    tests/test_field.py::test_carry_pass_count_proof):
+      pass 1: carries <= 2^19 in-limb; folds <= 19*2048 at limb 0,
+              19*(2^16+) at limb 1              -> limbs < 1.78e6
+      pass 2: carries <= 434; fold <= 19*2048   -> limb0 < 43k, rest loose
+      pass 3: carries <= 11;  fold <= 19*17     -> limbs < 4418 < L
     Bounds are regression-checked (tests/test_field.py::test_carry_bounds).
     """
-    return _carry_pass(_carry_pass(_carry_pass(_carry_pass(c))))
+    return _carry_pass(_carry_pass(_carry_pass(c)))
+
+
+def carry_lazy(c):
+    """carry() for inputs already bounded by |limb| <= 3L + 2^10 = 14848
+    — any three-term sum/difference of loose-carried values (the curve
+    formulas' worst case is g - c = (b - a) - 2*zsq with all four terms
+    loose, e.g. ops/curve.py dbl).  TWO passes suffice (machine-checked
+    alongside the generic proof in
+    tests/test_field.py::test_carry_pass_count_proof)."""
+    return _carry_pass(_carry_pass(c))
 
 
 # ---------------------------------------------------------------------------
@@ -221,16 +232,30 @@ def mul(a, b):
     return _reduce_wide(c)
 
 def _reduce_wide(c):
-    """Reduce a (2N-1, ...) signed coefficient vector (|coeff| < 1.87e9) to
-    loose-carried (N, ...) limbs."""
+    """Reduce a (2N-1, ...) signed coefficient vector (conv columns,
+    |coeff| <= 22 * 10240 * 9216 = 2.08e9) to loose-carried (N, ...) limbs.
+
+    Fold-FIRST: each hi coefficient h (weight 2^264 * 2^(12t) ≡
+    FOLD * 2^(12t)) is split round-to-nearest into three signed 12-bit
+    digits h = h0 + 2^12 h1 + 2^24 h2 and FOLD*h_i is added directly into
+    lo columns t, t+1, t+2 — no intermediate carry chain over the hi half.
+    |h0|,|h1| <= 2048 -> fold terms <= 19.9e6 each; |h2| <= 124 ->
+    <= 1.21e6.  The t = 20 h2 term has weight 2^(12*22) = 2^264 ≡ FOLD
+    again: FOLD^2 * h2[20] <= 9728^2 * 7 = 6.6e8 at limb 0 (conv column 42
+    is a single product, <= 9.4e7).  Exact per-column interval propagation
+    (tests/test_field.py::test_carry_pass_count_proof) bounds every lo
+    column by 2.10e9 < 2^31."""
     lo = c[:NLIMB]
-    hi = c[NLIMB:]
-    # Squeeze the high value H (coefficients of weight 2^264 * 2^(12t)) to
-    # loose limbs first, then fold: H * 2^264 ≡ H * FOLD, and
-    # FOLD * |h limb| <= 9728 * 4608 < 4.5e7 — overflow-safe added to lo.
-    hi_p = jnp.concatenate([hi, jnp.zeros_like(hi[:1])], axis=0)
-    h = carry(hi_p)
-    lo = lo + FOLD * h
+    hi = c[NLIMB:]  # (NLIMB-1, ...) = 21 coefficients, t = 0..20
+    zpad = [(0, 0)] * (c.ndim - 1)
+    h_hi = (hi + (1 << (RADIX - 1))) >> RADIX
+    h0 = hi - (h_hi << RADIX)                      # [-2048, 2047]
+    h2 = (h_hi + (1 << (RADIX - 1))) >> RADIX
+    h1 = h_hi - (h2 << RADIX)                      # [-2048, 2047]
+    lo = lo + FOLD * jnp.pad(h0, [(0, 1)] + zpad)
+    lo = lo + FOLD * jnp.pad(h1, [(1, 0)] + zpad)
+    lo = lo + FOLD * jnp.pad(h2[:-1], [(2, 0)] + zpad)
+    lo = lo.at[0].add((FOLD * FOLD) * h2[-1])
     return carry(lo)
 
 def sqr(a):

@@ -1,0 +1,506 @@
+"""Fused single-pass ed25519 batch-verify Pallas TPU kernel.
+
+Why this exists: the XLA-composed kernel (ops/ed25519.py verify_staged) is
+HBM-bound — every field op in the 64-iteration ladder materializes (22, B)
+int32 intermediates in HBM (~55 GB of traffic per 32k batch, measured via
+cost_analysis).  This kernel runs the ENTIRE verification — point
+decompression, cached-table build, the 64-step joint Straus ladder, final
+encode + compare — inside one pallas_call, tiled over the batch (lane) axis,
+so every intermediate lives in VMEM/vregs.  HBM traffic collapses to the
+compact staged inputs (192 bytes/sig) and a 4-byte result.
+
+Same bit-exact RFC 8032 / Go-crypto semantics as ops/ed25519.verify_impl
+(reference crypto/ed25519/ed25519.go:148, types/validator_set.go:680-702);
+the field/curve algorithms mirror ops/field.py + ops/curve.py with the same
+machine-checked int32 bounds (tests/test_field.py::test_carry_pass_count_proof):
+fold-first wide reduction, 3-pass loose carry, 2-pass lazy carry.
+
+Layout inside the kernel: a field element is (NLIMB=22, T) int32 — limbs on
+sublanes, batch tile T on lanes.  Convolutions accumulate into a (48, T)
+register value via static-shift adds (sublane concat), the only non-
+elementwise op.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import curve as C
+from . import field as F
+
+RADIX = F.RADIX
+NLIMB = F.NLIMB
+MASK = F.MASK
+TOP = 255 - RADIX * (NLIMB - 1)  # 3
+FOLD = F.FOLD
+WIDE = 2 * NLIMB - 1  # 43 conv columns; padded buffer rows = 48
+
+_i32 = jnp.int32
+
+
+def _rows(shape_t):
+    return jax.lax.broadcasted_iota(_i32, (NLIMB, shape_t), 0)
+
+
+# ---------------------------------------------------------------------------
+# field ops on (NLIMB, T) int32 values (value-level, no refs)
+# ---------------------------------------------------------------------------
+
+def _shift_down(x, i, rows):
+    """Shift a (rows, T) value down by i rows, zero-filling on top."""
+    if i == 0:
+        return x
+    z = jnp.zeros((i, x.shape[1]), _i32)
+    return jnp.concatenate([z, x[: rows - i]], axis=0)
+
+
+def _carry_pass(v):
+    """One vectorized carry-save pass; mirrors field._carry_pass."""
+    T = v.shape[1]
+    rows = _rows(T)
+    c = v >> RADIX
+    r = jnp.where(rows == NLIMB - 1, v & ((1 << TOP) - 1), v & MASK)
+    c_nolast = jnp.where(rows == NLIMB - 1, 0, c)
+    r = r + _shift_down(c_nolast, 1, NLIMB)
+    co = v[NLIMB - 1 :] >> TOP  # (1, T)
+    co_hi = (co + (1 << (RADIX - 1))) >> RADIX
+    co_lo = co - (co_hi << RADIX)
+    r = r + (rows == 0) * (19 * co_lo)
+    r = r + (rows == 1) * (19 * co_hi)
+    return r
+
+
+def _carry(v):  # any int32 input -> loose (3 passes, machine-checked)
+    return _carry_pass(_carry_pass(_carry_pass(v)))
+
+
+def _carry_lazy(v):  # |limb| <= 3L + 2^10 -> loose (2 passes)
+    return _carry_pass(_carry_pass(v))
+
+
+def _mul(a, b):
+    """Field multiply, loose-carried output.  Same operand contract as
+    field.mul (22 * |a| * |b| + folds < 2^31)."""
+    T = a.shape[1]
+    z = jnp.zeros((48 - NLIMB, T), _i32)
+    bw = jnp.concatenate([b, z], axis=0)  # (48, T)
+    acc = bw * a[0:1]
+    for i in range(1, NLIMB):
+        acc = acc + _shift_down(bw * a[i : i + 1], i, 48)
+    return _reduce_wide(acc)
+
+
+def _sqr(a):
+    """Field square via the symmetric schoolbook (pass i covers columns
+    2i..i+21 with operand [a_i, 2a_{i+1}...]); ~halves the MAC count."""
+    T = a.shape[1]
+    rows48 = jax.lax.broadcasted_iota(_i32, (48, T), 0)
+    z = jnp.zeros((48 - NLIMB, T), _i32)
+    a2w = jnp.concatenate([a + a, z], axis=0)  # (48, T) doubled
+    aw = jnp.concatenate([a, z], axis=0)
+    acc = None
+    for i in range(NLIMB):
+        # v_i: rows i.. : [a_i, 2a_{i+1}, ..., 2a_21, 0...]; rows < i zero.
+        # Mask-multiplies, not where(.., 0): scalar->2D broadcasts in both
+        # sublanes and lanes are unimplemented in Mosaic.
+        v = aw * (rows48 == i) + a2w * (rows48 > i)
+        t = _shift_down(v * a[i : i + 1], i, 48)
+        acc = t if acc is None else acc + t
+    return _reduce_wide(acc)
+
+
+def _reduce_wide(c48):
+    """Fold-first reduction of (48, T) conv columns (rows 43..47 zero) to
+    loose (NLIMB, T) limbs; bounds as field._reduce_wide."""
+    T = c48.shape[1]
+    rows = _rows(T)
+    lo = c48[:NLIMB]
+    hi = c48[NLIMB : 2 * NLIMB]  # rows 22..43; row 43 (t=21) is zero
+    h_hi = (hi + (1 << (RADIX - 1))) >> RADIX
+    h0 = hi - (h_hi << RADIX)
+    h2 = (h_hi + (1 << (RADIX - 1))) >> RADIX
+    h1 = h_hi - (h2 << RADIX)
+    lo = lo + FOLD * h0
+    lo = lo + FOLD * _shift_down(h1, 1, NLIMB)
+    # h2 lands at rows t+2; its t=20 coefficient wraps through 2^264 with
+    # an extra FOLD into row 0 (single-product column, bound-checked).
+    h2r = _shift_down(h2, 2, NLIMB)
+    lo = lo + FOLD * h2r
+    lo = lo + ((rows == 0) * (FOLD * FOLD)) * h2[NLIMB - 2 : NLIMB - 1]
+    return _carry(lo)
+
+
+def _mul_const(a, k_limbs):
+    """a * constant (constant given as (NLIMB, 1) limb array)."""
+    return _mul(a, jnp.broadcast_to(k_limbs, a.shape))
+
+
+def _freeze(a, two_p):
+    """Canonical representative in [0, p).  Serial quotient-estimate
+    reduction as field.freeze (2 passes over an exact carry chain).
+    two_p: (NLIMB, 1) limb column (from the packed const input)."""
+    v = _carry(a)
+    v = v + two_p
+
+    def chain(x):
+        outs = []
+        carry = jnp.zeros((1, x.shape[1]), _i32)
+        for i in range(NLIMB):
+            t = x[i : i + 1] + carry
+            outs.append(t & MASK)
+            carry = t >> RADIX
+        return jnp.concatenate(outs, axis=0), carry
+
+    def fpass(x):
+        rr = _rows(x.shape[1])
+        t, co = chain(x + (rr == 0) * 19)
+        q = (t[NLIMB - 1 :] >> TOP) + (co << (RADIX - TOP))
+        x = x + (rr == 0) * (19 * q)
+        x = x - (rr == NLIMB - 1) * (q << TOP)
+        out, _ = chain(x)
+        return out
+
+    return fpass(fpass(v))
+
+
+def _select(cond, a, b):
+    """cond: (1, T) bool/int — elementwise lane select."""
+    return jnp.where(cond, a, b)
+
+
+# ---------------------------------------------------------------------------
+# curve ops (extended / cached / niels), value-level
+# ---------------------------------------------------------------------------
+
+def _dbl(x, y, z, with_t=True):
+    a = _sqr(x)
+    b = _sqr(y)
+    zsq = _sqr(z)
+    c = zsq + zsq
+    aa = _sqr(x + y)
+    e = aa - a - b
+    g = b - a
+    f = _carry_lazy(g - c)
+    h = -a - b
+    return (_mul(e, f), _mul(g, h), _mul(f, g),
+            _mul(e, h) if with_t else None)
+
+
+def _add_cached(px, py, pz, pt, q):
+    qypx, qymx, qz, qt2d = q
+    a = _mul(py + px, qypx)
+    b = _mul(py - px, qymx)
+    c = _mul(pt, qt2d)
+    d = _mul(pz, qz)
+    d2 = d + d
+    e = a - b
+    f = d2 - c
+    g = _carry_lazy(d2 + c)
+    h = a + b
+    return _mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)
+
+
+def _madd_niels(px, py, pz, pt, nypx, nymx, nt2d):
+    a = _mul(py + px, nypx)
+    b = _mul(py - px, nymx)
+    c = _mul(pt, nt2d)
+    d2 = pz + pz
+    e = a - b
+    f = d2 - c
+    g = _carry_lazy(d2 + c)
+    h = a + b
+    return _mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)
+
+
+def _to_cached(x, y, z, t, d2_limbs):
+    return (_carry_lazy(y + x), _carry_lazy(y - x), z,
+            _mul_const(t, d2_limbs))
+
+
+def _pow2k(x, k):
+    return jax.lax.fori_loop(0, k, lambda _, v: _sqr(v), x)
+
+
+def _chain_250(a):
+    z2 = _sqr(a)
+    z8 = _pow2k(z2, 2)
+    z9 = _mul(z8, a)
+    z11 = _mul(z9, z2)
+    z22 = _sqr(z11)
+    z_5_0 = _mul(z22, z9)
+    z_10_0 = _mul(_pow2k(z_5_0, 5), z_5_0)
+    z_20_0 = _mul(_pow2k(z_10_0, 10), z_10_0)
+    z_40_0 = _mul(_pow2k(z_20_0, 20), z_20_0)
+    z_50_0 = _mul(_pow2k(z_40_0, 10), z_10_0)
+    z_100_0 = _mul(_pow2k(z_50_0, 50), z_50_0)
+    z_200_0 = _mul(_pow2k(z_100_0, 100), z_100_0)
+    z_250_0 = _mul(_pow2k(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def _invert(a):
+    z_250_0, z11 = _chain_250(a)
+    return _mul(_pow2k(z_250_0, 5), z11)
+
+
+def _pow_p58(a):
+    z_250_0, _ = _chain_250(a)
+    return _mul(_pow2k(z_250_0, 2), a)
+
+
+def _eq(a, b, two_p):
+    """(1, T) int mask: exact field equality."""
+    return jnp.all(_freeze(a, two_p) == _freeze(b, two_p),
+                   axis=0, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# byte -> limb unpacking (static 3-byte windows)
+# ---------------------------------------------------------------------------
+
+def _bytes_to_limbs(b32):
+    """(32, T) int32 byte rows (0..255) -> ((NLIMB, T) limbs of the low 255
+    bits, (1, T) top bit)."""
+    rows = []
+    for i in range(NLIMB):
+        if i % 2 == 0:
+            b0 = (3 * i) // 2
+            limb = b32[b0 : b0 + 1] | ((b32[b0 + 1 : b0 + 2] & 0x0F) << 8)
+        elif i < NLIMB - 1:
+            b0 = (3 * i - 1) // 2
+            limb = (b32[b0 : b0 + 1] >> 4) | (b32[b0 + 1 : b0 + 2] << 4)
+        else:  # limb 21: bits 252..254 of byte 31
+            limb = (b32[31:32] >> 4) & 0x7
+        rows.append(limb)
+    sign = b32[31:32] >> 7
+    return jnp.concatenate(rows, axis=0), sign
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+# Constant-column layout of the packed (NLIMB, 128) kernel-constant input:
+# 0 d, 1 d2, 2 sqrt_m1, 3 two_p, 4..12 base_ypx[j], 13..21 base_ymx[j],
+# 22..30 base_t2d[j].
+_COL_D, _COL_D2, _COL_SQRT_M1, _COL_TWO_P = 0, 1, 2, 3
+_COL_BYPX, _COL_BYMX, _COL_BT2D = 4, 13, 22
+# one (limb0=1) and zero columns: conv/sqr operands must originate from a
+# ref load — feeding compile-time-constant limb vectors into the schoolbook
+# convolution crashes Mosaic's constant folder ("limits[i] <= dim(i)").
+_COL_ONE, _COL_ZERO = 31, 32
+
+
+def _make_consts() -> np.ndarray:
+    """Packed static limb constants as one (NLIMB, 128) int32 array (the
+    lane dim padded to a full vreg tile)."""
+    from . import ed25519 as edops
+    cols = np.zeros((NLIMB, 128), dtype=np.int32)
+    cols[:, _COL_D] = F.int_to_limbs(C.D_INT)
+    cols[:, _COL_D2] = F.int_to_limbs(C.D2_INT)
+    cols[:, _COL_SQRT_M1] = F.int_to_limbs(C.SQRT_M1_INT)
+    cols[:, _COL_TWO_P] = np.asarray(F._TWO_P)
+    cols[:, _COL_BYPX:_COL_BYPX + 9] = np.asarray(edops._BASE_YPX).T
+    cols[:, _COL_BYMX:_COL_BYMX + 9] = np.asarray(edops._BASE_YMX).T
+    cols[:, _COL_BT2D:_COL_BT2D + 9] = np.asarray(edops._BASE_T2D).T
+    cols[0, _COL_ONE] = 1
+    return cols
+
+
+_CONSTS_PACKED = _make_consts()
+
+
+def _gather9(digit, table_rows):
+    """Per-lane select of |digit| in 0..8 from 9 stacked (NLIMB, T) values.
+    digit: (1, T).  table_rows: list of 9 (NLIMB, T) values."""
+    acc = table_rows[0]
+    for j in range(1, 9):
+        acc = jnp.where(digit == j, table_rows[j], acc)
+    return acc
+
+
+def _verify_tile(consts, pub_b, r_b, s_ref, k_ref, one, zero):
+    """consts: (NLIMB, 128) packed constant columns; pub_b, r_b: (32, T)
+    i32 bytes; s_ref, k_ref: (64, T) int8 digit REFS (row-indexed
+    dynamically inside the ladder loop — Mosaic supports dynamic slices
+    on refs, not on values); one, zero: (NLIMB, T) scratch-laundered
+    constants (see _kernel).  Returns (1, T) int32 ok mask."""
+    T = pub_b.shape[1]
+
+    def cst(col):
+        return consts[:, col : col + 1]  # (NLIMB, 1)
+
+    two_p = cst(_COL_TWO_P)
+
+    # -- decompress A ---------------------------------------------------
+    y_l, a_sign = _bytes_to_limbs(pub_b)
+    y = _carry_lazy(y_l)
+    yy = _sqr(y)
+    u = yy - one
+    v = _carry_lazy(_mul_const(yy, cst(_COL_D)) + one)
+    v3 = _mul(_sqr(v), v)
+    v7 = _mul(_sqr(v3), v)
+    uv7 = _mul(u, v7)
+    x = _mul(_mul(u, v3), _pow_p58(uv7))
+    vxx = _mul(v, _sqr(x))
+    ok_plus = _eq(vxx, _carry_lazy(u), two_p)
+    ok_minus = _eq(vxx, _carry_lazy(-u), two_p)
+    x = _select(ok_minus, _mul_const(x, cst(_COL_SQRT_M1)), x)
+    decode_ok = ok_plus | ok_minus
+    x_frozen = _freeze(x, two_p)
+    x_is_zero = jnp.all(x_frozen == 0, axis=0, keepdims=True)
+    x_neg = x_frozen[0:1] & 1
+    decode_ok = decode_ok & ~(x_is_zero & (a_sign == 1))
+    x = _select(x_neg != a_sign, _carry_lazy(-x), x)
+    t = _mul(x, y)
+
+    # -- negate and build cached table of j * (-A), j = 0..8 -------------
+    nx = _carry_lazy(-x)
+    nt = _carry_lazy(-t)
+    z1 = one
+    d2c = cst(_COL_D2)
+    a1 = (nx, y, z1, nt)
+    a2 = _dbl(nx, y, z1, with_t=True)
+    c1 = _to_cached(*a1, d2c)
+    a3 = _add_cached(*a2, c1)
+    a4 = _dbl(a2[0], a2[1], a2[2], with_t=True)
+    a5 = _add_cached(*a4, c1)
+    a6 = _dbl(a3[0], a3[1], a3[2], with_t=True)
+    a7 = _add_cached(*a6, c1)
+    a8 = _dbl(a4[0], a4[1], a4[2], with_t=True)
+    ident = (one, one, one, zero)
+    entries = [ident, c1] + [
+        _to_cached(*p, d2c) for p in (a2, a3, a4, a5, a6, a7, a8)]
+    tab_ypx = [e[0] for e in entries]
+    tab_ymx = [e[1] for e in entries]
+    tab_z = [e[2] for e in entries]
+    tab_t2d = [e[3] for e in entries]
+
+    # base-point niels table columns from the packed consts
+    base_ypx = [cst(_COL_BYPX + j) for j in range(9)]
+    base_ymx = [cst(_COL_BYMX + j) for j in range(9)]
+    base_t2d = [cst(_COL_BT2D + j) for j in range(9)]
+
+    # -- 64-iteration joint Straus ladder --------------------------------
+    p0 = (zero, one, one, zero)
+
+    def step(p, db, da):
+        """One digit position: 4 doublings + fixed-base niels add (digit
+        db) + variable-base cached add (digit da).  db/da: (1, T) i32."""
+        px, py, pz, pt = p
+        px, py, pz, _ = _dbl(px, py, pz, with_t=False)
+        px, py, pz, _ = _dbl(px, py, pz, with_t=False)
+        px, py, pz, _ = _dbl(px, py, pz, with_t=False)
+        px, py, pz, pt = _dbl(px, py, pz, with_t=True)
+        jb = jnp.abs(db)
+        neg_b = db < 0
+        nypx = _gather9(jb, [jnp.broadcast_to(v, (NLIMB, T))
+                             for v in base_ypx])
+        nymx = _gather9(jb, [jnp.broadcast_to(v, (NLIMB, T))
+                             for v in base_ymx])
+        nt2d = _gather9(jb, [jnp.broadcast_to(v, (NLIMB, T))
+                             for v in base_t2d])
+        nypx, nymx = (_select(neg_b, nymx, nypx),
+                      _select(neg_b, nypx, nymx))
+        nt2d = _select(neg_b, -nt2d, nt2d)
+        px, py, pz, pt = _madd_niels(px, py, pz, pt, nypx, nymx, nt2d)
+        ja = jnp.abs(da)
+        neg_a = da < 0
+        qypx = _gather9(ja, tab_ypx)
+        qymx = _gather9(ja, tab_ymx)
+        qz = _gather9(ja, tab_z)
+        qt2d = _gather9(ja, tab_t2d)
+        qypx, qymx = (_select(neg_a, qymx, qypx),
+                      _select(neg_a, qypx, qymx))
+        qt2d = _select(neg_a, -qt2d, qt2d)
+        return _add_cached(px, py, pz, pt, (qypx, qymx, qz, qt2d))
+
+    def group(g, p):
+        """Digit rows are consumed most-significant-first (63 down to 0).
+        Mosaic requires dynamic sublane offsets provably aligned to the
+        tile, so load an aligned (8, T) digit block per outer iteration
+        and unroll the 8 positions statically."""
+        off = pl.multiple_of((7 - g) * 8, 8)
+        s8 = s_ref[pl.ds(off, 8), :].astype(_i32)
+        k8 = k_ref[pl.ds(off, 8), :].astype(_i32)
+        for j in range(7, -1, -1):
+            p = step(p, s8[j : j + 1], k8[j : j + 1])
+        return p
+
+    px, py, pz, pt = jax.lax.fori_loop(0, 8, group, p0)
+
+    # -- encode and compare against R ------------------------------------
+    zinv = _invert(pz)
+    xf = _mul(px, zinv)
+    yf = _mul(py, zinv)
+    y_enc = _freeze(yf, two_p)
+    x_sign = _freeze(xf, two_p)[0:1] & 1
+    r_l, r_sign = _bytes_to_limbs(r_b)
+    r_eq = jnp.all(y_enc == r_l, axis=0, keepdims=True) & (x_sign == r_sign)
+    return (decode_ok & r_eq).astype(_i32)
+
+
+def _kernel(const_ref, pub_ref, r_ref, s_ref, k_ref, out_ref,
+            one_scr, zero_scr):
+    consts = const_ref[:]
+    pub_b = pub_ref[:].astype(_i32) & 0xFF
+    r_b = r_ref[:].astype(_i32) & 0xFF
+    # Launder the one/zero limb constants through VMEM scratch: values
+    # whose lanes are compile-time uniform keep a "replicated" layout in
+    # Mosaic, and row-slicing them inside the schoolbook convolution needs
+    # a both-sublanes-and-lanes broadcast Mosaic does not implement (or
+    # crashes its constant folder).  A store/load round trip forces a
+    # standard tiled layout.
+    T = pub_ref.shape[1]
+    one_scr[:] = jnp.broadcast_to(consts[:, _COL_ONE : _COL_ONE + 1],
+                                  (NLIMB, T))
+    zero_scr[:] = jnp.broadcast_to(consts[:, _COL_ZERO : _COL_ZERO + 1],
+                                   (NLIMB, T))
+    ok = _verify_tile(consts, pub_b, r_b, s_ref, k_ref,
+                      one_scr[:], zero_scr[:])  # (1, T)
+    out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def verify_staged_pallas(pub, r, s_digits, k_digits, tile: int = 512):
+    """Batched verify via the fused Pallas kernel.
+
+    pub, r: (B, 32) uint8; s_digits, k_digits: (B, 64) int8 (the compact
+    staging layout of ops.ed25519.prepare_batch).  B must be a multiple of
+    `tile`.  Returns (B,) bool.
+    """
+    B = pub.shape[0]
+    assert B % tile == 0, (B, tile)
+    grid = (B // tile,)
+    # transpose to lane-major for the kernel
+    pub_t = pub.T.astype(jnp.int8)   # (32, B)
+    r_t = r.T.astype(jnp.int8)
+    s_t = s_digits.T                  # (64, B) i8
+    k_t = k_digits.T
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((8, B), _i32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((NLIMB, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((64, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((64, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((NLIMB, tile), _i32)],
+    )(jnp.asarray(_CONSTS_PACKED), pub_t, r_t, s_t, k_t)
+    return out[0].astype(jnp.bool_)

@@ -1,0 +1,111 @@
+"""Vectorized scalar mod-L reduction over numpy int64 limb lanes.
+
+Host staging for the batched ed25519 verifier: the challenge scalar
+k = SHA-512(R || A || M) mod L must be reduced for every signature in a
+batch.  Round 1 did this with per-signature Python bignum `% L` (~2-3
+us/sig); here the whole batch is reduced with vectorized 2^24-radix
+int64 limb arithmetic.  (The SHA-512 digests themselves stay on hashlib
+/ OpenSSL — C-loop hashing of short messages beats numpy lane hashing.)
+
+Reference semantics: Go crypto/ed25519 Verify's SHA-512 + edwards25519
+ScReduce (reference crypto/ed25519/ed25519.go:148).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+
+# -- mod L ------------------------------------------------------------------
+# Fold: 2^252 ≡ -C (mod L) with C = L - 2^252 (125 bits).  Limbs: radix
+# 2^24 in int64.  Each fold computes  v' = lo + M_k - C*hi  where M_k is a
+# precomputed multiple of L large enough to keep v' positive (M_k >=
+# C * max(hi)), so carries never need a sign-extending borrow out of the
+# top limb.  Three folds take 512 -> ~254 bits; a few conditional
+# subtracts of L give the canonical representative.
+
+_C = L - (1 << 252)
+_RADIX = 24
+_NROWS = 24  # working limb rows (24 * 24 = 576 bits headroom)
+_C_LIMBS = np.array([(_C >> (_RADIX * i)) & 0xFFFFFF
+                     for i in range(6)], dtype=np.int64)  # 125 bits -> 6
+_L_LIMBS = np.array([(L >> (_RADIX * i)) & 0xFFFFFF
+                     for i in range(11)], dtype=np.int64)
+
+
+def _mult_of_l_geq(x: int) -> int:
+    return ((x + L - 1) // L) * L
+
+
+# fold-k positive offsets: hi_1 <= 2^260, hi_2 <= 2^135, hi_3 <= 2^9
+_M_OFFSETS = [_mult_of_l_geq(_C << 260), _mult_of_l_geq(_C << 135),
+              _mult_of_l_geq(_C << 9)]
+_M_LIMBS = [np.array([(m >> (_RADIX * i)) & 0xFFFFFF
+                      for i in range(_NROWS)], dtype=np.int64)
+            for m in _M_OFFSETS]
+
+
+def _carry_unsigned(x):
+    """Carry propagation for a nonnegative total; returns limbs in
+    [0, 2^24) and asserts no residual carry escapes the top row."""
+    out = np.zeros_like(x)
+    carry = np.zeros(x.shape[1], dtype=np.int64)
+    for i in range(x.shape[0]):
+        v = x[i] + carry
+        out[i] = v & 0xFFFFFF
+        carry = v >> _RADIX
+    assert (carry == 0).all(), "mod_l fold escaped its bound"
+    return out
+
+
+def mod_l_batch(digests: np.ndarray) -> np.ndarray:
+    """(B, 64) uint8 little-endian 512-bit values -> (B, 32) uint8
+    canonical values mod L."""
+    B = digests.shape[0]
+    d = np.zeros((B, 3 * _NROWS), dtype=np.uint8)
+    d[:, :64] = digests
+    limbs = (d[:, 0::3].astype(np.int64)
+             | (d[:, 1::3].astype(np.int64) << 8)
+             | (d[:, 2::3].astype(np.int64) << 16)).T  # (_NROWS, B)
+
+    split = 252 // _RADIX  # limb 10; bit 252 is bit 12 of limb 10
+    for m_limbs in _M_LIMBS:
+        # split value at bit 252: value = lo + 2^252 * hi
+        lo = limbs[: split + 1].copy()
+        lo[split] &= (1 << 12) - 1
+        hi = limbs[split:].copy()
+        hi[0] >>= 12
+        for i in range(1, hi.shape[0]):
+            hi[i - 1] |= (hi[i] & ((1 << 12) - 1)) << 12
+            hi[i] >>= 12
+        acc = np.zeros((_NROWS, B), dtype=np.int64)
+        acc[: split + 1] = lo
+        acc += m_limbs[:, None]
+        nh = min(hi.shape[0], _NROWS - 6)
+        for i in range(6):
+            acc[i : i + nh] -= _C_LIMBS[i] * hi[:nh]
+        limbs = _carry_unsigned(acc)
+
+    # value now < M_3 + 2^252 < 5L: at most 4 conditional subtracts
+    acc = limbs
+    for _ in range(5):
+        ge = np.zeros(B, dtype=bool)
+        decided = np.zeros(B, dtype=bool)
+        for i in range(acc.shape[0] - 1, -1, -1):
+            li = int(_L_LIMBS[i]) if i < 11 else 0
+            gt = ~decided & (acc[i] > li)
+            lt = ~decided & (acc[i] < li)
+            ge |= gt
+            decided |= gt | lt
+        ge |= ~decided  # equal -> subtract
+        sub = np.zeros_like(acc)
+        sub[:11] = _L_LIMBS[:, None] * ge.astype(np.int64)
+        acc = _carry_unsigned(acc - sub)
+
+    out = np.zeros((B, 3 * 11), dtype=np.uint8)
+    for i in range(11):
+        out[:, 3 * i] = acc[i] & 0xFF
+        out[:, 3 * i + 1] = (acc[i] >> 8) & 0xFF
+        out[:, 3 * i + 2] = (acc[i] >> 16) & 0xFF
+    return np.ascontiguousarray(out[:, :32])

@@ -238,3 +238,65 @@ def test_scalar_times_batch_broadcast(bsize):
     assert F.limbs_to_int(col(sel, 0)) % P == c % P
     if bsize > 1:
         assert F.limbs_to_int(col(sel, 1)) % P == xs[1] % P
+
+
+def test_carry_pass_count_proof():
+    """Machine-checked proof that carry()'s 3 passes / carry_lazy()'s 2
+    passes / _reduce_wide's fold-first bounds are sufficient: exact
+    max-abs interval propagation mirroring _carry_pass's op structure.
+    If anyone changes RADIX/NLIMB/pass structure, this recomputes."""
+    RADIX, NLIMB, MASK = F.RADIX, F.NLIMB, F.MASK
+    TOP = 255 - RADIX * (NLIMB - 1)
+    FOLD = F.FOLD
+    LOOSE = 4608
+
+    def pass_bound(b):
+        b = np.asarray(b, dtype=np.float64)
+        c = (b + MASK) // (1 << RADIX)          # |v >> 12|
+        r = np.minimum(b, MASK)                  # |v & MASK|
+        r[-1] = min(b[-1], (1 << TOP) - 1)
+        r[1:] = r[1:] + c[:-1]
+        co = (b[-1] + (1 << TOP) - 1) // (1 << TOP)
+        co_hi = (co + (1 << (RADIX - 1))) // (1 << RADIX) + 1
+        co_lo = min(co, 1 << (RADIX - 1))
+        r[0] += 19 * co_lo
+        r[1] += 19 * co_hi
+        return r
+
+    # generic contract: any int32 input -> loose in 3 passes
+    b = np.full(NLIMB, 2.0 ** 31)
+    for _ in range(3):
+        b = pass_bound(b)
+    assert b.max() < LOOSE, b
+
+    # lazy contract: |limb| <= 3L + 2^10 (worst three-term combination of
+    # loose values, e.g. dbl's g - c) -> loose in 2 passes
+    b = np.full(NLIMB, 3.0 * LOOSE + (1 << 10))
+    for _ in range(2):
+        b = pass_bound(b)
+    assert b.max() < LOOSE, b
+
+    # fold-first _reduce_wide: conv columns of the extreme mul contract
+    # (|a| <= 10240, |b| <= 9216) fold into lo columns that fit int32,
+    # then 3 passes reach loose.
+    A, B = 10240, 9216
+    conv = np.zeros(2 * NLIMB - 1)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            conv[i + j] += A * B
+    lo, hi = conv[:NLIMB].copy(), conv[NLIMB:]
+    for t, h in enumerate(hi):
+        h_hi = (h + (1 << (RADIX - 1))) // (1 << RADIX) + 1
+        h2 = (h_hi + (1 << (RADIX - 1))) // (1 << RADIX) + 1
+        half = 1 << (RADIX - 1)
+        lo[t] += FOLD * half
+        lo[t + 1] += FOLD * half if t + 1 <= NLIMB - 1 else 0
+        if t + 2 <= NLIMB - 1:
+            lo[t + 2] += FOLD * h2
+        else:
+            lo[0] += FOLD * FOLD * h2
+    assert lo.max() < 2 ** 31 - 1, lo.max()
+    b = lo
+    for _ in range(3):
+        b = pass_bound(b)
+    assert b.max() < LOOSE, b
