@@ -72,6 +72,15 @@ def cmd_start(args):
         node.stop()
 
 
+def cmd_replay(args):
+    """Reference cmd replay/replay_console (consensus/replay_file.go):
+    print a WAL stream; --console single-steps."""
+    from tendermint_tpu.consensus.replay_console import replay_messages
+    wal = args.wal or os.path.join(_home(args), "data", "cs.wal", "wal")
+    n = replay_messages(wal, console=args.console)
+    print(f"replayed {n} WAL messages from {wal}")
+
+
 def _load_app(spec: str):
     """`kvstore` (default), a socket address (`unix:///path` or
     `tcp://host:port`) for an external ABCI app process, or
@@ -242,6 +251,14 @@ def main(argv=None):
     sp.add_argument("--node-addr", required=True,
                     help="the node's priv_validator_laddr to dial")
     sp.set_defaults(fn=cmd_remote_signer)
+
+    sp = sub.add_parser("replay", help="print a consensus WAL")
+    sp.add_argument("--wal", default="")
+    sp.set_defaults(fn=cmd_replay, console=False)
+    sp = sub.add_parser("replay-console",
+                        help="single-step through a consensus WAL")
+    sp.add_argument("--wal", default="")
+    sp.set_defaults(fn=cmd_replay, console=True)
 
     sp = sub.add_parser("abci-kvstore",
                         help="run the kvstore app as an ABCI server")

@@ -24,6 +24,7 @@ class P2PConfig:
 
 @dataclass
 class MempoolConfig:
+    version: str = "v0"         # "v0" FIFO or "v1" priority mempool
     size: int = 5000
     cache_size: int = 10000
     max_tx_bytes: int = 1048576
@@ -116,6 +117,7 @@ pex = {str(self.p2p.pex).lower()}
 seeds = "{self.p2p.seeds}"
 
 [mempool]
+version = "{self.mempool.version}"
 size = {self.mempool.size}
 cache_size = {self.mempool.cache_size}
 max_tx_bytes = {self.mempool.max_tx_bytes}
@@ -165,6 +167,7 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             seeds=p.get("seeds", ""))
         m = d.get("mempool", {})
         cfg.mempool = MempoolConfig(
+            version=m.get("version", "v0"),
             size=m.get("size", 5000), cache_size=m.get("cache_size", 10000),
             max_tx_bytes=m.get("max_tx_bytes", 1048576))
         r = d.get("rpc", {})

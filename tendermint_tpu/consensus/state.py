@@ -53,8 +53,12 @@ class ConsensusState:
     def __init__(self, config: ConsensusConfig, state: SMState,
                  block_exec: BlockExecutor, block_store, mempool=None,
                  evidence_pool=None, priv_validator=None, wal_path=None,
-                 event_bus=None, name: str = ""):
+                 event_bus=None, name: str = "", metrics_registry=None):
+        from tendermint_tpu.libs.metrics import ConsensusMetrics
         self.config = config
+        self.metrics = ConsensusMetrics(metrics_registry)
+        self._round_t0 = time.time()
+        self._last_block_time = 0.0
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -414,6 +418,11 @@ class ConsensusState:
         if rs.round < round_:
             validators = validators.copy()
             validators.increment_proposer_priority(round_ - rs.round)
+        self.metrics.height.set(height)
+        self.metrics.rounds.set(round_)
+        self.metrics.round_duration.observe(
+            max(time.time() - self._round_t0, 0.0))
+        self._round_t0 = time.time()
         rs.round = round_
         rs.step = Step.NEW_ROUND
         rs.validators = validators
@@ -794,6 +803,18 @@ class ConsensusState:
         state_copy = self.state.copy()
         new_state, _ = self.block_exec.apply_block(
             state_copy, block_id, block)
+
+        m = self.metrics  # reference consensus/metrics.go recordMetrics
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.inc(len(block.data.txs))
+        m.commit_round.set(rs.commit_round)
+        m.validators.set(rs.validators.size())
+        m.validators_power.set(rs.validators.total_voting_power())
+        m.block_size_bytes.set(sum(len(t) for t in block.data.txs))
+        bt = block.header.time.seconds + block.header.time.nanos * 1e-9
+        if self._last_block_time:
+            m.block_interval.observe(max(bt - self._last_block_time, 0.0))
+        self._last_block_time = bt
 
         for fn in self.on_committed:
             fn(block)

@@ -6,6 +6,11 @@ boundary reproduces the exact state.  Framing: 4-byte CRC32c | 4-byte
 length | safe_codec(msg), matching the reference's crc/length framing
 (consensus/wal.go:288-355); EndHeightMessage marks height boundaries.
 
+Storage is a rotating autofile Group (reference libs/autofile/group.go via
+consensus/wal.go:91 NewWAL): the head file rotates into numbered chunks at
+height boundaries once it exceeds the head size limit, bounding any single
+file; readers see one logical stream across chunks + head.
+
 fsync policy mirrors the reference: WriteSync on own votes/timeouts and on
 EndHeight (consensus/state.go:765,774,1683).
 """
@@ -19,6 +24,7 @@ from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
 from tendermint_tpu.libs import safe_codec
+from tendermint_tpu.libs.autofile import Group, list_group_paths
 
 MAX_MSG_SIZE = 1 << 20  # 1MB (reference consensus/wal.go:25)
 
@@ -35,10 +41,9 @@ class WALCorruptionError(Exception):
 
 
 class WAL:
-    def __init__(self, path: str):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    def __init__(self, path: str, head_size_limit: int = 10 * 1024 * 1024):
         self.path = path
-        self._f = open(path, "ab")
+        self._group = Group(path, head_size_limit=head_size_limit)
         self._lock = threading.Lock()
 
     def write(self, msg) -> None:
@@ -48,7 +53,14 @@ class WAL:
         frame = (struct.pack(">I", zlib.crc32(data))
                  + struct.pack(">I", len(data)) + data)
         with self._lock:
-            self._f.write(frame)
+            self._group.write(frame)
+        # rotation only at height boundaries: a frame never spans files
+        # (reference consensus/wal.go writes #ENDHEIGHT then the group
+        # rotates on its own ticker; rotating on the boundary keeps replay
+        # chunk-local)
+        if isinstance(msg, EndHeightMessage):
+            with self._lock:
+                self._group.maybe_rotate()
 
     def write_sync(self, msg) -> None:
         self.write(msg)
@@ -56,21 +68,16 @@ class WAL:
 
     def flush_and_sync(self):
         with self._lock:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self._group.flush_and_sync()
 
     def close(self):
         with self._lock:
-            self._f.flush()
-            self._f.close()
+            self._group.close()
 
     # -- replay ------------------------------------------------------------
 
     @staticmethod
-    def iter_messages(path: str, allow_corruption_tail: bool = True):
-        """Yield messages; a torn/corrupt tail (crash mid-write) stops
-        iteration cleanly when allow_corruption_tail (reference repairWalFile
-        consensus/state.go:330-366)."""
+    def _iter_file(path: str, allow_corruption_tail: bool = True):
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
@@ -96,6 +103,20 @@ class WAL:
                     if allow_corruption_tail:
                         return
                     raise
+
+    @staticmethod
+    def iter_messages(path: str, allow_corruption_tail: bool = True):
+        """Yield messages across rotated chunks + head, oldest first; a
+        torn/corrupt tail (crash mid-write) stops iteration cleanly when
+        allow_corruption_tail (reference repairWalFile
+        consensus/state.go:330-366).  Only the FINAL file can legitimately
+        have a torn tail — corruption in an earlier rotated chunk would
+        silently hole the replay stream, so it raises regardless."""
+        paths = list_group_paths(path)
+        for i, p in enumerate(paths):
+            is_last = i == len(paths) - 1
+            yield from WAL._iter_file(
+                p, allow_corruption_tail and is_last)
 
     @staticmethod
     def search_for_end_height(path: str, height: int) -> bool:

@@ -1,0 +1,266 @@
+"""Metrics registry with Prometheus text exposition
+(reference libs' go-kit/prometheus metrics; consensus/metrics.go:22,
+state/execution.go:202 BlockProcessingTime, scripts/metricsgen outputs).
+
+Counters, gauges, and histograms with optional label dimensions; a
+process-global default registry (one node per process is the common
+case — tests may build private registries); rendered in the Prometheus
+text format at the RPC endpoint GET /metrics (the reference serves a
+separate Prometheus listener gated by config.Instrumentation,
+node/node.go:959-962 — here it rides the existing RPC listener).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        assert set(labels) == set(self.label_names), (
+            f"{self.name}: labels {set(labels)} != {set(self.label_names)}")
+        return tuple(labels[k] for k in self.label_names)
+
+    def _fmt_labels(self, key: Tuple[str, ...], extra: str = "") -> str:
+        pairs = [f'{n}="{v}"' for n, v in zip(self.label_names, key)]
+        if extra:
+            pairs.append(extra)
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", labels=()):
+        super().__init__(name, help_, labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, n: float = 1.0, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{self._fmt_labels(k)} {v:g}"
+                for k, v in items] or [f"{self.name} 0"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", labels=()):
+        super().__init__(name, help_, labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, v: float, **labels):
+        with self._lock:
+            self._values[self._key(labels)] = float(v)
+
+    def add(self, n: float, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{self._fmt_labels(k)} {v:g}"
+                for k, v in items] or [f"{self.name} 0"]
+
+
+def exp_buckets(start: float, factor: float, count: int) -> List[float]:
+    """Exponential-range buckets (reference consensus/metrics.go:33
+    0.1..100s exprange)."""
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_="", labels=(), buckets=None):
+        super().__init__(name, help_, labels)
+        self.buckets = sorted(buckets or
+                              [.005, .01, .025, .05, .1, .25, .5,
+                               1, 2.5, 5, 10])
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._n: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, v: float, **labels):
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key,
+                                             [0] * (len(self.buckets) + 1))
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + v
+            self._n[key] = self._n.get(key, 0) + 1
+
+    def render(self) -> List[str]:
+        out = []
+        with self._lock:
+            keys = sorted(self._counts)
+            for key in keys:
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum += self._counts[key][i]
+                    out.append(f"{self.name}_bucket"
+                               f"{self._fmt_labels(key, f'le=\"{ub:g}\"')}"
+                               f" {cum}")
+                cum += self._counts[key][-1]
+                out.append(f"{self.name}_bucket"
+                           f"{self._fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                out.append(f"{self.name}_sum{self._fmt_labels(key)}"
+                           f" {self._sum[key]:g}")
+                out.append(f"{self.name}_count{self._fmt_labels(key)}"
+                           f" {self._n[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, subsystem, name, help_, **kw):
+        full = f"{self.namespace}_{subsystem}_{name}" if subsystem else \
+            f"{self.namespace}_{name}"
+        with self._lock:
+            if full in self._metrics:
+                m = self._metrics[full]
+                assert isinstance(m, cls), full
+                return m
+            m = cls(full, help_, **kw)
+            self._metrics[full] = m
+            return m
+
+    def counter(self, subsystem, name, help_="", labels=()) -> Counter:
+        return self._register(Counter, subsystem, name, help_,
+                              labels=labels)
+
+    def gauge(self, subsystem, name, help_="", labels=()) -> Gauge:
+        return self._register(Gauge, subsystem, name, help_, labels=labels)
+
+    def histogram(self, subsystem, name, help_="", labels=(),
+                  buckets=None) -> Histogram:
+        return self._register(Histogram, subsystem, name, help_,
+                              labels=labels, buckets=buckets)
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()
+
+
+class ConsensusMetrics:
+    """Reference consensus/metrics.go:22-40."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.height = reg.gauge("consensus", "height",
+                                "Height of the chain.")
+        self.rounds = reg.gauge("consensus", "rounds",
+                                "Round of the current height.")
+        self.round_duration = reg.histogram(
+            "consensus", "round_duration_seconds",
+            "Time spent in a round.",
+            buckets=exp_buckets(0.1, 100 ** (1 / 8), 9))
+        self.validators = reg.gauge("consensus", "validators",
+                                    "Number of validators.")
+        self.validators_power = reg.gauge(
+            "consensus", "validators_power", "Total voting power.")
+        self.num_txs = reg.gauge("consensus", "num_txs",
+                                 "Transactions in the latest block.")
+        self.total_txs = reg.counter("consensus", "total_txs",
+                                     "Total committed transactions.")
+        self.block_interval = reg.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block.")
+        self.block_size_bytes = reg.gauge(
+            "consensus", "block_size_bytes", "Size of the latest block.")
+        self.commit_round = reg.gauge(
+            "consensus", "commit_round", "Round at which the last block "
+            "committed.")
+        self.block_parts = reg.counter(
+            "consensus", "block_parts",
+            "Block parts transmitted per peer.", labels=("peer_id",))
+        self.quorum_prevote_delay = reg.gauge(
+            "consensus", "quorum_prevote_delay",
+            "Seconds from proposal time to 2/3 prevotes.")
+
+
+class StateMetrics:
+    """Reference state/execution.go:202 + state/metrics.go."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.block_processing_time = reg.histogram(
+            "state", "block_processing_time",
+            "Time to process a block (ApplyBlock), seconds.")
+        self.batch_verify_size = reg.histogram(
+            "state", "batch_verify_size",
+            "Signatures per batched verify call (TPU data plane).",
+            buckets=[1, 4, 16, 64, 256, 1024, 4096, 16384, 65536])
+
+
+class P2PMetrics:
+    """Reference p2p/metrics.go."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.peers = reg.gauge("p2p", "peers", "Connected peers.")
+        self.bytes_sent = reg.counter("p2p", "message_send_bytes_total",
+                                      "Bytes sent.", labels=("ch_id",))
+        self.bytes_recv = reg.counter("p2p", "message_receive_bytes_total",
+                                      "Bytes received.", labels=("ch_id",))
+
+
+class MempoolMetrics:
+    """Reference mempool/metrics.go."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.size = reg.gauge("mempool", "size",
+                              "Transactions in the mempool.")
+        self.tx_size_bytes = reg.histogram(
+            "mempool", "tx_size_bytes", "Tx sizes.",
+            buckets=exp_buckets(1, 3, 17))
+        self.failed_txs = reg.counter("mempool", "failed_txs",
+                                      "Rejected CheckTx.")
+        self.recheck_times = reg.counter("mempool", "recheck_times",
+                                         "Tx recheck invocations.")

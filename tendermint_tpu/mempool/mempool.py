@@ -55,11 +55,14 @@ class TxCache:
 
 class Mempool:
     def __init__(self, app: abci.Application, max_tx_bytes: int = 1048576,
-                 size_limit: int = 5000, keep_invalid_txs_in_cache=False):
+                 size_limit: int = 5000, keep_invalid_txs_in_cache=False,
+                 registry=None):
         self.app = app
         self.max_tx_bytes = max_tx_bytes
         self.size_limit = size_limit
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        from tendermint_tpu.libs.metrics import MempoolMetrics
+        self.metrics = MempoolMetrics(registry)
         self.cache = TxCache()
         self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
         self._lock = threading.RLock()
@@ -104,8 +107,12 @@ class Mempool:
         # thread takes the mempool lock during commit — calling out while
         # holding _lock would be an ABBA deadlock.
         if admitted:
+            self.metrics.size.set(self.size())
+            self.metrics.tx_size_bytes.observe(len(tx))
             for fn in self._notify:
                 fn()
+        elif not res.is_ok():
+            self.metrics.failed_txs.inc()
         return res
 
     # -- reap (reference clist_mempool.go:519) -----------------------------
@@ -154,6 +161,7 @@ class Mempool:
     def _recheck(self):
         dead = []
         for key, mt in self._txs.items():
+            self.metrics.recheck_times.inc()
             res = self.app.check_tx(abci.RequestCheckTx(
                 tx=mt.tx, type=abci.CheckTxType.RECHECK))
             if not res.is_ok():
@@ -162,6 +170,7 @@ class Mempool:
             mt = self._txs.pop(key)
             if not self.keep_invalid_txs_in_cache:
                 self.cache.remove(mt.tx)
+        self.metrics.size.set(len(self._txs))
 
     def flush(self):
         with self._lock:

@@ -150,9 +150,17 @@ class Node:
         self.block_indexer = BlockIndexer(ix_db)
         self.indexer_service = IndexerService(
             self.tx_indexer, self.block_indexer, self.event_bus)
-        self.mempool = Mempool(self.app_conns.mempool,
-                               max_tx_bytes=cfg.mempool.max_tx_bytes,
-                               size_limit=cfg.mempool.size)
+        if cfg.mempool.version == "v1":
+            from tendermint_tpu.mempool.priority_mempool import \
+                PriorityMempool
+            self.mempool = PriorityMempool(
+                self.app_conns.mempool,
+                max_tx_bytes=cfg.mempool.max_tx_bytes,
+                size_limit=cfg.mempool.size)
+        else:
+            self.mempool = Mempool(self.app_conns.mempool,
+                                   max_tx_bytes=cfg.mempool.max_tx_bytes,
+                                   size_limit=cfg.mempool.size)
         self.evidence_pool = EvidencePool(ev_db, self.state_store,
                                           self.block_store)
 

@@ -99,6 +99,65 @@ def scalars_to_digits(s_bytes: np.ndarray) -> np.ndarray:
     return dig
 
 
+def _to_u8_matrix(rows, width):
+    if isinstance(rows, np.ndarray):
+        return np.ascontiguousarray(rows, dtype=np.uint8)
+    return np.frombuffer(b"".join(bytes(r) for r in rows),
+                         dtype=np.uint8).reshape(-1, width)
+
+
+def _s_canonical(s_bytes: np.ndarray) -> np.ndarray:
+    """Vectorized s < L check (Go: scMinimal): compare the four
+    little-endian uint64 words against L's, most-significant first."""
+    s_words = s_bytes.view("<u8")  # (B, 4)
+    l_words = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8")
+    B = s_bytes.shape[0]
+    ok = np.zeros(B, dtype=bool)
+    decided = np.zeros(B, dtype=bool)
+    for w in (3, 2, 1, 0):
+        lt = ~decided & (s_words[:, w] < l_words[w])
+        gt = ~decided & (s_words[:, w] > l_words[w])
+        ok |= lt
+        decided |= lt | gt
+    return ok  # undecided = equal to L -> not ok
+
+
+def _sha512_digests(r_bytes, pubkeys, msgs) -> np.ndarray:
+    """(B, 64) uint8 SHA-512(R || A || M) digests via hashlib (OpenSSL's
+    C loop beats numpy lane hashing on short messages)."""
+    B = r_bytes.shape[0]
+    rp = np.concatenate([r_bytes, pubkeys], axis=1).tobytes()
+    _sha = hashlib.sha512
+    return np.frombuffer(b"".join(
+        _sha(rp[64 * i: 64 * i + 64] + msgs[i]).digest()
+        for i in range(B)), dtype=np.uint8).reshape(B, 64)
+
+
+def prepare_batch_compact(pubkeys, sigs, msgs):
+    """Stage a verification batch for the fused Pallas kernel.
+
+    Host work is byte packing, the s < L canonicity check, and hashlib
+    SHA-512 digests — the mod-L reduction and balanced radix-16 digit
+    decomposition run on-device (ops/pallas_ed25519.py _mod_l /
+    _digits_from_limbs).  Returns (device_inputs, host_ok)."""
+    pubkeys = _to_u8_matrix(pubkeys, 32)
+    sigs = _to_u8_matrix(sigs, 64)
+    B = pubkeys.shape[0]
+    assert pubkeys.shape == (B, 32) and sigs.shape == (B, 64) \
+        and len(msgs) == B
+    r_bytes = np.ascontiguousarray(sigs[:, :32])
+    s_bytes = np.ascontiguousarray(sigs[:, 32:])
+    host_ok = _s_canonical(s_bytes)
+    digests = _sha512_digests(r_bytes, pubkeys, msgs)
+    # lane-major (transposed) int8 — the kernel's native layout; device
+    # transposes of int8 are ~4x the cost of the whole verify kernel
+    dev = dict(pub=np.ascontiguousarray(pubkeys.T).view(np.int8),
+               r=np.ascontiguousarray(r_bytes.T).view(np.int8),
+               s=np.ascontiguousarray(s_bytes.T).view(np.int8),
+               digest=np.ascontiguousarray(digests.T).view(np.int8))
+    return dev, host_ok
+
+
 def prepare_batch(pubkeys, sigs, msgs):
     """Stage a verification batch for the device kernel.
 
@@ -113,31 +172,14 @@ def prepare_batch(pubkeys, sigs, msgs):
     compact uint8/int8, batch-major — bit/limb expansion happens on-device
     in verify_staged (160 B/signature of transfer instead of ~1.5 KB).
     """
-    pubkeys = np.ascontiguousarray(np.asarray(
-        [np.frombuffer(bytes(p), dtype=np.uint8) for p in pubkeys]
-        if not isinstance(pubkeys, np.ndarray) else pubkeys, dtype=np.uint8))
-    sigs = np.ascontiguousarray(np.asarray(
-        [np.frombuffer(bytes(s), dtype=np.uint8) for s in sigs]
-        if not isinstance(sigs, np.ndarray) else sigs, dtype=np.uint8))
+    pubkeys = _to_u8_matrix(pubkeys, 32)
+    sigs = _to_u8_matrix(sigs, 64)
     B = pubkeys.shape[0]
     assert pubkeys.shape == (B, 32) and sigs.shape == (B, 64) and len(msgs) == B
 
     r_bytes = np.ascontiguousarray(sigs[:, :32])
     s_bytes = np.ascontiguousarray(sigs[:, 32:])
-
-    # s < L canonicity (Go: scMinimal), vectorized: compare the four
-    # little-endian uint64 words of s against L's words, most-significant
-    # first.
-    s_words = s_bytes.view("<u8")  # (B, 4)
-    l_words = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8")
-    host_ok = np.zeros(B, dtype=bool)
-    decided = np.zeros(B, dtype=bool)
-    for w in (3, 2, 1, 0):
-        lt = ~decided & (s_words[:, w] < l_words[w])
-        gt = ~decided & (s_words[:, w] > l_words[w])
-        host_ok |= lt
-        decided |= lt | gt
-    # undecided = equal to L -> not ok (host_ok stays False)
+    host_ok = _s_canonical(s_bytes)
 
     # challenge k = SHA-512(R || A || M) mod L.  hashlib (OpenSSL) beats a
     # vectorized numpy SHA-512 on short messages, but the mod-L reduction
@@ -146,11 +188,7 @@ def prepare_batch(pubkeys, sigs, msgs):
     # (VERDICT r1 weak #2).
     from . import sha512_np
 
-    rp = np.concatenate([r_bytes, pubkeys], axis=1).tobytes()  # (B*64,)
-    _sha = hashlib.sha512
-    digests = np.frombuffer(b"".join(
-        _sha(rp[64 * i: 64 * i + 64] + msgs[i]).digest()
-        for i in range(B)), dtype=np.uint8).reshape(B, 64)
+    digests = _sha512_digests(r_bytes, pubkeys, msgs)
     k_red = sha512_np.mod_l_batch(digests)
 
     dev = dict(
@@ -305,17 +343,20 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     On TPU the fused Pallas kernel (ops/pallas_ed25519.py) runs the whole
     verification in VMEM (~3.5x the XLA-composed kernel); elsewhere the
     XLA kernel is used."""
-    dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
-    n = host_ok.shape[0]
     if _use_pallas():
         from . import pallas_ed25519 as pe
+        dev, host_ok = prepare_batch_compact(pubkeys, sigs, msgs)
+        n = host_ok.shape[0]
         nb = max(PALLAS_TILE, bucket_size(n))
-        dev = _pad_dev(dev, n, nb)
+        if nb != n:  # pad the trailing (lane) axis
+            dev = {k: np.pad(v, [(0, 0), (0, nb - n)]) for k, v in dev.items()}
         out = pe.verify_staged_pallas(
             jnp.asarray(dev["pub"]), jnp.asarray(dev["r"]),
-            jnp.asarray(dev["s_digits"]), jnp.asarray(dev["k_digits"]),
+            jnp.asarray(dev["s"]), jnp.asarray(dev["digest"]),
             tile=min(PALLAS_TILE, nb))
     else:
+        dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
+        n = host_ok.shape[0]
         dev = _pad_dev(dev, n, bucket_size(n))
         out = verify_kernel(**{k: jnp.asarray(v) for k, v in dev.items()})
     return np.asarray(out)[:n] & host_ok

@@ -282,6 +282,128 @@ def _bytes_to_limbs(b32):
 
 
 # ---------------------------------------------------------------------------
+# device-side scalar pipeline: SHA-512 digest mod L + balanced radix-16
+# digits (moves what round 1 did per-signature in host Python onto the
+# lanes; host staging shrinks to byte packing + hashlib digests)
+# ---------------------------------------------------------------------------
+
+_L_INT = (1 << 252) + 27742317777372353535851937790883648493
+_C_INT = _L_INT - (1 << 252)  # 125 bits
+_C_L12 = [(_C_INT >> (12 * i)) & 0xFFF for i in range(11)]
+_L_L12 = [(_L_INT >> (12 * i)) & 0xFFF for i in range(NLIMB)]
+
+
+def _mult_of_l_geq(x: int) -> int:
+    return ((x + _L_INT - 1) // _L_INT) * _L_INT
+
+
+# positive fold offsets (see ops/sha512_np.py): after fold k the value is
+# bounded by 2^386 / 2^260 / 2^254, so M_k >= C * max(hi_k) keeps it
+# positive.  hi_1 <= 2^260, hi_2 <= 2^134, hi_3 <= 2^8.
+_M_OFFS = [_mult_of_l_geq(_C_INT << 260), _mult_of_l_geq(_C_INT << 134),
+           _mult_of_l_geq(_C_INT << 8)]
+_SROWS = 33  # 33 * 12 = 396 bits >= the 2^386 fold-1 bound
+
+
+def _bytes_to_limbs12(bN, nlimbs):
+    """(NB, T) int32 byte rows -> (nlimbs, T) radix-2^12 limbs (full
+    value, no sign masking)."""
+    nbytes = bN.shape[0]
+    rows = []
+    for i in range(nlimbs):
+        if i % 2 == 0:
+            b0 = (3 * i) // 2
+            v = bN[b0 : b0 + 1]
+            if b0 + 1 < nbytes:
+                v = v | ((bN[b0 + 1 : b0 + 2] & 0x0F) << 8)
+        else:
+            b0 = (3 * i - 1) // 2
+            v = bN[b0 : b0 + 1] >> 4
+            if b0 + 1 < nbytes:
+                v = v | (bN[b0 + 1 : b0 + 2] << 4)
+        rows.append(v)
+    return jnp.concatenate(rows, axis=0)
+
+
+def _scalar_carry(rows_list):
+    """Exact carry over a list of (1, T) nonnegative rows; values < 2^31.
+    Returns the list with limbs in [0, 2^12)."""
+    out = []
+    carry = None
+    for r in rows_list:
+        v = r if carry is None else r + carry
+        out.append(v & MASK)
+        carry = v >> RADIX
+    return out
+
+
+def _mod_l(dig_limbs):
+    """(43, T) radix-2^12 limbs of a 512-bit value -> (NLIMB, T) canonical
+    limbs mod L.  Positive-offset folds (2^252 ≡ -C mod L) exactly as the
+    host-side ops/sha512_np.py, then <= 4 conditional subtracts of L."""
+    rows = [dig_limbs[i : i + 1] for i in range(dig_limbs.shape[0])]
+    for m in _M_OFFS:
+        # split at bit 252 = limb 21 boundary (252 = 21 * 12)
+        lo = rows[:21]
+        hi = rows[21:]
+        acc = [None] * _SROWS
+        for j in range(_SROWS):
+            mj = (m >> (12 * j)) & 0xFFF
+            base = lo[j] if j < 21 else None
+            if base is None:
+                acc[j] = jnp.full_like(rows[0], mj) if mj else \
+                    jnp.zeros_like(rows[0])
+            else:
+                acc[j] = base + mj
+        # acc -= C * hi  (11x|hi| schoolbook, scalar python-int C limbs)
+        for i in range(11):
+            ci = _C_L12[i]
+            if ci == 0:
+                continue
+            for j, h in enumerate(hi):
+                if i + j < _SROWS:
+                    acc[i + j] = acc[i + j] - ci * h
+        rows = _scalar_carry(acc)
+    rows = rows[:NLIMB]
+    # conditional subtracts: value < M_3 + 2^252 < 5L
+    for _ in range(4):
+        ge = None
+        decided = None
+        for i in range(NLIMB - 1, -1, -1):
+            li = _L_L12[i]
+            gt = rows[i] > li
+            lt = rows[i] < li
+            if ge is None:
+                ge, decided = gt, gt | lt
+            else:
+                ge = ge | (~decided & gt)
+                decided = decided | gt | lt
+        ge = (ge | ~decided).astype(_i32)  # equal -> subtract
+        # signed intermediates are fine: & MASK / >> RADIX are exact
+        # two's-complement splits and the total stays nonnegative
+        rows = _scalar_carry([rows[i] - ge * _L_L12[i]
+                              for i in range(NLIMB)])
+    return jnp.concatenate(rows, axis=0)
+
+
+def _digits_from_limbs(limbs):
+    """(NLIMB, T) radix-2^12 limbs of a scalar < 2^253 -> (64, T) balanced
+    radix-16 digits in [-8, 7], least-significant first.  Closed form:
+    t = s + 0x88..8 (64 eights); digit_j = nibble_j(t) - 8 (see
+    ops/ed25519.py scalars_to_digits)."""
+    rows = [limbs[i : i + 1] + 0x888 for i in range(NLIMB)]
+    # t may reach 2^256: carry exactly; the two carry bits above limb 21
+    # land in nibbles 64+ and are discarded (they encode t's top bits,
+    # which the 64-digit window never reads).
+    rows = _scalar_carry(rows)
+    digs = []
+    for j in range(64):
+        limb, sh = divmod(4 * j, 12)
+        digs.append(((rows[limb] >> sh) & 0xF) - 8)
+    return jnp.concatenate(digs, axis=0)
+
+
+# ---------------------------------------------------------------------------
 # the kernel
 # ---------------------------------------------------------------------------
 
@@ -324,12 +446,14 @@ def _gather9(digit, table_rows):
     return acc
 
 
-def _verify_tile(consts, pub_b, r_b, s_ref, k_ref, one, zero):
+def _verify_tile(consts, pub_b, r_b, digit_ref, one, zero):
     """consts: (NLIMB, 128) packed constant columns; pub_b, r_b: (32, T)
-    i32 bytes; s_ref, k_ref: (64, T) int8 digit REFS (row-indexed
-    dynamically inside the ladder loop — Mosaic supports dynamic slices
-    on refs, not on values); one, zero: (NLIMB, T) scratch-laundered
-    constants (see _kernel).  Returns (1, T) int32 ok mask."""
+    i32 bytes; digit_ref: (128, T) int32 scratch REF holding the s digits
+    (rows 0..63) and k digits (rows 64..127), written by _kernel before
+    this runs (the ladder row-indexes it dynamically — Mosaic supports
+    dynamic slices on refs, not on values); one, zero: (NLIMB, T)
+    scratch-laundered constants (see _kernel).  Returns (1, T) int32 ok
+    mask."""
     T = pub_b.shape[1]
 
     def cst(col):
@@ -426,8 +550,8 @@ def _verify_tile(consts, pub_b, r_b, s_ref, k_ref, one, zero):
         tile, so load an aligned (8, T) digit block per outer iteration
         and unroll the 8 positions statically."""
         off = pl.multiple_of((7 - g) * 8, 8)
-        s8 = s_ref[pl.ds(off, 8), :].astype(_i32)
-        k8 = k_ref[pl.ds(off, 8), :].astype(_i32)
+        s8 = digit_ref[pl.ds(off, 8), :]
+        k8 = digit_ref[pl.ds(64 + off, 8), :]
         for j in range(7, -1, -1):
             p = step(p, s8[j : j + 1], k8[j : j + 1])
         return p
@@ -445,8 +569,8 @@ def _verify_tile(consts, pub_b, r_b, s_ref, k_ref, one, zero):
     return (decode_ok & r_eq).astype(_i32)
 
 
-def _kernel(const_ref, pub_ref, r_ref, s_ref, k_ref, out_ref,
-            one_scr, zero_scr):
+def _kernel(const_ref, pub_ref, r_ref, s_ref, dig_ref, out_ref,
+            one_scr, zero_scr, digit_scr):
     consts = const_ref[:]
     pub_b = pub_ref[:].astype(_i32) & 0xFF
     r_b = r_ref[:].astype(_i32) & 0xFF
@@ -461,27 +585,37 @@ def _kernel(const_ref, pub_ref, r_ref, s_ref, k_ref, out_ref,
                                   (NLIMB, T))
     zero_scr[:] = jnp.broadcast_to(consts[:, _COL_ZERO : _COL_ZERO + 1],
                                    (NLIMB, T))
-    ok = _verify_tile(consts, pub_b, r_b, s_ref, k_ref,
+    # device-side scalar staging: s digits straight from the 32 scalar
+    # bytes; k = SHA-512 digest (64 bytes) reduced mod L, then digits.
+    s_b = s_ref[:].astype(_i32) & 0xFF
+    dig_b = dig_ref[:].astype(_i32) & 0xFF
+    digit_scr[0:64, :] = _digits_from_limbs(_bytes_to_limbs12(s_b, NLIMB))
+    digit_scr[64:128, :] = _digits_from_limbs(
+        _mod_l(_bytes_to_limbs12(dig_b, 43)))
+    ok = _verify_tile(consts, pub_b, r_b, digit_scr,
                       one_scr[:], zero_scr[:])  # (1, T)
     out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
 
 
 @partial(jax.jit, static_argnames=("tile",))
-def verify_staged_pallas(pub, r, s_digits, k_digits, tile: int = 512):
+def verify_staged_pallas(pub_t, r_t, s_t, d_t, tile: int = 512):
     """Batched verify via the fused Pallas kernel.
 
-    pub, r: (B, 32) uint8; s_digits, k_digits: (B, 64) int8 (the compact
-    staging layout of ops.ed25519.prepare_batch).  B must be a multiple of
+    LANE-MAJOR inputs (transposed on the host — int8 transposes on TPU
+    relayout through sublane shuffles and cost ~4x the whole kernel):
+    pub_t, r_t, s_t: (32, B) int8/uint8; d_t: (64, B) raw SHA-512 digests
+    of R || A || M (the staging layout of
+    ops.ed25519.prepare_batch_compact — mod-L reduction and radix-16
+    digit decomposition happen on-device).  B must be a multiple of
     `tile`.  Returns (B,) bool.
     """
-    B = pub.shape[0]
+    B = pub_t.shape[1]
     assert B % tile == 0, (B, tile)
     grid = (B // tile,)
-    # transpose to lane-major for the kernel
-    pub_t = pub.T.astype(jnp.int8)   # (32, B)
-    r_t = r.T.astype(jnp.int8)
-    s_t = s_digits.T                  # (64, B) i8
-    k_t = k_digits.T
+    pub_t = pub_t.astype(jnp.int8)
+    r_t = r_t.astype(jnp.int8)
+    s_t = s_t.astype(jnp.int8)
+    d_t = d_t.astype(jnp.int8)
     out = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((8, B), _i32),
@@ -493,7 +627,7 @@ def verify_staged_pallas(pub, r, s_digits, k_digits, tile: int = 512):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((32, tile), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((64, tile), lambda i: (0, i),
+            pl.BlockSpec((32, tile), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((64, tile), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
@@ -501,6 +635,7 @@ def verify_staged_pallas(pub, r, s_digits, k_digits, tile: int = 512):
         out_specs=pl.BlockSpec((8, tile), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((NLIMB, tile), _i32),
-                        pltpu.VMEM((NLIMB, tile), _i32)],
-    )(jnp.asarray(_CONSTS_PACKED), pub_t, r_t, s_t, k_t)
+                        pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((128, tile), _i32)],
+    )(jnp.asarray(_CONSTS_PACKED), pub_t, r_t, s_t, d_t)
     return out[0].astype(jnp.bool_)

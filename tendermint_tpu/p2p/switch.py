@@ -94,7 +94,10 @@ class Peer:
 
 class Switch:
     def __init__(self, node_key: NodeKey, listen_addr: str, network: str,
-                 moniker: str = "", version: str = "0.1.0"):
+                 moniker: str = "", version: str = "0.1.0",
+                 metrics_registry=None):
+        from tendermint_tpu.libs.metrics import P2PMetrics
+        self._metrics = P2PMetrics(metrics_registry)
         self.node_key = node_key
         self.listen_addr = listen_addr
         self.network = network
@@ -266,6 +269,7 @@ class Switch:
         peer_box[0] = peer
         with self._lock:
             self.peers[peer.id] = peer
+            self._metrics.peers.set(len(self.peers))
         # introduce the peer to every reactor BEFORE the recv thread can
         # dispatch its messages (sends queue until mconn.start drains
         # them), so no reactor ever receives from an unknown peer
@@ -279,6 +283,7 @@ class Switch:
     def stop_peer_for_error(self, peer: Peer, reason):
         with self._lock:
             existing = self.peers.pop(peer.id, None)
+            self._metrics.peers.set(len(self.peers))
         if existing is None:
             return
         peer.stop()

@@ -102,6 +102,19 @@ class RPCServer:
             def do_GET(self):
                 u = urlparse(self.path)
                 method = u.path.strip("/")
+                if method == "metrics":
+                    # Prometheus text exposition (reference serves this on
+                    # a dedicated Instrumentation listener,
+                    # node/node.go:959-962)
+                    from tendermint_tpu.libs.metrics import DEFAULT
+                    body = DEFAULT.render_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 params = {}
                 for k, v in parse_qsl(u.query):
                     if v in ("true", "false"):

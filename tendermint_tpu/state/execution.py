@@ -8,6 +8,8 @@ validate_block routes through the TPU batch plane
 """
 from __future__ import annotations
 
+import time
+
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -55,8 +57,11 @@ def validator_updates_to_validators(updates) -> List[Validator]:
 
 class BlockExecutor:
     def __init__(self, state_store, app: abci.Application, mempool=None,
-                 evidence_pool=None, event_bus=None, block_store=None):
+                 evidence_pool=None, event_bus=None, block_store=None,
+                 metrics_registry=None):
+        from tendermint_tpu.libs.metrics import StateMetrics
         self.state_store = state_store
+        self.metrics = StateMetrics(metrics_registry)
         self.app = app
         self.mempool = mempool
         self.evidence_pool = evidence_pool
@@ -171,6 +176,7 @@ class BlockExecutor:
 
     def apply_block(self, state: State, block_id: BlockID,
                     block: Block) -> Tuple[State, ABCIResponses]:
+        _t0 = time.perf_counter()
         self.validate_block(state, block)
 
         responses = self._exec_block_on_app(state, block)
@@ -202,6 +208,8 @@ class BlockExecutor:
 
         if self.event_bus is not None:
             self._fire_events(block, block_id, responses, validator_updates)
+        self.metrics.block_processing_time.observe(
+            time.perf_counter() - _t0)
         return new_state, responses
 
     def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:

@@ -45,10 +45,10 @@ def test_pallas_kernel_matches_oracle_interpret(interpret_pallas):
             msgs[i] = msgs[i] + b"!"
     sigs = [bytes(s) for s in sigs]
 
-    dev, host_ok = edops.prepare_batch(pubs, sigs, msgs)
+    dev, host_ok = edops.prepare_batch_compact(pubs, sigs, msgs)
     out = pe.verify_staged_pallas(
         jnp.asarray(dev["pub"]), jnp.asarray(dev["r"]),
-        jnp.asarray(dev["s_digits"]), jnp.asarray(dev["k_digits"]),
+        jnp.asarray(dev["s"]), jnp.asarray(dev["digest"]),
         tile=128)
     out = np.asarray(out) & host_ok
     expected = np.array([_edref.verify(p, m, s)

@@ -1,0 +1,120 @@
+"""v1 priority mempool tests (reference mempool/v1/mempool_test.go)."""
+import numpy as np
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.mempool.priority_mempool import PriorityMempool
+
+
+class PrioApp(abci.Application):
+    """CheckTx priority = first byte of the tx; sender = byte 1 (if the
+    tx is >= 2 bytes and byte 1 is nonzero)."""
+
+    def check_tx(self, req):
+        tx = req.tx
+        if not tx:
+            return abci.ResponseCheckTx(code=1, log="empty")
+        sender = ""
+        if len(tx) >= 2 and tx[1]:
+            sender = f"s{tx[1]}"
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK,
+                                    priority=tx[0], gas_wanted=1,
+                                    sender=sender)
+
+
+def tx(priority, sender=0, tag=b""):
+    return bytes([priority, sender]) + tag
+
+
+def test_reap_orders_by_priority_then_fifo():
+    mp = PriorityMempool(PrioApp())
+    mp.check_tx(tx(5, tag=b"a"))
+    mp.check_tx(tx(9, tag=b"b"))
+    mp.check_tx(tx(5, tag=b"c"))
+    mp.check_tx(tx(7, tag=b"d"))
+    reaped = mp.reap_max_bytes_max_gas(-1, -1)
+    assert [t[0] for t in reaped] == [9, 7, 5, 5]
+    # FIFO within equal priority
+    assert reaped[2][2:] == b"a" and reaped[3][2:] == b"c"
+
+
+def test_eviction_of_lower_priority_when_full():
+    mp = PriorityMempool(PrioApp(), size_limit=3)
+    mp.check_tx(tx(1, tag=b"low1"))
+    mp.check_tx(tx(2, tag=b"low2"))
+    mp.check_tx(tx(8, tag=b"high"))
+    assert mp.size() == 3
+    # higher priority than the floor: evicts the lowest (priority 1)
+    res = mp.check_tx(tx(5, tag=b"mid"))
+    assert res.is_ok()
+    assert mp.size() == 3
+    prios = sorted(t[0] for t in mp.reap_max_txs(-1))
+    assert prios == [2, 5, 8]
+    # lower priority than everything resident: rejected
+    res = mp.check_tx(tx(1, tag=b"lower"))
+    assert not res.is_ok()
+    assert mp.size() == 3
+
+
+def test_sender_exclusivity():
+    mp = PriorityMempool(PrioApp())
+    assert mp.check_tx(tx(5, sender=7, tag=b"x")).is_ok()
+    res = mp.check_tx(tx(6, sender=7, tag=b"y"))
+    assert not res.is_ok() and "sender" in res.log
+    # after commit of the first, the sender slot frees up
+    mp.lock()
+    try:
+        mp.update(1, [tx(5, sender=7, tag=b"x")])
+    finally:
+        mp.unlock()
+    assert mp.check_tx(tx(6, sender=7, tag=b"y")).is_ok()
+
+
+def test_update_removes_committed_and_rechecks():
+    class DropAfterHeight(PrioApp):
+        def __init__(self):
+            self.drop = False
+
+        def check_tx(self, req):
+            if self.drop and req.type == abci.CheckTxType.RECHECK:
+                return abci.ResponseCheckTx(code=1, log="stale")
+            return super().check_tx(req)
+
+    app = DropAfterHeight()
+    mp = PriorityMempool(app)
+    mp.check_tx(tx(3, tag=b"keep"))
+    mp.check_tx(tx(4, tag=b"gone"))
+    app.drop = True
+    mp.lock()
+    try:
+        mp.update(2, [tx(3, tag=b"keep")])
+    finally:
+        mp.unlock()
+    # committed tx removed; survivor failed recheck and was dropped
+    assert mp.size() == 0
+
+
+def test_reap_respects_byte_and_gas_caps():
+    mp = PriorityMempool(PrioApp())
+    for i in range(10):
+        mp.check_tx(tx(10 - i, tag=bytes(8)))
+    # each tx is 10 bytes + 20 overhead = 30; cap at 3 txs worth
+    reaped = mp.reap_max_bytes_max_gas(95, -1)
+    assert len(reaped) == 3
+    assert [t[0] for t in reaped] == [10, 9, 8]
+    reaped = mp.reap_max_bytes_max_gas(-1, 4)
+    assert len(reaped) == 4
+
+
+def test_node_uses_v1_when_configured(tmp_path):
+    import argparse
+    from tendermint_tpu.cmd.__main__ import cmd_init
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    home = str(tmp_path / "n0")
+    cmd_init(argparse.Namespace(home=home, chain_id="prio-chain"))
+    cfg = Config.load(home)
+    cfg.mempool.version = "v1"
+    node = Node(cfg, KVStoreApplication(), in_memory=True)
+    assert isinstance(node.mempool, PriorityMempool)
