@@ -1,10 +1,12 @@
 """WAL replay console (reference consensus/replay_file.go): step through a
-consensus WAL message by message, printing the evolving round state —
-`tendermint_tpu replay` (all at once) and `replay-console` (interactive).
+consensus WAL message by message — `tendermint_tpu replay` (all at once)
+and `replay-console` (interactive).
 
-The console drives a REAL consensus state machine (same code path as crash
-recovery) with gossip/ticker side effects disconnected, so what it shows is
-exactly what the node would reconstruct.
+The console decodes and pretty-prints the WAL frame stream (message type,
+height/round, origin) with single-stepping and run-to-boundary controls; it
+does not re-execute the state machine — crash-recovery semantics are
+exercised by the WAL catchup replay itself (consensus/state.py
+_catchup_replay, tests/test_consensus.py).
 """
 from __future__ import annotations
 

@@ -136,3 +136,168 @@ def _trails_from_byte_slices(items):
     right_root.parent = root
     right_root.left = left_root.hash
     return lefts + rights, root
+
+
+# ---------------------------------------------------------------------------
+# multi-op proofs: chained merkle trees (reference crypto/merkle/proof_op.go,
+# proof_value.go, proof_key_path.go) — e.g. IAVL value -> store root ->
+# app hash, verified by the light client RPC proxy
+# ---------------------------------------------------------------------------
+
+class ProofError(Exception):
+    pass
+
+
+@dataclass
+class ProofOp:
+    """Wire form of one operator (reference proto tendermint/crypto
+    ProofOp)."""
+    type: str
+    key: bytes
+    data: bytes
+
+
+def key_path_to_keys(path: str) -> List[bytes]:
+    """Reference proof_key_path.go:87 — '/' separated, 'x:' hex parts,
+    URL-escaped raw parts."""
+    import binascii
+    from urllib.parse import unquote_to_bytes
+
+    if not path or path[0] != "/":
+        raise ProofError("key path must start with '/'")
+    keys = []
+    for i, part in enumerate(path[1:].split("/")):
+        if part.startswith("x:"):
+            try:
+                keys.append(binascii.unhexlify(part[2:]))
+            except (binascii.Error, ValueError) as e:
+                raise ProofError(f"bad hex part #{i}: {part}") from e
+        else:
+            keys.append(unquote_to_bytes(part))
+    return keys
+
+
+def key_path_append(path: str, key: bytes, hex_encode: bool = False) -> str:
+    from urllib.parse import quote_from_bytes
+    part = f"x:{key.hex()}" if hex_encode else quote_from_bytes(key)
+    return path + "/" + part
+
+
+class ValueOp:
+    """Leaf operator: proves value under key in a simple merkle tree of
+    length-prefixed KV pairs (reference proof_value.go)."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def get_key(self) -> bytes:
+        return self.key
+
+    def run(self, args: List[bytes]) -> List[bytes]:
+        if len(args) != 1:
+            raise ProofError(f"ValueOp expects 1 arg, got {len(args)}")
+        from tendermint_tpu.libs.protoenc import uvarint
+
+        vhash = _sha256(args[0])
+        kv = (uvarint(len(self.key)) + self.key
+              + uvarint(len(vhash)) + vhash)
+        if leaf_hash(kv) != self.proof.leaf_hash:
+            raise ProofError("leaf hash mismatch")
+        root = self.proof.compute_root()
+        if root is None:
+            raise ProofError("invalid proof structure")
+        return [root]
+
+    def proof_op(self) -> ProofOp:
+        from tendermint_tpu.libs import protoenc as pe
+        body = (pe.varint_field(1, self.proof.total)
+                + pe.varint_field(2, self.proof.index)
+                + pe.bytes_field(3, self.proof.leaf_hash)
+                + pe.repeated_bytes_field(4, self.proof.aunts))
+        return ProofOp(self.TYPE, self.key, pe.message_field_always(1, body))
+
+    @classmethod
+    def decode(cls, pop: ProofOp) -> "ValueOp":
+        from tendermint_tpu.libs import protodec as pd
+        f = pd.parse(pop.data)
+        body = pd.get_message(f, 1)
+        if body is None:
+            raise ProofError("ValueOp missing proof")
+        pf = pd.parse(body)
+        proof = Proof(total=pd.get_int(pf, 1, 0), index=pd.get_int(pf, 2, 0),
+                      leaf_hash=pd.get_bytes(pf, 3),
+                      aunts=pd.get_messages(pf, 4))
+        return cls(pop.key, proof)
+
+
+class ProofOperators(list):
+    """Reference proof_op.go:30-69: apply operators in sequence, consuming
+    the keypath last-to-first, and match the final root."""
+
+    def verify(self, root: bytes, keypath: str, args: List[bytes]) -> None:
+        keys = key_path_to_keys(keypath)
+        for i, op in enumerate(self):
+            key = op.get_key()
+            if key:
+                if not keys:
+                    raise ProofError(
+                        f"key path exhausted at op #{i} (key {key!r})")
+                if keys[-1] != key:
+                    raise ProofError(
+                        f"key mismatch at op #{i}: {keys[-1]!r} != {key!r}")
+                keys = keys[:-1]
+            args = op.run(args)
+        if args[0] != root:
+            raise ProofError(
+                f"root mismatch: {args[0].hex()} != {root.hex()}")
+        if keys:
+            raise ProofError("keypath not fully consumed")
+
+    def verify_value(self, root: bytes, keypath: str, value: bytes) -> None:
+        self.verify(root, keypath, [value])
+
+
+class ProofRuntime:
+    """Registry of op decoders (reference proof.go:180 ProofRuntime);
+    default knows ValueOp."""
+
+    def __init__(self):
+        self._decoders = {}
+
+    def register(self, type_: str, decoder):
+        self._decoders[type_] = decoder
+
+    def decode(self, pops: List[ProofOp]) -> ProofOperators:
+        out = ProofOperators()
+        for pop in pops:
+            dec = self._decoders.get(pop.type)
+            if dec is None:
+                raise ProofError(f"unknown proof op type {pop.type!r}")
+            out.append(dec(pop))
+        return out
+
+    def verify_value(self, pops: List[ProofOp], root: bytes, keypath: str,
+                     value: bytes) -> None:
+        self.decode(pops).verify_value(root, keypath, value)
+
+
+def default_proof_runtime() -> ProofRuntime:
+    rt = ProofRuntime()
+    rt.register(ValueOp.TYPE, ValueOp.decode)
+    return rt
+
+
+def proofs_from_kv_map(kvs: dict):
+    """(root, {key: ValueOp}) over a map of key -> value, with KV leaves
+    hashed as <len-prefixed key, len-prefixed sha256(value)> in sorted-key
+    order (reference proof.go ProofsFromMap + kvpair semantics)."""
+    from tendermint_tpu.libs.protoenc import uvarint
+
+    keys = sorted(kvs)
+    leaves = [uvarint(len(k)) + k + uvarint(32) + _sha256(kvs[k])
+              for k in keys]
+    root, proofs = proofs_from_byte_slices(leaves)
+    return root, {k: ValueOp(k, proofs[i]) for i, k in enumerate(keys)}

@@ -199,7 +199,7 @@ class ConsensusMetrics:
         self.round_duration = reg.histogram(
             "consensus", "round_duration_seconds",
             "Time spent in a round.",
-            buckets=exp_buckets(0.1, 100 ** (1 / 8), 9))
+            buckets=exp_buckets(0.1, (100 / 0.1) ** (1 / 8), 9))
         self.validators = reg.gauge("consensus", "validators",
                                     "Number of validators.")
         self.validators_power = reg.gauge(

@@ -78,17 +78,21 @@ class PriorityMempool:
     # -- admission (reference mempool/v1/mempool.go:441-545) ---------------
 
     def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        def reject(res):
+            self.metrics.failed_txs.inc()
+            return res
+
         if len(tx) > self.max_tx_bytes:
-            return abci.ResponseCheckTx(code=1, log="tx too large")
+            return reject(abci.ResponseCheckTx(code=1, log="tx too large"))
         if not self.cache.push(tx):
-            return abci.ResponseCheckTx(code=1, log="tx already in cache")
-        admitted = False
+            return reject(abci.ResponseCheckTx(
+                code=1, log="tx already in cache"))
         with self._lock:
             res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
             if not res.is_ok():
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
-                return res
+                return reject(res)
             key = tx_hash(tx)
             if key in self._txs:
                 return res
@@ -96,26 +100,22 @@ class PriorityMempool:
             # per declared sender
             if res.sender and res.sender in self._by_sender:
                 self.cache.remove(tx)
-                return abci.ResponseCheckTx(
-                    code=1, log=f"sender {res.sender} has tx in mempool")
+                return reject(abci.ResponseCheckTx(
+                    code=1, log=f"sender {res.sender} has tx in mempool"))
             if not self._make_room(len(tx), res.priority):
                 self.cache.remove(tx)
-                return abci.ResponseCheckTx(
-                    code=1, log="mempool is full and tx priority too low")
+                return reject(abci.ResponseCheckTx(
+                    code=1, log="mempool is full and tx priority too low"))
             wtx = _WrappedTx(tx, key, self._height, res.gas_wanted,
                              res.priority, res.sender, next(self._order))
             self._txs[key] = wtx
             if res.sender:
                 self._by_sender[res.sender] = key
             self._bytes += len(tx)
-            admitted = True
-        if admitted:
-            self.metrics.size.set(self.size())
-            self.metrics.tx_size_bytes.observe(len(tx))
-            for fn in self._notify:
-                fn()
-        elif not res.is_ok():
-            self.metrics.failed_txs.inc()
+        self.metrics.size.set(self.size())
+        self.metrics.tx_size_bytes.observe(len(tx))
+        for fn in self._notify:
+            fn()
         return res
 
     def _make_room(self, need_bytes: int, priority: int) -> bool:

@@ -150,6 +150,10 @@ class Node:
         self.block_indexer = BlockIndexer(ix_db)
         self.indexer_service = IndexerService(
             self.tx_indexer, self.block_indexer, self.event_bus)
+        if cfg.mempool.version not in ("v0", "v1"):
+            raise NodeError(
+                f"unknown mempool version {cfg.mempool.version!r} "
+                "(expected 'v0' or 'v1')")
         if cfg.mempool.version == "v1":
             from tendermint_tpu.mempool.priority_mempool import \
                 PriorityMempool

@@ -14,6 +14,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from tendermint_tpu.libs.flowrate import Monitor
+
 from .secret_connection import SecretConnection
 
 _MSG = 0x01
@@ -23,6 +25,12 @@ _PONG = 0x03
 PING_INTERVAL = 10.0
 PONG_TIMEOUT = 45.0
 MAX_MSG_SIZE = 32 * 1024 * 1024
+# Per-connection send/recv byte-rate caps (reference
+# p2p/conn/connection.go:43-44 defaults 500 KB/s; raised 10x here — the
+# batch-verifying data plane sustains much higher replay throughput and
+# the cap exists for fairness, not protection).
+DEFAULT_SEND_RATE = 5_120_000
+DEFAULT_RECV_RATE = 5_120_000
 
 
 @dataclass
@@ -36,8 +44,12 @@ class MConnection:
     def __init__(self, conn: SecretConnection,
                  channels: List[ChannelDescriptor],
                  on_receive: Callable[[int, bytes], None],
-                 on_error: Callable[[Exception], None]):
+                 on_error: Callable[[Exception], None],
+                 send_rate: int = DEFAULT_SEND_RATE,
+                 recv_rate: int = DEFAULT_RECV_RATE):
         self.conn = conn
+        self.send_monitor = Monitor(send_rate)
+        self.recv_monitor = Monitor(recv_rate)
         self.on_receive = on_receive
         self.on_error = on_error
         self._chans: Dict[int, ChannelDescriptor] = {c.id: c for c in channels}
@@ -107,6 +119,7 @@ class MConnection:
                     continue
                 cid, msg = item
                 self.conn.send_frame(bytes([_MSG, cid]) + msg)
+                self.send_monitor.update(len(msg) + 2)
         except Exception as e:  # noqa: BLE001
             self._fail(e)
 
@@ -116,6 +129,7 @@ class MConnection:
                 frame = self.conn.recv_frame()
                 if not frame:
                     continue
+                self.recv_monitor.update(len(frame))
                 kind = frame[0]
                 if kind == _PING:
                     self.conn.send_frame(bytes([_PONG]))

@@ -397,9 +397,12 @@ class PexReactor(Reactor):
     """Reference p2p/pex/pex_reactor.go."""
 
     def __init__(self, book: AddrBook, ensure_period_s: float = 30.0,
-                 target_out_peers: int = 10, seeds: str = ""):
+                 target_out_peers: int = 10, seeds: str = "",
+                 trust_store=None):
         super().__init__("PEX")
+        from tendermint_tpu.p2p.trust import TrustMetricStore
         self.book = book
+        self.trust = trust_store or TrustMetricStore()
         self.ensure_period_s = ensure_period_s
         self.target_out_peers = target_out_peers
         self.seeds = [s.strip() for s in seeds.split(",") if s.strip()]
@@ -413,6 +416,12 @@ class PexReactor(Reactor):
     def get_channels(self):
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
                                   send_queue_capacity=10)]
+
+    def remove_peer(self, peer: Peer, reason):
+        """Switch error-path feedback into the trust metric (reference
+        trust store usage in p2p)."""
+        if reason is not None:
+            self.trust.get(peer.id).bad_events()
 
     def start(self):
         self._thread = threading.Thread(target=self._ensure_peers_routine,
@@ -538,11 +547,19 @@ class PexReactor(Reactor):
                 break
             if ka.node_id in sw.peers or ka.is_bad(time.time()):
                 continue
+            # distrusted peers (EWMA of dial failures/disconnect errors,
+            # reference p2p/trust + pex ranking) are skipped until their
+            # metric recovers
+            if self.trust.peer_trust(ka.node_id) < 0.2:
+                continue
             self.book.mark_attempt(ka.node_id)
             peer = sw.dial_peer(f"{ka.node_id}@{ka.addr}")
             if peer is not None:
                 self.book.mark_good(peer.id)
+                self.trust.get(peer.id).good_events()
                 need -= 1
+            else:
+                self.trust.get(ka.node_id).bad_events()
         with sw._lock:
             peers = list(sw.peers.values())
         if not peers and self.seeds:

@@ -1,0 +1,120 @@
+"""Flowrate, fuzzed connection, trust metric, armor tests (reference
+libs/flowrate, p2p/fuzz.go, p2p/trust/metric.go, crypto/armor)."""
+import random
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.armor import (ArmorError, decode_armor,
+                                         encode_armor,
+                                         encrypt_armor_priv_key,
+                                         unarmor_decrypt_priv_key)
+from tendermint_tpu.libs.flowrate import Monitor
+from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+
+def test_flowrate_limits_throughput():
+    m = Monitor(limit_bytes_per_s=50_000)
+    t0 = time.monotonic()
+    for _ in range(10):
+        m.update(10_000)  # 100 KB at 50 KB/s -> >= ~1s after burst credit
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.8, elapsed
+    assert m.total() == 100_000
+
+
+def test_flowrate_unlimited_is_fast():
+    m = Monitor(limit_bytes_per_s=0)
+    t0 = time.monotonic()
+    for _ in range(100):
+        m.update(1 << 20)
+    assert time.monotonic() - t0 < 0.5
+
+
+class _PipeConn:
+    def __init__(self):
+        self.sent = []
+        self.inbox = []
+
+    def send_frame(self, data):
+        self.sent.append(data)
+
+    def recv_frame(self):
+        return self.inbox.pop(0)
+
+    def close(self):
+        pass
+
+
+def test_fuzzed_connection_drops_frames():
+    inner = _PipeConn()
+    fz = FuzzedConnection(inner, FuzzConnConfig(
+        prob_drop_rw=0.5, prob_sleep=0.0), rng=random.Random(42))
+    for i in range(200):
+        fz.send_frame(b"x%d" % i)
+    assert 0 < len(inner.sent) < 200
+    assert fz.dropped_frames == 200 - len(inner.sent)
+    # recv: dropped frames are skipped, later ones delivered
+    inner.inbox = [b"a", b"b", b"c", b"d", b"e", b"f"]
+    got = fz.recv_frame()
+    assert got in (b"a", b"b", b"c", b"d", b"e", b"f")
+
+
+def test_fuzz_start_after_grace():
+    inner = _PipeConn()
+    fz = FuzzedConnection(inner, FuzzConnConfig(
+        prob_drop_rw=1.0, start_after_s=60.0), rng=random.Random(1))
+    fz.send_frame(b"hello")  # within grace: no fuzzing
+    assert inner.sent == [b"hello"]
+
+
+def test_trust_metric_declines_on_bad_events():
+    tm = TrustMetric(interval_s=0.05, max_history=4)
+    assert tm.value() == pytest.approx(1.0)
+    tm.bad_events(10)
+    v1 = tm.value()
+    assert v1 < 1.0
+    time.sleep(0.06)
+    tm.bad_events(10)
+    v2 = tm.value()
+    assert v2 < 0.9
+    # recovery with good events
+    for _ in range(6):
+        time.sleep(0.06)
+        tm.good_events(20)
+    assert tm.value() > v2
+
+
+def test_trust_store():
+    st = TrustMetricStore()
+    assert st.peer_trust("unknown") == 1.0
+    st.get("p1").bad_events(5)
+    assert st.peer_trust("p1") < 1.0
+    assert st.size() == 1
+
+
+def test_armor_round_trip_and_crc():
+    text = encode_armor("TEST BLOCK", {"k": "v"}, b"\x00\x01\xFFdata" * 40)
+    bt, headers, data = decode_armor(text)
+    assert bt == "TEST BLOCK" and headers == {"k": "v"}
+    assert data == b"\x00\x01\xFFdata" * 40
+    # corrupt one base64 char -> CRC failure
+    lines = text.splitlines()
+    for i, ln in enumerate(lines):
+        if ln and not ln.startswith(("-", "=", "k")):
+            lines[i] = ("B" if ln[0] != "B" else "C") + ln[1:]
+            break
+    with pytest.raises(ArmorError):
+        decode_armor("\n".join(lines))
+
+
+def test_encrypt_armor_priv_key():
+    priv = bytes(range(32))
+    armored = encrypt_armor_priv_key(priv, "hunter2", key_type="ed25519")
+    assert "TENDERMINT PRIVATE KEY" in armored
+    assert "kdf: scrypt" in armored
+    out, kt = unarmor_decrypt_priv_key(armored, "hunter2")
+    assert out == priv and kt == "ed25519"
+    with pytest.raises(ArmorError):
+        unarmor_decrypt_priv_key(armored, "wrong-pass")

@@ -1,0 +1,62 @@
+"""Multi-op merkle proofs (reference crypto/merkle/proof_op.go,
+proof_value.go, proof_key_path.go)."""
+import pytest
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.merkle import (ProofError, ProofOperators,
+                                          ValueOp, default_proof_runtime,
+                                          key_path_append, key_path_to_keys,
+                                          proofs_from_kv_map)
+
+
+def test_key_path_round_trip():
+    path = key_path_append(key_path_append("", b"store"), b"\x01\xff",
+                           hex_encode=True)
+    assert path == "/store/x:01ff"
+    assert key_path_to_keys(path) == [b"store", b"\x01\xff"]
+    with pytest.raises(ProofError):
+        key_path_to_keys("no-slash")
+
+
+def test_value_op_proves_kv_membership():
+    kvs = {b"a": b"1", b"b": b"2", b"c": b"3", b"k" * 30: b"v" * 100}
+    root, ops = proofs_from_kv_map(kvs)
+    for k, v in kvs.items():
+        ProofOperators([ops[k]]).verify_value(
+            root, key_path_append("", k, hex_encode=True), v)
+    # wrong value fails
+    with pytest.raises(ProofError):
+        ProofOperators([ops[b"a"]]).verify_value(
+            root, key_path_append("", b"a", hex_encode=True), b"WRONG")
+    # wrong key in path fails
+    with pytest.raises(ProofError):
+        ProofOperators([ops[b"a"]]).verify_value(
+            root, key_path_append("", b"b", hex_encode=True), b"1")
+
+
+def test_chained_trees_verify_to_app_hash():
+    """Two chained trees: value in a store tree, store root in an app-level
+    tree — the multi-op path the light client RPC proxy uses."""
+    store_kvs = {b"balance": b"100", b"nonce": b"7"}
+    store_root, store_ops = proofs_from_kv_map(store_kvs)
+    app_kvs = {b"bank": store_root, b"staking": b"\xAA" * 32}
+    app_hash, app_ops = proofs_from_kv_map(app_kvs)
+
+    keypath = key_path_append(
+        key_path_append("", b"bank"), b"balance", hex_encode=True)
+    ops = ProofOperators([store_ops[b"balance"], app_ops[b"bank"]])
+    ops.verify_value(app_hash, keypath, b"100")
+    with pytest.raises(ProofError):
+        ops.verify_value(app_hash, keypath, b"101")
+
+
+def test_runtime_decodes_wire_ops():
+    kvs = {b"x": b"y"}
+    root, ops = proofs_from_kv_map(kvs)
+    pop = ops[b"x"].proof_op()
+    rt = default_proof_runtime()
+    rt.verify_value([pop], root, key_path_append("", b"x", hex_encode=True),
+                    b"y")
+    pop2 = merkle.ProofOp("unknown:v", b"x", b"")
+    with pytest.raises(ProofError):
+        rt.verify_value([pop2], root, "/x:78", b"y")
