@@ -46,7 +46,10 @@ class Monitor:
                 if self._bucket < 0:
                     sleep_for = -self._bucket / self.limit
         if sleep_for > 0:
-            time.sleep(min(sleep_for, 1.0))
+            # sleep the FULL deficit: capping here would let oversized
+            # updates (e.g. 32 MB frames vs a 5 MB/s limit) stream faster
+            # than the configured rate while the debt grows unboundedly
+            time.sleep(sleep_for)
 
     def rate(self) -> float:
         with self._lock:

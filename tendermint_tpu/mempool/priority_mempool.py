@@ -85,8 +85,8 @@ class PriorityMempool:
         if len(tx) > self.max_tx_bytes:
             return reject(abci.ResponseCheckTx(code=1, log="tx too large"))
         if not self.cache.push(tx):
-            return reject(abci.ResponseCheckTx(
-                code=1, log="tx already in cache"))
+            # routine gossip duplicate — not a failure (v0 parity)
+            return abci.ResponseCheckTx(code=1, log="tx already in cache")
         with self._lock:
             res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
             if not res.is_ok():

@@ -154,26 +154,35 @@ def carry(c):
       * one lazy add/sub of loose values: |limb| < 2L
       * schoolbook column sums: 22 * (2L)^2 = 1.87e9, plus the < 8.2e7
         fold-first term in _reduce_wide, < 2^31.
-    THREE passes suffice for any int32 input (exact max-abs interval
-    propagation, machine-checked by
+    TWO full passes + a limb0 tail pass suffice for any int32 input
+    (exact max-abs interval propagation, machine-checked by
     tests/test_field.py::test_carry_pass_count_proof):
       pass 1: carries <= 2^19 in-limb; folds <= 19*2048 at limb 0,
               19*(2^16+) at limb 1              -> limbs < 1.78e6
       pass 2: carries <= 434; fold <= 19*2048   -> limb0 < 43k, rest loose
-      pass 3: carries <= 11;  fold <= 19*17     -> limbs < 4418 < L
+      tail:   split limb0 only; carry <= 11 into limb 1 -> loose
     Bounds are regression-checked (tests/test_field.py::test_carry_bounds).
     """
-    return _carry_pass(_carry_pass(_carry_pass(c)))
+    return _tail_pass(_carry_pass(_carry_pass(c)))
+
+
+def _tail_pass(v):
+    """Final cheap pass touching only limbs 0/1: after the full passes
+    only limb 0 (which absorbs the 19*co folds) can exceed the loose
+    bound."""
+    c0 = v[0] >> RADIX
+    v = v.at[0].set(v[0] & MASK)
+    return v.at[1].add(c0)
 
 
 def carry_lazy(c):
     """carry() for inputs already bounded by |limb| <= 3L + 2^10 = 14848
     — any three-term sum/difference of loose-carried values (the curve
     formulas' worst case is g - c = (b - a) - 2*zsq with all four terms
-    loose, e.g. ops/curve.py dbl).  TWO passes suffice (machine-checked
-    alongside the generic proof in
+    loose, e.g. ops/curve.py dbl).  ONE pass + the limb0 tail suffices
+    (machine-checked alongside the generic proof in
     tests/test_field.py::test_carry_pass_count_proof)."""
-    return _carry_pass(_carry_pass(c))
+    return _tail_pass(_carry_pass(c))
 
 
 # ---------------------------------------------------------------------------

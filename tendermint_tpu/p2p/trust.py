@@ -42,9 +42,13 @@ class TrustMetric:
     def _maybe_roll(self):
         now = time.monotonic()
         while now - self._interval_start >= self.interval_s:
-            self._history.append(self._proportional())
+            p = self._proportional()
+            self._history.append(p)
             if len(self._history) > self.max_history:
                 self._history.pop(0)
+            # derivative anchor: previous interval's closing ratio (NOT
+            # mutated on reads — value() must be a pure observation)
+            self._last_value = p
             self._good = 0.0
             self._bad = 0.0
             self._interval_start += self.interval_s
@@ -63,25 +67,34 @@ class TrustMetric:
                 / sum(weights))
 
     def value(self) -> float:
-        """Trust in [0, 1] (reference calcTrustValue)."""
+        """Trust in [0, 1] (reference calcTrustValue).  Pure read: the
+        derivative compares the current interval's ratio against the
+        PREVIOUS interval's closing ratio (updated only on interval
+        roll), so repeated reads are stable."""
         with self._lock:
             self._maybe_roll()
             p = self._proportional()
             i = self._integral()
             d = p - self._last_value
             deriv = 0.0 if d >= 0 else d  # only punish decline
-            v = max(0.0, min(1.0, 0.4 * p + 0.6 * i + 0.2 * deriv))
-            self._last_value = p
-            return v
+            return max(0.0, min(1.0, 0.4 * p + 0.6 * i + 0.2 * deriv))
 
 
 class TrustMetricStore:
     """Per-peer metric registry (reference p2p/trust/store.go); PEX asks
-    it when ranking addresses and the switch feeds it on peer errors."""
+    it when ranking addresses and the switch feeds it on peer errors.
+    Bounded: least-recently-touched metrics are evicted past max_size (a
+    churning PEX address space must not leak one metric per id ever
+    seen)."""
 
-    def __init__(self, interval_s: float = INTERVAL_S):
+    MAX_SIZE = 4096
+
+    def __init__(self, interval_s: float = INTERVAL_S,
+                 max_size: int = MAX_SIZE):
+        from collections import OrderedDict
         self.interval_s = interval_s
-        self._metrics: Dict[str, TrustMetric] = {}
+        self.max_size = max_size
+        self._metrics: "OrderedDict[str, TrustMetric]" = OrderedDict()
         self._lock = threading.Lock()
 
     def get(self, peer_id: str) -> TrustMetric:
@@ -90,6 +103,10 @@ class TrustMetricStore:
             if m is None:
                 m = TrustMetric(self.interval_s)
                 self._metrics[peer_id] = m
+                while len(self._metrics) > self.max_size:
+                    self._metrics.popitem(last=False)
+            else:
+                self._metrics.move_to_end(peer_id)
             return m
 
     def peer_trust(self, peer_id: str) -> float:
