@@ -1,0 +1,208 @@
+"""ctypes loader for the native host-staging library (native/staging.c).
+
+pybind11 is not available in this image, so the native runtime components
+are plain C compiled to a shared object at first use (cached next to the
+source, keyed by a source hash) and called through ctypes with numpy
+buffers.  Every entry point has a pure-Python/numpy fallback so the
+framework still works where no C toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "staging.c")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> str | None:
+    """Compile staging.c -> cached .so; returns path or None on failure."""
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+    except OSError:
+        return None
+    import platform
+
+    # tag = source + arch: -march=native output must never be shared
+    # across machine types (SIGILL on a host missing the build ISA)
+    tag = hashlib.sha256(
+        src + platform.machine().encode()).hexdigest()[:16]
+    so = os.path.join(_NATIVE_DIR, f"_staging_{tag}.so")
+    if os.path.exists(so):
+        return so
+    # per-process tmp name: concurrent first-use builders (multi-process
+    # localnet, test workers) must not interleave writes before the
+    # atomic publish
+    tmp = f"{so}.{os.getpid()}.tmp"
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-march=native", "-fPIC", "-shared",
+                 "-o", tmp, _SRC],
+                capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, so)
+            return so
+    return None
+
+
+def get_lib():
+    """The loaded CDLL, or None if unavailable (no toolchain / failed)."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        so = _build()
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                u64p = ctypes.POINTER(ctypes.c_uint64)
+                u64 = ctypes.c_uint64
+                lib.tm_sha512_prefixed.argtypes = [u8p, u8p, u64, u8p, u64]
+                lib.tm_sha512_batch.argtypes = [u8p, u8p, u64p, u8p, u64]
+                lib.tm_sha512_plain.argtypes = [u8p, u64p, u8p, u64]
+                lib.tm_scalar_canonical.argtypes = [u8p, u8p, u64]
+                lib.tm_mod_l.argtypes = [u8p, u8p, u64]
+                lib.tm_challenge_prefixed.argtypes = [u8p, u8p, u64, u8p, u64]
+                lib.tm_challenge_batch.argtypes = [u8p, u8p, u64p, u8p, u64]
+                for fn in (lib.tm_sha512_prefixed, lib.tm_sha512_batch,
+                           lib.tm_sha512_plain, lib.tm_scalar_canonical,
+                           lib.tm_mod_l, lib.tm_challenge_prefixed,
+                           lib.tm_challenge_batch):
+                    fn.restype = None
+                _lib = lib
+            except OSError:
+                _lib = None
+        _tried = True
+        return _lib
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def sha512_prefixed(prefix: np.ndarray, msgs, out: np.ndarray | None = None
+                    ) -> np.ndarray | None:
+    """digest[i] = SHA-512(prefix[i] || msg[i]) for a whole batch.
+
+    prefix: (B, 64) uint8 contiguous.  msgs: (B, mlen) uint8 array
+    (fixed-width fast path) or a list of bytes (variable width).
+    Returns (B, 64) uint8, or None when the native library is missing
+    (caller falls back to hashlib).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    B = prefix.shape[0]
+    assert prefix.dtype == np.uint8 and prefix.shape == (B, 64) \
+        and prefix.flags.c_contiguous
+    if out is None:
+        out = np.empty((B, 64), dtype=np.uint8)
+    if isinstance(msgs, np.ndarray):
+        msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+        assert msgs.shape[0] == B
+        lib.tm_sha512_prefixed(_u8p(prefix), _u8p(msgs),
+                               ctypes.c_uint64(msgs.shape[1]), _u8p(out),
+                               ctypes.c_uint64(B))
+        return out
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
+    offsets = np.zeros(B + 1, dtype=np.uint64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)
+    lib.tm_sha512_batch(_u8p(prefix), _u8p(buf), _u64p(offsets), _u8p(out),
+                        ctypes.c_uint64(B))
+    return out
+
+
+def sha512_plain(msgs) -> np.ndarray | None:
+    """Batched SHA-512 over a list of bytes / (B, mlen) array."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if isinstance(msgs, np.ndarray):
+        msgs = [bytes(m) for m in msgs]
+    B = len(msgs)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
+    offsets = np.zeros(B + 1, dtype=np.uint64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)
+    out = np.empty((B, 64), dtype=np.uint8)
+    lib.tm_sha512_plain(_u8p(buf), _u64p(offsets), _u8p(out),
+                        ctypes.c_uint64(B))
+    return out
+
+
+def mod_l(digests: np.ndarray) -> np.ndarray | None:
+    """(B, 64) uint8 LE 512-bit values -> (B, 32) canonical mod-L scalars."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    digests = np.ascontiguousarray(digests, dtype=np.uint8)
+    B = digests.shape[0]
+    out = np.empty((B, 32), dtype=np.uint8)
+    lib.tm_mod_l(_u8p(digests), _u8p(out), ctypes.c_uint64(B))
+    return out
+
+
+def challenge_scalars(prefix: np.ndarray, msgs) -> np.ndarray | None:
+    """k[i] = SHA-512(prefix[i] || msg[i]) mod L for a whole batch (fused
+    in C: digest never round-trips through Python).  Returns (B, 32)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    B = prefix.shape[0]
+    assert prefix.dtype == np.uint8 and prefix.shape == (B, 64) \
+        and prefix.flags.c_contiguous
+    out = np.empty((B, 32), dtype=np.uint8)
+    if isinstance(msgs, np.ndarray):
+        msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+        assert msgs.shape[0] == B
+        lib.tm_challenge_prefixed(_u8p(prefix), _u8p(msgs),
+                                  ctypes.c_uint64(msgs.shape[1]), _u8p(out),
+                                  ctypes.c_uint64(B))
+        return out
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
+    offsets = np.zeros(B + 1, dtype=np.uint64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)
+    lib.tm_challenge_batch(_u8p(prefix), _u8p(buf), _u64p(offsets),
+                           _u8p(out), ctypes.c_uint64(B))
+    return out
+
+
+def scalar_canonical(s_bytes: np.ndarray) -> np.ndarray | None:
+    """Vectorized s < L over (B, 32) uint8 scalars; bool (B,) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    s_bytes = np.ascontiguousarray(s_bytes, dtype=np.uint8)
+    B = s_bytes.shape[0]
+    out = np.empty(B, dtype=np.uint8)
+    lib.tm_scalar_canonical(_u8p(s_bytes), _u8p(out), ctypes.c_uint64(B))
+    return out.astype(bool)

@@ -109,6 +109,11 @@ def _to_u8_matrix(rows, width):
 def _s_canonical(s_bytes: np.ndarray) -> np.ndarray:
     """Vectorized s < L check (Go: scMinimal): compare the four
     little-endian uint64 words against L's, most-significant first."""
+    from tendermint_tpu.libs import native
+
+    out = native.scalar_canonical(s_bytes)
+    if out is not None:
+        return out
     s_words = s_bytes.view("<u8")  # (B, 4)
     l_words = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8")
     B = s_bytes.shape[0]
@@ -122,14 +127,35 @@ def _s_canonical(s_bytes: np.ndarray) -> np.ndarray:
     return ok  # undecided = equal to L -> not ok
 
 
+def _as_fixed_width(msgs, B):
+    """Collapse a list of equal-length bytes into a (B, mlen) uint8 array
+    (the C staging's fixed-width fast path); pass arrays/ragged through."""
+    if isinstance(msgs, np.ndarray) or B == 0:
+        return msgs
+    if len(msgs[0]) == len(msgs[-1]) and \
+            all(len(m) == len(msgs[0]) for m in msgs):
+        return np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(B, -1)
+    return msgs
+
+
 def _sha512_digests(r_bytes, pubkeys, msgs) -> np.ndarray:
-    """(B, 64) uint8 SHA-512(R || A || M) digests via hashlib (OpenSSL's
-    C loop beats numpy lane hashing on short messages)."""
+    """(B, 64) uint8 SHA-512(R || A || M) digests.
+
+    Native batch path (libs/native.py -> native/staging.c): one C call for
+    the whole batch, no per-signature Python objects.  Fallback: hashlib
+    loop (OpenSSL) where no C toolchain exists."""
+    from tendermint_tpu.libs import native
+
     B = r_bytes.shape[0]
-    rp = np.concatenate([r_bytes, pubkeys], axis=1).tobytes()
+    prefix = np.concatenate([r_bytes, pubkeys], axis=1)
+    if native.get_lib() is not None:
+        out = native.sha512_prefixed(prefix, _as_fixed_width(msgs, B))
+        if out is not None:
+            return out
+    rp = prefix.tobytes()
     _sha = hashlib.sha512
     return np.frombuffer(b"".join(
-        _sha(rp[64 * i: 64 * i + 64] + msgs[i]).digest()
+        _sha(rp[64 * i: 64 * i + 64] + bytes(msgs[i])).digest()
         for i in range(B)), dtype=np.uint8).reshape(B, 64)
 
 
@@ -156,6 +182,41 @@ def prepare_batch_compact(pubkeys, sigs, msgs):
                s=np.ascontiguousarray(s_bytes.T).view(np.int8),
                digest=np.ascontiguousarray(digests.T).view(np.int8))
     return dev, host_ok
+
+
+def prepare_batch_packed(pubkeys, sigs, msgs):
+    """Stage a verification batch as ONE lane-major (128, B) int8 array:
+    rows 0:32 pubkey bytes, 32:64 R bytes, 64:96 s bytes, 96:128 the
+    challenge scalar k = SHA-512(R || A || M) mod L (reduced on the host
+    by the native C staging; native/staging.c tm_challenge_*).
+
+    One array = one host->device transfer per round: the tunnel's
+    per-transfer latency is large and variable, and k at 32 bytes (vs the
+    64-byte raw digest) cuts payload 160 -> 128 B/sig.  Returns
+    (packed, host_ok)."""
+    from tendermint_tpu.libs import native
+
+    pubkeys = _to_u8_matrix(pubkeys, 32)
+    sigs = _to_u8_matrix(sigs, 64)
+    B = pubkeys.shape[0]
+    assert pubkeys.shape == (B, 32) and sigs.shape == (B, 64) \
+        and len(msgs) == B
+    r_bytes = np.ascontiguousarray(sigs[:, :32])
+    s_bytes = np.ascontiguousarray(sigs[:, 32:])
+    host_ok = _s_canonical(s_bytes)
+    prefix = np.concatenate([r_bytes, pubkeys], axis=1)
+    k = None
+    if native.get_lib() is not None:
+        k = native.challenge_scalars(prefix, _as_fixed_width(msgs, B))
+    if k is None:  # no C toolchain: hashlib + numpy fallback
+        from . import sha512_np
+        k = sha512_np.mod_l_batch(_sha512_digests(r_bytes, pubkeys, msgs))
+    packed = np.empty((128, B), dtype=np.uint8)
+    packed[0:32] = pubkeys.T
+    packed[32:64] = r_bytes.T
+    packed[64:96] = s_bytes.T
+    packed[96:128] = k.T
+    return packed.view(np.int8), host_ok
 
 
 def prepare_batch(pubkeys, sigs, msgs):
@@ -307,7 +368,7 @@ def verify_staged(pub, r, s_digits, k_digits):
 verify_kernel = jax.jit(verify_staged)
 
 
-PALLAS_TILE = 512  # best-measured batch tile for the fused TPU kernel
+PALLAS_TILE = 256  # best-measured batch tile for the fused TPU kernel
 
 
 def _use_pallas() -> bool:
@@ -345,15 +406,13 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     XLA kernel is used."""
     if _use_pallas():
         from . import pallas_ed25519 as pe
-        dev, host_ok = prepare_batch_compact(pubkeys, sigs, msgs)
+        packed, host_ok = prepare_batch_packed(pubkeys, sigs, msgs)
         n = host_ok.shape[0]
         nb = max(PALLAS_TILE, bucket_size(n))
         if nb != n:  # pad the trailing (lane) axis
-            dev = {k: np.pad(v, [(0, 0), (0, nb - n)]) for k, v in dev.items()}
-        out = pe.verify_staged_pallas(
-            jnp.asarray(dev["pub"]), jnp.asarray(dev["r"]),
-            jnp.asarray(dev["s"]), jnp.asarray(dev["digest"]),
-            tile=min(PALLAS_TILE, nb))
+            packed = np.pad(packed, [(0, 0), (0, nb - n)])
+        out = pe.verify_packed_pallas(jnp.asarray(packed),
+                                      tile=min(PALLAS_TILE, nb))
     else:
         dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
         n = host_ok.shape[0]

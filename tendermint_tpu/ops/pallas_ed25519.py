@@ -596,6 +596,55 @@ def _kernel(const_ref, pub_ref, r_ref, s_ref, dig_ref, out_ref,
     out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
 
 
+def _kernel_packed(const_ref, in_ref, out_ref, one_scr, zero_scr, digit_scr):
+    """Packed-input kernel: in_ref is (128, T) int8 — rows 0:32 pubkey,
+    32:64 R, 64:96 s, 96:128 k = SHA-512(R||A||M) mod L (host-reduced by
+    native/staging.c, so no on-device _mod_l pass and 32 fewer bytes per
+    signature on the wire)."""
+    consts = const_ref[:]
+    pub_b = in_ref[0:32, :].astype(_i32) & 0xFF
+    r_b = in_ref[32:64, :].astype(_i32) & 0xFF
+    s_b = in_ref[64:96, :].astype(_i32) & 0xFF
+    k_b = in_ref[96:128, :].astype(_i32) & 0xFF
+    T = in_ref.shape[1]
+    one_scr[:] = jnp.broadcast_to(consts[:, _COL_ONE : _COL_ONE + 1],
+                                  (NLIMB, T))
+    zero_scr[:] = jnp.broadcast_to(consts[:, _COL_ZERO : _COL_ZERO + 1],
+                                   (NLIMB, T))
+    digit_scr[0:64, :] = _digits_from_limbs(_bytes_to_limbs12(s_b, NLIMB))
+    digit_scr[64:128, :] = _digits_from_limbs(_bytes_to_limbs12(k_b, NLIMB))
+    ok = _verify_tile(consts, pub_b, r_b, digit_scr,
+                      one_scr[:], zero_scr[:])
+    out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def verify_packed_pallas(packed, tile: int = 512):
+    """Batched verify from the single packed (128, B) int8 staging array
+    (ops.ed25519.prepare_batch_packed).  B must be a multiple of `tile`.
+    Returns (B,) bool."""
+    B = packed.shape[1]
+    assert packed.shape[0] == 128 and B % tile == 0, (packed.shape, tile)
+    grid = (B // tile,)
+    out = pl.pallas_call(
+        _kernel_packed,
+        out_shape=jax.ShapeDtypeStruct((8, B), _i32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((NLIMB, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((128, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((128, tile), _i32)],
+    )(jnp.asarray(_CONSTS_PACKED), packed.astype(jnp.int8))
+    return out[0].astype(jnp.bool_)
+
+
 @partial(jax.jit, static_argnames=("tile",))
 def verify_staged_pallas(pub_t, r_t, s_t, d_t, tile: int = 512):
     """Batched verify via the fused Pallas kernel.

@@ -1,0 +1,112 @@
+"""Native C staging library (native/staging.c via libs/native.py).
+
+Oracle: hashlib (OpenSSL) for SHA-512, Python bignum for mod L — the same
+semantics as Go crypto/ed25519's challenge computation (reference
+crypto/ed25519/ed25519.go:148, SHA-512(R||A||M) then ScReduce).
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.libs import native
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="no C toolchain for native staging")
+
+
+def test_sha512_prefixed_matches_hashlib_across_block_boundaries():
+    rng = np.random.default_rng(0)
+    # lengths straddling every SHA-512 padding/block edge for 64B prefix
+    for mlen in (0, 1, 47, 48, 63, 64, 111, 112, 127, 128, 200, 300):
+        B = 9
+        prefix = np.ascontiguousarray(
+            rng.integers(0, 256, (B, 64), dtype=np.uint8))
+        msgs = [rng.integers(0, 256, mlen, dtype=np.uint8).tobytes()
+                for _ in range(B)]
+        got = native.sha512_prefixed(prefix, msgs)
+        exp = np.stack([np.frombuffer(
+            hashlib.sha512(prefix[i].tobytes() + msgs[i]).digest(),
+            dtype=np.uint8) for i in range(B)])
+        assert (got == exp).all(), mlen
+
+
+def test_sha512_prefixed_fixed_width_array_path():
+    rng = np.random.default_rng(1)
+    B, mlen = 33, 118
+    prefix = np.ascontiguousarray(
+        rng.integers(0, 256, (B, 64), dtype=np.uint8))
+    msgs = rng.integers(0, 256, (B, mlen), dtype=np.uint8)
+    got = native.sha512_prefixed(prefix, msgs)
+    exp = np.stack([np.frombuffer(
+        hashlib.sha512(prefix[i].tobytes() + msgs[i].tobytes()).digest(),
+        dtype=np.uint8) for i in range(B)])
+    assert (got == exp).all()
+
+
+def test_sha512_plain_and_variable_lengths():
+    rng = np.random.default_rng(2)
+    msgs = [rng.integers(0, 256, int(l), dtype=np.uint8).tobytes()
+            for l in rng.integers(0, 400, 40)]
+    got = native.sha512_plain(msgs)
+    exp = np.stack([np.frombuffer(hashlib.sha512(m).digest(), dtype=np.uint8)
+                    for m in msgs])
+    assert (got == exp).all()
+
+
+def test_mod_l_edge_cases_and_random():
+    rng = np.random.default_rng(3)
+    vals = [0, 1, L - 1, L, L + 1, 2 * L, 4 * L + 7, (1 << 512) - 1,
+            1 << 252, L << 259, (L - 1) << 259, (1 << 512) - 12345]
+    d = np.zeros((len(vals) + 64, 64), dtype=np.uint8)
+    for i, v in enumerate(vals):
+        d[i] = np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8)
+    d[len(vals):] = rng.integers(0, 256, (64, 64), dtype=np.uint8)
+    got = native.mod_l(d)
+    for i in range(d.shape[0]):
+        exp = int.from_bytes(d[i].tobytes(), "little") % L
+        assert int.from_bytes(got[i].tobytes(), "little") == exp, i
+
+
+def test_challenge_scalars_fused():
+    rng = np.random.default_rng(4)
+    B = 17
+    prefix = np.ascontiguousarray(
+        rng.integers(0, 256, (B, 64), dtype=np.uint8))
+    msgs = rng.integers(0, 256, (B, 30), dtype=np.uint8)
+    got = native.challenge_scalars(prefix, msgs)
+    for i in range(B):
+        dig = hashlib.sha512(prefix[i].tobytes() + msgs[i].tobytes()).digest()
+        assert int.from_bytes(got[i].tobytes(), "little") == \
+            int.from_bytes(dig, "little") % L
+
+
+def test_scalar_canonical():
+    vals = [0, 1, L - 1, L, L + 1, 2**256 - 1, 1 << 252, 12345]
+    s = np.stack([np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+                  for v in vals])
+    got = native.scalar_canonical(s)
+    assert list(got) == [v < L for v in vals]
+
+
+def test_prepare_batch_packed_roundtrip():
+    """Packed staging agrees with the reference staging layout."""
+    from tendermint_tpu.crypto import _edref
+    from tendermint_tpu.ops import ed25519 as edops
+
+    seeds = [i.to_bytes(32, "little") for i in range(1, 9)]
+    msgs = [b"packed staging %d" % i for i in range(8)]
+    pubs = [_edref.pubkey_from_seed(s) for s in seeds]
+    sigs = [_edref.sign(s, m) for s, m in zip(seeds, msgs)]
+    packed, ok = edops.prepare_batch_packed(pubs, sigs, msgs)
+    assert ok.all() and packed.shape == (128, 8)
+    pu = packed.view(np.uint8)
+    for i in range(8):
+        assert pu[0:32, i].tobytes() == pubs[i]
+        assert pu[32:64, i].tobytes() == sigs[i][:32]
+        assert pu[64:96, i].tobytes() == sigs[i][32:]
+        dig = hashlib.sha512(sigs[i][:32] + pubs[i] + msgs[i]).digest()
+        assert int.from_bytes(pu[96:128, i].tobytes(), "little") == \
+            int.from_bytes(dig, "little") % L
