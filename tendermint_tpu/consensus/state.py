@@ -46,7 +46,7 @@ from .round_types import (
 from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
 
 from .ticker import TimeoutTicker
-from .wal import WAL, EndHeightMessage
+from .wal import WAL, EndHeightMessage, WALCorruptionError
 
 
 class ConsensusState:
@@ -137,7 +137,19 @@ class ConsensusState:
 
     def start(self):
         if self.wal is not None:
-            self._catchup_replay()
+            try:
+                self._catchup_replay()
+            except WALCorruptionError:
+                raise  # repair/abort path: corrupted WAL is fatal
+            except Exception as e:
+                # reference consensus/state.go:330-332: non-corruption
+                # catchup errors are logged and the state starts anyway
+                # (e.g. a crash between block-save and the EndHeight
+                # fsync leaves the WAL one marker behind the handshake-
+                # recovered state; the handshake already applied the
+                # block, so there is nothing left to replay)
+                print(f"consensus[{self.name}]: catchup replay error, "
+                      f"proceeding to start state anyway: {e}", flush=True)
         self._stop.clear()
         self._thread = threading.Thread(target=self._receive_routine,
                                         name=f"consensus-{self.name}",

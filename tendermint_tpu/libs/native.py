@@ -34,8 +34,10 @@ def _build() -> str | None:
         return None
     import platform
 
-    # tag = source + arch: -march=native output must never be shared
-    # across machine types (SIGILL on a host missing the build ISA)
+    # Baseline ISA only (no -march=native): the kernels are scalar 64-bit
+    # integer code that gains nothing from vector extensions, and a cached
+    # .so shared across hosts of the same platform.machine() must never
+    # SIGILL on the weakest of them.
     tag = hashlib.sha256(
         src + platform.machine().encode()).hexdigest()[:16]
     so = os.path.join(_NATIVE_DIR, f"_staging_{tag}.so")
@@ -45,18 +47,24 @@ def _build() -> str | None:
     # localnet, test workers) must not interleave writes before the
     # atomic publish
     tmp = f"{so}.{os.getpid()}.tmp"
-    for cc in ("cc", "gcc", "clang"):
-        try:
-            r = subprocess.run(
-                [cc, "-O3", "-march=native", "-fPIC", "-shared",
-                 "-o", tmp, _SRC],
-                capture_output=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired):
-            continue
-        if r.returncode == 0:
-            os.replace(tmp, so)
-            return so
-    return None
+    try:
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                r = subprocess.run(
+                    [cc, "-O3", "-fPIC", "-shared", "-o", tmp, _SRC],
+                    capture_output=True, timeout=120)
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            if r.returncode == 0:
+                os.replace(tmp, so)
+                return so
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def get_lib():

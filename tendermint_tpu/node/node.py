@@ -72,10 +72,14 @@ def handshake(app, state: State, state_store: StateStore,
             f"store height {store_height}; reset the app or restore data")
     elif app_height < store_height:
         # replay stored blocks the app missed (replay.go:420-516); the
-        # in-process apps here persist nothing, so this is the restart path
+        # in-process apps here persist nothing, so this is the restart
+        # path.  The tail block is handled below (it may also need the
+        # STATE reconstructed), so replay the app only to store-1 here.
         import copy
         executor = BlockExecutor(None, app)
-        for h in range(app_height + 1, store_height + 1):
+        app_tail = store_height - 1 \
+            if state.last_block_height == store_height - 1 else store_height
+        for h in range(app_height + 1, app_tail + 1):
             block = block_store.load_block(h)
             if block is None:
                 raise NodeError(f"handshake: missing block {h}")
@@ -87,7 +91,69 @@ def handshake(app, state: State, state_store: StateStore,
                 replay_state.last_validators = lvals
             executor._exec_block_on_app(replay_state, block)
             app.commit()
+
+    # Tail-block state reconstruction (replay.go:284 decision table,
+    # storeHeight == stateHeight+1): a crash between the WAL EndHeight
+    # fsync and the state save leaves the state store one block behind
+    # the block store.  Rebuild state for the stored tip so consensus
+    # starts at tip+1 — otherwise catchupReplay correctly refuses with
+    # "WAL should not contain EndHeight" (reference replay.go:472-516).
+    store_height = block_store.height()
+    if state.last_block_height == store_height - 1 and store_height > 0:
+        state = _replay_tail_block(app, state, state_store, block_store,
+                                   store_height)
+    elif state.last_block_height < store_height - 1:
+        raise NodeError(
+            f"handshake: state height {state.last_block_height} is more "
+            f"than one block behind store height {store_height}")
     return state
+
+
+def _replay_tail_block(app, state: State, state_store: StateStore,
+                       block_store: BlockStore, h: int) -> State:
+    """Apply stored block h to the state (and to the app if it has not
+    committed it yet).  If the app already committed h, re-executing would
+    double-apply the txs, so the saved ABCI responses are used instead —
+    the reference's mock-proxy replay (replay.go:501-516)."""
+    import copy
+
+    from tendermint_tpu.state.execution import (
+        update_state, validator_updates_to_validators)
+    from tendermint_tpu.types.block import BlockID
+
+    block = block_store.load_block(h)
+    meta = block_store.load_block_meta(h)
+    if block is None or meta is None:
+        raise NodeError(f"handshake: missing tail block {h}")
+    info = app.info(RequestInfo())
+    app_height = getattr(info, "last_block_height", 0) or 0
+
+    replay_state = copy.copy(state)
+    lvals = state_store.load_validators(h - 1) if h > 1 else None
+    if lvals is not None:
+        replay_state.last_validators = lvals
+
+    executor = BlockExecutor(None, app)
+    if app_height == h:
+        responses = state_store.load_abci_responses(h)
+        if responses is None:
+            raise NodeError(
+                f"handshake: app committed block {h} but its ABCI "
+                f"responses were not persisted; cannot reconstruct state")
+        app_hash = getattr(info, "last_block_app_hash", b"") or b""
+    else:
+        responses = executor._exec_block_on_app(replay_state, block)
+        state_store.save_abci_responses(h, responses)
+        app_hash = app.commit().data
+
+    validator_updates = validator_updates_to_validators(
+        responses.end_block.validator_updates if responses.end_block else [])
+    block_id = BlockID(block.hash(), meta.block_id.part_set_header)
+    new_state = update_state(state, block_id, block, responses,
+                             validator_updates)
+    new_state.app_hash = app_hash
+    state_store.save(new_state)
+    return new_state
 
 
 class Node:

@@ -33,6 +33,8 @@ from jax.experimental.pallas import tpu as pltpu
 from . import curve as C
 from . import field as F
 
+DEFAULT_TILE = 256  # keep in lockstep with ops.ed25519.PALLAS_TILE
+
 RADIX = F.RADIX
 NLIMB = F.NLIMB
 MASK = F.MASK
@@ -619,7 +621,7 @@ def _kernel_packed(const_ref, in_ref, out_ref, one_scr, zero_scr, digit_scr):
 
 
 @partial(jax.jit, static_argnames=("tile",))
-def verify_packed_pallas(packed, tile: int = 512):
+def verify_packed_pallas(packed, tile: int = DEFAULT_TILE):
     """Batched verify from the single packed (128, B) int8 staging array
     (ops.ed25519.prepare_batch_packed).  B must be a multiple of `tile`.
     Returns (B,) bool."""
@@ -646,7 +648,7 @@ def verify_packed_pallas(packed, tile: int = 512):
 
 
 @partial(jax.jit, static_argnames=("tile",))
-def verify_staged_pallas(pub_t, r_t, s_t, d_t, tile: int = 512):
+def verify_staged_pallas(pub_t, r_t, s_t, d_t, tile: int = DEFAULT_TILE):
     """Batched verify via the fused Pallas kernel.
 
     LANE-MAJOR inputs (transposed on the host — int8 transposes on TPU

@@ -1,0 +1,117 @@
+"""Crash-recovery matrix over the planted fail points.
+
+The reference exercises its commit-path crash windows by killing the
+process at indexed `fail.Fail()` sites and asserting WAL + handshake
+replay recovers (reference consensus/replay_test.go crash matrix,
+libs/fail/fail.go:28-39).  Here: a single-validator node in a subprocess
+dies at each FAIL_TEST_INDEX juncture of the first commit — between
+block-save, WAL EndHeight fsync, ABCI-response save, app commit and
+state save (consensus/state.py fail points 10-12,
+state/execution.py 1-4) — then restarts from the same home dir and must
+make progress past the crashed height.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the child runs a real node (file WAL, SQLite stores, FilePV) until the
+# block store reaches the target height, then exits 0
+CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import tendermint_tpu
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.node import Node
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.types.basic import Timestamp
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+home, target = sys.argv[1], int(sys.argv[2])
+cfg = Config(home=home)
+cfg.p2p.laddr = "127.0.0.1:0"
+cfg.p2p.pex = False
+cfg.rpc.enabled = False
+c = cfg.consensus
+c.timeout_propose = c.timeout_prevote = c.timeout_precommit = 0.2
+c.timeout_propose_delta = c.timeout_prevote_delta = \
+    c.timeout_precommit_delta = 0.1
+c.timeout_commit = 0.05
+cfg.ensure_dirs()
+pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                             cfg.priv_validator_state_file())
+NodeKey.load_or_generate(cfg.node_key_file())
+if not os.path.exists(cfg.genesis_file()):
+    pub = pv.get_pub_key()
+    gdoc = GenesisDoc(chain_id="crash-matrix-chain",
+                      genesis_time=Timestamp(1700000000, 0),
+                      validators=[GenesisValidator(
+                          address=pub.address(), pub_key_type=pub.type_name,
+                          pub_key_bytes=pub.bytes(), power=10)])
+    with open(cfg.genesis_file(), "w") as f:
+        f.write(gdoc.to_json())
+
+node = Node(cfg, KVStoreApplication())
+node.start()
+deadline = time.time() + 60
+while time.time() < deadline:
+    if node.block_store.height() >= target:
+        node.stop()
+        sys.exit(0)
+    time.sleep(0.05)
+sys.exit(3)  # no progress
+"""
+
+
+def _run(home: str, target: int, fail_index: int | None):
+    env = dict(os.environ)
+    env.pop("FAIL_TEST_INDEX", None)
+    if fail_index is not None:
+        env["FAIL_TEST_INDEX"] = str(fail_index)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-c", CHILD.format(repo=REPO), home, str(target)],
+        env=env, capture_output=True, timeout=120)
+
+
+# 7 fail points per commit: consensus 10,11,12 then execution 1,2,3,4
+@pytest.mark.slow
+@pytest.mark.parametrize("fail_index", range(7))
+def test_crash_at_fail_point_then_recover(tmp_path, fail_index):
+    home = str(tmp_path / "node")
+
+    r = _run(home, target=3, fail_index=fail_index)
+    assert r.returncode == 77, (
+        f"expected death at fail point {fail_index}, rc={r.returncode}\n"
+        f"stderr: {r.stderr[-2000:].decode(errors='replace')}")
+
+    # restart without injection: WAL catchup + handshake replay must
+    # recover whatever the crash window left and keep committing
+    r = _run(home, target=3, fail_index=None)
+    assert r.returncode == 0, (
+        f"recovery after fail point {fail_index} failed rc={r.returncode}\n"
+        f"stderr: {r.stderr[-2000:].decode(errors='replace')}")
+
+
+@pytest.mark.slow
+def test_crash_matrix_double_restart(tmp_path):
+    """Crash at the first juncture, recover, then crash again at a later
+    juncture of a subsequent commit, and recover again."""
+    home = str(tmp_path / "node")
+    r = _run(home, target=3, fail_index=0)
+    assert r.returncode == 77
+    r = _run(home, target=3, fail_index=10)  # a later hit, height >= 2
+    assert r.returncode == 77
+    r = _run(home, target=4, fail_index=None)
+    assert r.returncode == 0, r.stderr[-2000:].decode(errors="replace")
