@@ -97,9 +97,19 @@ class KVStoreApplication(abci.Application):
     def end_block(self, height: int) -> abci.ResponseEndBlock:
         return abci.ResponseEndBlock(validator_updates=self.val_updates)
 
+    def _compute_app_hash(self) -> bytes:
+        """Hook for subclasses that derive the app hash differently
+        (ProvableKVStoreApplication uses the kv merkle root)."""
+        return struct.pack(">Q", self.size)
+
+    def _on_committed(self):
+        """Hook called once self.height/app_hash reflect the committed
+        state (after commit and after snapshot restore)."""
+
     def commit(self) -> abci.ResponseCommit:
-        self.app_hash = struct.pack(">Q", self.size)
+        self.app_hash = self._compute_app_hash()
         self.height += 1
+        self._on_committed()
         if self.snapshot_interval and self.height % self.snapshot_interval \
                 == 0:
             self._take_snapshot()
@@ -175,7 +185,57 @@ class KVStoreApplication(abci.Application):
                 reject_senders=[sender])
         self.size, self.height = size, height
         self.data, self.validators = data, validators
-        self.app_hash = struct.pack(">Q", self.size)
+        self.app_hash = self._compute_app_hash()
+        self._on_committed()
         self._restoring = None
         return abci.ResponseApplySnapshotChunk(
             result=abci.ResponseApplySnapshotChunk.ACCEPT)
+
+
+class ProvableKVStoreApplication(KVStoreApplication):
+    """kvstore whose app hash is the merkle root of its kv map and whose
+    Query(prove=True) serves ValueOp merkle proofs.
+
+    The reference kvstore hashes only its size (kvstore.go) and proves
+    nothing; this variant exists so the light rpc proxy's proof
+    verification path (reference light/rpc/client.go ABCIQuery +
+    crypto/merkle ProofOperators) runs against a real application."""
+
+    _committed = None  # (height, committed-data snapshot, root, proofs)
+    _pending = None
+
+    def _compute_app_hash(self) -> bytes:
+        from tendermint_tpu.crypto.merkle import proofs_from_kv_map
+        # snapshot the committed state: queries must answer and prove
+        # against what consensus committed, never the live map a
+        # concurrent deliver_tx is mutating (and the O(n log n) tree
+        # build runs once per block, not per query)
+        data = dict(self.data)
+        root, proofs = proofs_from_kv_map(data)
+        self._pending = (data, root, proofs)
+        return root
+
+    def _on_committed(self):
+        # self.height is final here, for both commit and snapshot restore
+        data, root, proofs = self._pending
+        self._committed = (self.height, data, root, proofs)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        snap = self._committed
+        if snap is None:
+            return super().query(req)
+        height, data, _root, proofs = snap
+        value = data.get(req.data, b"")
+        resp = abci.ResponseQuery(
+            code=abci.CODE_TYPE_OK, key=req.data, value=value,
+            log="exists" if value else "does not exist",
+            height=height)
+        if getattr(req, "prove", False) and value:
+            op = proofs.get(req.data)
+            if op is not None:
+                pop = op.proof_op()
+                resp.proof_ops = [(pop.type, pop.key, pop.data)]
+        # resp.height is the committed height h; the proof anchors to the
+        # app hash in header h+1 (verifier lag, reference
+        # light/rpc/client.go res.Height+1)
+        return resp

@@ -85,6 +85,10 @@ class ResponseQuery:
     value: bytes = b""
     height: int = 0
     codespace: str = ""
+    # merkle proof of (key, value) under the app hash, as (type, key, data)
+    # operator tuples (reference abci/types ResponseQuery.ProofOps;
+    # verified by crypto/merkle.ProofOperators in the light rpc proxy)
+    proof_ops: list = field(default_factory=list)
 
 
 @dataclass

@@ -197,6 +197,51 @@ def cmd_remote_signer(args):
         srv.stop()
 
 
+def cmd_light(args):
+    """Run a light-client-verifying RPC proxy against a full node
+    (reference cmd light.go + light/proxy)."""
+    from tendermint_tpu.libs.kvdb import MemDB, SQLiteDB
+    from tendermint_tpu.light.client import Client, TrustOptions
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.light.store import LightStore
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    primary = args.primary
+    chain_id = args.chain_id
+    if not chain_id:
+        st = HTTPClient(primary).status()
+        chain_id = st["node_info"]["network"]
+
+    if args.trusted_height:
+        opts = TrustOptions(args.trusted_height,
+                            bytes.fromhex(args.trusted_hash),
+                            period_s=args.trust_period)
+    else:
+        # trust the primary's current head (subjective initialization)
+        lb = HTTPProvider(chain_id, primary).light_block(0)
+        opts = TrustOptions(lb.height, lb.hash(),
+                            period_s=args.trust_period)
+        print(f"trusting current head {lb.height} "
+              f"({lb.hash().hex().upper()})")
+
+    db = SQLiteDB(os.path.join(_home(args), "light.db")) \
+        if args.home else MemDB()
+    client = Client(chain_id, opts, HTTPProvider(chain_id, primary),
+                    witnesses=[HTTPProvider(chain_id, w)
+                               for w in args.witnesses.split(",") if w],
+                    store=LightStore(db))
+    proxy = LightProxy(client, primary, args.laddr)
+    proxy.start()
+    print(f"light proxy for {chain_id} via {primary} "
+          f"serving on {proxy.laddr}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
 def cmd_abci_kvstore(args):
     """Run the example kvstore as a standalone ABCI server process
     (reference abci/cmd/abci-cli kvstore)."""
@@ -264,6 +309,18 @@ def main(argv=None):
                         help="run the kvstore app as an ABCI server")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
     sp.set_defaults(fn=cmd_abci_kvstore)
+
+    sp = sub.add_parser("light",
+                        help="light-client-verifying RPC proxy")
+    sp.add_argument("primary", help="primary node RPC addr (host:port)")
+    sp.add_argument("--laddr", default="127.0.0.1:8888")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--trusted-height", type=int, default=0)
+    sp.add_argument("--trusted-hash", default="")
+    sp.add_argument("--trust-period", type=float, default=86400 * 7)
+    sp.add_argument("--witnesses", default="",
+                    help="comma-separated witness RPC addrs")
+    sp.set_defaults(fn=cmd_light)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -37,9 +37,12 @@ def _build() -> str | None:
     # Baseline ISA only (no -march=native): the kernels are scalar 64-bit
     # integer code that gains nothing from vector extensions, and a cached
     # .so shared across hosts of the same platform.machine() must never
-    # SIGILL on the weakest of them.
+    # SIGILL on the weakest of them.  The flags are part of the cache tag
+    # so a flag change invalidates stale artifacts.
+    flags = ("-O3", "-fPIC", "-shared")
     tag = hashlib.sha256(
-        src + platform.machine().encode()).hexdigest()[:16]
+        src + platform.machine().encode()
+        + " ".join(flags).encode()).hexdigest()[:16]
     so = os.path.join(_NATIVE_DIR, f"_staging_{tag}.so")
     if os.path.exists(so):
         return so
@@ -51,7 +54,7 @@ def _build() -> str | None:
         for cc in ("cc", "gcc", "clang"):
             try:
                 r = subprocess.run(
-                    [cc, "-O3", "-fPIC", "-shared", "-o", tmp, _SRC],
+                    [cc, *flags, "-o", tmp, _SRC],
                     capture_output=True, timeout=120)
             except (OSError, subprocess.TimeoutExpired):
                 continue

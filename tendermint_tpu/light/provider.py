@@ -91,3 +91,51 @@ class NodeBackedProvider(Provider):
         if meta is None or commit is None or vals is None:
             raise LightBlockNotFound(f"no light block at {height}")
         return LightBlock(SignedHeader(meta.header, commit), vals)
+
+
+class HTTPProvider(Provider):
+    """Light-block provider over a full node's JSON-RPC (reference
+    light/provider/http/http.go): fetches the `light_block` route's
+    canonical-proto SignedHeader + ValidatorSet and validates internal
+    consistency before handing it to the light client."""
+
+    def __init__(self, chain_id: str, addr: str, timeout: float = 10.0):
+        from tendermint_tpu.rpc.client import HTTPClient
+
+        self._chain_id = chain_id
+        self.client = HTTPClient(addr, timeout=timeout)
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        import base64
+
+        from tendermint_tpu.rpc.client import RPCClientError
+        from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        try:
+            r = self.client.call("light_block",
+                                 **({"height": height} if height else {}))
+        except RPCClientError as e:
+            if "above" in str(e) or "no light block" in str(e) \
+                    or "no commit" in str(e):
+                raise LightBlockNotFound(str(e)) from e
+            raise ProviderError(str(e)) from e
+        try:
+            sh = SignedHeader.from_proto(
+                base64.b64decode(r["signed_header"]))
+            vals = ValidatorSet.from_proto(
+                base64.b64decode(r["validator_set"]))
+        except Exception as e:
+            raise BadLightBlockError(f"undecodable light block: {e}") from e
+        lb = LightBlock(sh, vals)
+        try:
+            lb.validate_basic(self._chain_id)
+        except Exception as e:
+            raise BadLightBlockError(f"invalid light block: {e}") from e
+        if height and sh.height != height:
+            raise BadLightBlockError(
+                f"asked height {height}, got {sh.height}")
+        return lb

@@ -69,6 +69,8 @@ class RPCServer:
             "tx": self.tx,
             "tx_search": self.tx_search,
             "block_search": self.block_search,
+            "light_block": self.light_block,
+            "block_proto": self.block_proto,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -371,7 +373,42 @@ class RPCServer:
             prove=bool(prove)))
         return {"response": {
             "code": r.code, "log": r.log, "key": _b64(r.key or b""),
-            "value": _b64(r.value or b""), "height": r.height}}
+            "value": _b64(r.value or b""), "height": r.height,
+            "proof_ops": [{"type": t, "key": _b64(k), "data": _b64(d)}
+                          for (t, k, d) in
+                          (getattr(r, "proof_ops", None) or [])]}}
+
+    def light_block(self, height=None):
+        """Canonical-proto light block for light-client providers
+        (reference light/provider/http fetches signed header + validator
+        set over RPC; here both ride one call as canonical bytes so the
+        provider verifies exactly what consensus signed)."""
+        from tendermint_tpu.types.light_block import SignedHeader
+
+        store = self.node.block_store
+        h = _int_arg(height, store.height())
+        meta = store.load_block_meta(h)
+        vals = self.node.state_store.load_validators(h)
+        if meta is None or vals is None:
+            raise RPCError(-32603, f"no light block at height {h}")
+        canonical = h < store.height()
+        com = store.load_block_commit(h) if canonical \
+            else store.load_seen_commit(h)
+        if com is None:
+            raise RPCError(-32603, f"no commit at height {h}")
+        sh = SignedHeader(meta.header, com)
+        return {"height": h,
+                "signed_header": _b64(sh.proto()),
+                "validator_set": _b64(vals.proto())}
+
+    def block_proto(self, height=None):
+        """Canonical-proto block bytes (hash-verifiable against a light
+        client's verified header)."""
+        h = _int_arg(height, self.node.block_store.height())
+        block = self.node.block_store.load_block(h)
+        if block is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {"height": h, "block": _b64(block.proto())}
 
     def broadcast_evidence(self, evidence=None):
         from tendermint_tpu.types.evidence import evidence_from_proto
