@@ -197,10 +197,151 @@ def cmd_remote_signer(args):
         srv.stop()
 
 
+def cmd_rollback(args):
+    """Reference commands/rollback.go: overwrite state height n with a
+    state rebuilt from block n-1; the node then re-executes block n."""
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.state.rollback import rollback
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    cfg = Config(home=_home(args))
+    block_store = BlockStore(SQLiteDB(cfg.block_db_file()))
+    state_store = StateStore(SQLiteDB(cfg.state_db_file()))
+    height, app_hash = rollback(block_store, state_store)
+    print(f"Rolled back state to height {height} and "
+          f"hash {app_hash.hex().upper()}")
+
+
+def cmd_gen_validator(args):
+    """Reference commands/gen_validator.go: print a fresh validator key
+    (does NOT write any file)."""
+    from tendermint_tpu.crypto import ed25519 as edkeys
+
+    priv = edkeys.PrivKey.generate()
+    pub = priv.pub_key()
+    print(json.dumps({
+        "address": pub.address().hex().upper(),
+        "pub_key": {"type": pub.type_name, "value": pub.bytes().hex()},
+        "priv_key": {"type": pub.type_name, "value": priv.bytes().hex()},
+    }, indent=2))
+
+
+def cmd_gen_node_key(args):
+    """Reference commands/gen_node_key.go: write node_key.json if absent
+    and print the node id."""
+    from tendermint_tpu.p2p.key import NodeKey
+
+    cfg = Config(home=_home(args))
+    cfg.ensure_dirs()
+    nk = NodeKey.load_or_generate(cfg.node_key_file())
+    print(nk.node_id)
+
+
+def cmd_compact(args):
+    """Reference commands/compact.go: compact the node's databases (the
+    node must be stopped)."""
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+
+    cfg = Config(home=_home(args))
+    n = 0
+    for name in sorted(os.listdir(cfg.data_dir())):
+        if name.endswith(".db"):
+            path = os.path.join(cfg.data_dir(), name)
+            db = SQLiteDB(path)
+            db.compact()
+            db.close()
+            print(f"compacted {path}")
+            n += 1
+    print(f"compacted {n} databases")
+
+
+def cmd_reindex_event(args):
+    """Reference commands/reindex_event.go: rebuild the tx/block indexes
+    from stored blocks + ABCI responses over a height range."""
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.state.indexer import BlockIndexer, TxIndexer
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    cfg = Config(home=_home(args))
+    block_store = BlockStore(SQLiteDB(cfg.block_db_file()))
+    state_store = StateStore(SQLiteDB(cfg.state_db_file()))
+    ix_db = SQLiteDB(os.path.join(cfg.data_dir(), "tx_index.db"))
+    tx_ix, bl_ix = TxIndexer(ix_db), BlockIndexer(ix_db)  # shared, as Node
+    first = args.start_height or max(block_store.base(), 1)
+    last = args.end_height or block_store.height()
+    if first > last:
+        raise SystemExit(f"start height {first} > end height {last}")
+    n = 0
+    for h in range(first, last + 1):
+        block = block_store.load_block(h)
+        resp = state_store.load_abci_responses(h)
+        if block is None or resp is None:
+            print(f"skipping height {h}: missing block or responses")
+            continue
+        tx_ix.index_block_txs(h, block.data.txs, resp.deliver_txs or [])
+        bl_ix.index(h, getattr(resp.begin_block, "events", []) or [],
+                    getattr(resp.end_block, "events", []) or [])
+        n += 1
+    print(f"reindexed events for {n} heights in [{first}, {last}]")
+
+
+def cmd_debug_dump(args):
+    """Reference cmd debug dump: collect node status, consensus state,
+    net info, metrics, config and WAL into a tarball via the node's RPC
+    (the node keeps running)."""
+    import tarfile
+    import urllib.request
+
+    cfg = Config.load(_home(args))
+    cfg.home = _home(args)
+    out = os.path.abspath(args.output_file or
+                          f"tm-debug-{int(time.time())}.tar.gz")
+    rpc = args.rpc_laddr or cfg.rpc.laddr
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+
+    def fetch(route):
+        try:
+            with urllib.request.urlopen(f"http://{rpc}/{route}",
+                                        timeout=5) as r:
+                return r.read()
+        except Exception as e:
+            return json.dumps({"error": f"{route}: {e}"}).encode()
+
+    with tarfile.open(out, "w:gz") as tar:
+        def add_bytes(name, body):
+            import io
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tar.addfile(info, io.BytesIO(body))
+
+        for route in ("status", "consensus_state", "net_info",
+                      "num_unconfirmed_txs", "metrics"):
+            add_bytes(f"{route}.json", fetch(route))
+        cfg_file = os.path.join(cfg.home, "config", "config.toml")
+        if os.path.exists(cfg_file):
+            tar.add(cfg_file, arcname="config.toml")
+        wal_path = os.path.join(cfg.data_dir(), "cs.wal")
+        if os.path.exists(wal_path):  # autofile group dir or single file
+            tar.add(wal_path, arcname="cs.wal")
+    print(f"wrote debug dump to {out}")
+
+
+def cmd_debug_kill(args):
+    """Reference cmd debug kill: take a dump, then kill the node."""
+    import signal
+
+    cmd_debug_dump(args)
+    pid = args.pid
+    os.kill(pid, signal.SIGTERM)
+    print(f"sent SIGTERM to {pid}")
+
+
 def cmd_light(args):
     """Run a light-client-verifying RPC proxy against a full node
     (reference cmd light.go + light/proxy)."""
-    from tendermint_tpu.libs.kvdb import MemDB, SQLiteDB
+    from tendermint_tpu.libs.kvdb import SQLiteDB
     from tendermint_tpu.light.client import Client, TrustOptions
     from tendermint_tpu.light.proxy import LightProxy
     from tendermint_tpu.light.provider import HTTPProvider
@@ -225,8 +366,9 @@ def cmd_light(args):
         print(f"trusting current head {lb.height} "
               f"({lb.hash().hex().upper()})")
 
-    db = SQLiteDB(os.path.join(_home(args), "light.db")) \
-        if args.home else MemDB()
+    home = _home(args)
+    os.makedirs(home, exist_ok=True)
+    db = SQLiteDB(os.path.join(home, "light.db"))
     client = Client(chain_id, opts, HTTPProvider(chain_id, primary),
                     witnesses=[HTTPProvider(chain_id, w)
                                for w in args.witnesses.split(",") if w],
@@ -309,6 +451,36 @@ def main(argv=None):
                         help="run the kvstore app as an ABCI server")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
     sp.set_defaults(fn=cmd_abci_kvstore)
+
+    sp = sub.add_parser("rollback",
+                        help="roll the state back one height")
+    sp.set_defaults(fn=cmd_rollback)
+    sp = sub.add_parser("gen-validator",
+                        help="print a fresh validator key")
+    sp.set_defaults(fn=cmd_gen_validator)
+    sp = sub.add_parser("gen-node-key",
+                        help="write node_key.json and print the node id")
+    sp.set_defaults(fn=cmd_gen_node_key)
+    sp = sub.add_parser("compact", help="compact the node's databases")
+    sp.set_defaults(fn=cmd_compact)
+    sp = sub.add_parser("reindex-event",
+                        help="rebuild tx/block indexes from stored blocks")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+    sp = sub.add_parser("debug-dump",
+                        help="collect a diagnostic tarball from a "
+                             "running node")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_dump)
+    sp = sub.add_parser("debug-kill",
+                        help="collect a diagnostic tarball, then SIGTERM "
+                             "the node")
+    sp.add_argument("pid", type=int)
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_kill)
 
     sp = sub.add_parser("light",
                         help="light-client-verifying RPC proxy")

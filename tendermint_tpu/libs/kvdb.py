@@ -13,6 +13,10 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 
 class KVDB:
+    def compact(self):
+        """Reclaim storage (reference cmd compact.go / goleveldb
+        CompactRange); no-op unless the backend supports it."""
+
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
@@ -73,6 +77,12 @@ class MemDB(KVDB):
 
 class SQLiteDB(KVDB):
     """Durable single-file store; WAL mode for crash consistency."""
+
+    def compact(self):
+        with self._lock:
+            self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            self._conn.execute("VACUUM")
+            self._conn.commit()
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -28,8 +28,12 @@ def _register_defaults():
     from tendermint_tpu.types import (
         basic, block, commit, params, part_set, proposal, validator,
         validator_set, vote)
+    # imported for their @register side effects (evidence in stored
+    # blocks, light blocks in the light store)
+    from tendermint_tpu.types import evidence, light_block  # noqa: F401
     from tendermint_tpu.crypto import ed25519, merkle
     from tendermint_tpu.consensus import round_types, wal
+    from tendermint_tpu.state import execution
     from tendermint_tpu.state import state as sm_state
 
     for cls in (
@@ -48,7 +52,7 @@ def _register_defaults():
         ed25519.PubKey, ed25519.PrivKey,
         params.ConsensusParams, params.BlockParams, params.EvidenceParams,
         params.ValidatorParams, params.VersionParams,
-        sm_state.State,
+        sm_state.State, execution.ABCIResponses,
     ):
         register(cls)
     # every ABCI request/response dataclass (stored in SaveABCIResponses)

@@ -245,6 +245,15 @@ class LightProxy:
         wire = [ProofOp(p["type"], base64.b64decode(p["key"]),
                         base64.b64decode(p["data"])) for p in pops]
         key = base64.b64decode(resp.get("key") or "")
+        # the proof must be about the key the CLIENT asked for, not
+        # whatever key the primary chose to return: a malicious primary
+        # could otherwise serve a genuine proof for a different pair
+        want = bytes.fromhex(data) if data else b""
+        if want and key != want:
+            raise ProxyError(
+                -32603,
+                f"primary answered for key {key.hex()} instead of the "
+                f"requested {want.hex()}")
         value = base64.b64decode(resp.get("value") or "")
         keypath = "/x:" + key.hex()
         try:

@@ -1,0 +1,143 @@
+"""Operator CLI commands over a real node home dir (reference
+cmd/tendermint/commands: rollback, gen_validator, gen_node_key, compact,
+reindex_event, debug dump)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*argv, timeout=120):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd", *argv],
+        capture_output=True, cwd=REPO, env=env, timeout=timeout, text=True)
+
+
+@pytest.fixture(scope="module")
+def ran_home(tmp_path_factory):
+    """A home dir whose node committed a few blocks, then stopped."""
+    home = str(tmp_path_factory.mktemp("cli") / "node")
+    r = _cli("--home", home, "init")
+    assert r.returncode == 0, r.stderr
+    child = r"""
+import sys, time
+sys.path.insert(0, %r)
+import tendermint_tpu, jax
+jax.config.update("jax_platforms", "cpu")
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.node import Node
+cfg = Config.load(%r); cfg.home = %r
+cfg.p2p.laddr = "127.0.0.1:0"; cfg.rpc.laddr = "127.0.0.1:0"
+c = cfg.consensus
+c.timeout_propose = c.timeout_prevote = c.timeout_precommit = 0.2
+c.timeout_commit = 0.05
+node = Node(cfg, KVStoreApplication())
+node.start()
+node.mempool.check_tx(b"cli=tools")
+deadline = time.time() + 60
+while node.block_store.height() < 4 and time.time() < deadline:
+    time.sleep(0.05)
+node.stop()
+sys.exit(0 if node.block_store.height() >= 4 else 3)
+""" % (REPO, home, home)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return home
+
+
+def test_gen_validator():
+    r = _cli("gen-validator")
+    assert r.returncode == 0, r.stderr
+    d = json.loads(r.stdout)
+    assert len(bytes.fromhex(d["pub_key"]["value"])) == 32
+    # Go-style 64-byte ed25519 private key: seed || pubkey
+    assert len(bytes.fromhex(d["priv_key"]["value"])) == 64
+    assert bytes.fromhex(d["priv_key"]["value"])[32:] == \
+        bytes.fromhex(d["pub_key"]["value"])
+
+
+def test_gen_node_key(tmp_path):
+    home = str(tmp_path / "h")
+    r = _cli("--home", home, "gen-node-key")
+    assert r.returncode == 0, r.stderr
+    nid = r.stdout.strip()
+    assert len(nid) == 40
+    # idempotent: same id on the second run
+    r2 = _cli("--home", home, "gen-node-key")
+    assert r2.stdout.strip() == nid
+
+
+def test_rollback(ran_home):
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.state.store import StateStore
+
+    ss = StateStore(SQLiteDB(os.path.join(ran_home, "data", "state.db")))
+    before = ss.load().last_block_height
+    ss.db.close() if hasattr(ss, "db") else None
+
+    r = _cli("--home", ran_home, "rollback")
+    assert r.returncode == 0, r.stderr
+    assert f"height {before - 1}" in r.stdout
+
+    ss = StateStore(SQLiteDB(os.path.join(ran_home, "data", "state.db")))
+    assert ss.load().last_block_height == before - 1
+
+
+def test_reindex_event(ran_home):
+    # wipe the tx index, rebuild it, and find the tx again
+    ix = os.path.join(ran_home, "data", "tx_index.db")
+    for f in (ix, ix + "-wal", ix + "-shm"):
+        if os.path.exists(f):
+            os.remove(f)
+    r = _cli("--home", ran_home, "reindex-event")
+    assert r.returncode == 0, r.stderr
+    assert "reindexed events" in r.stdout
+
+    import hashlib
+
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.state.indexer import TxIndexer
+
+    tx_ix = TxIndexer(SQLiteDB(ix))
+    rec = tx_ix.get(hashlib.sha256(b"cli=tools").digest())
+    assert rec is not None, "reindexed tx not found"
+
+
+def test_compact(ran_home):
+    r = _cli("--home", ran_home, "compact")
+    assert r.returncode == 0, r.stderr
+    assert "compacted" in r.stdout
+    # stores still readable afterwards
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.store.block_store import BlockStore
+    bs = BlockStore(SQLiteDB(os.path.join(ran_home, "data",
+                                          "blockstore.db")))
+    assert bs.height() >= 4
+
+
+def test_debug_dump(ran_home, tmp_path):
+    out = str(tmp_path / "dump.tar.gz")
+    # node is stopped: RPC fetches degrade to error stubs, config + WAL
+    # still collected
+    r = _cli("--home", ran_home, "debug-dump", "--output-file", out,
+             "--rpc-laddr", "127.0.0.1:1")
+    assert r.returncode == 0, r.stderr
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert "config.toml" in names
+    assert any(n.startswith("cs.wal") for n in names)
+    assert "status.json" in names
