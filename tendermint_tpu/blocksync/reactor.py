@@ -86,6 +86,15 @@ class BlocksyncReactor(Reactor):
             threading.Thread(target=self._sync_routine, daemon=True).start()
             threading.Thread(target=self._status_routine, daemon=True).start()
 
+    def switch_to_blocksync(self, state):
+        """Adopt a statesync-bootstrapped state and sync the tail from it
+        (reference blocksync/reactor.go:110 SwitchToBlockSync: resets the
+        pool to state.LastBlockHeight+1).  Must be called before start()."""
+        self.state = state
+        self.fast_sync = True
+        self.pool = BlockPool(state.last_block_height + 1,
+                              self._send_request, self._peer_error)
+
     def stop(self):
         self._stop.set()
         self.pool.stop()

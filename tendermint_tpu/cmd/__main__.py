@@ -82,12 +82,25 @@ def cmd_replay(args):
 
 
 def _load_app(spec: str):
-    """`kvstore` (default), a socket address (`unix:///path` or
-    `tcp://host:port`) for an external ABCI app process, or
-    `module:factory` for an in-process Python app."""
-    if spec in ("", "kvstore"):
-        from tendermint_tpu.abci.kvstore import KVStoreApplication
-        return KVStoreApplication()
+    """`kvstore` / `kvstore-provable` (optionally with `@snapshots=N` to
+    take an app snapshot every N heights), a socket address
+    (`unix:///path` or `tcp://host:port`) for an external ABCI app
+    process, or `module:factory` for an in-process Python app."""
+    base, _, opt = spec.partition("@")
+    if base in ("", "kvstore", "kvstore-provable"):
+        from tendermint_tpu.abci.kvstore import (
+            KVStoreApplication, ProvableKVStoreApplication)
+        app = ProvableKVStoreApplication() if base == "kvstore-provable" \
+            else KVStoreApplication()
+        if opt:
+            if not opt.startswith("snapshots="):
+                raise SystemExit(
+                    f"unknown app option {opt!r} (supported: snapshots=N)")
+            try:
+                app.snapshot_interval = int(opt[len("snapshots="):])
+            except ValueError:
+                raise SystemExit(f"bad snapshots interval in {spec!r}")
+        return app
     if spec.startswith(("unix://", "tcp://")):
         from tendermint_tpu.proxy import AppConns, ClientCreator
         return AppConns(ClientCreator.remote(spec))

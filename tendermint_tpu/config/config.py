@@ -42,6 +42,17 @@ class BlockSyncConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """Reference config/config.go StateSyncConfig: bootstrap a fresh node
+    from an app snapshot verified through the light client."""
+    enable: bool = False
+    rpc_servers: str = ""      # comma-separated full-node RPC addrs
+    trust_height: int = 0
+    trust_hash: str = ""       # hex header hash at trust_height
+    trust_period: float = 86400.0 * 7
+
+
+@dataclass
 class BatchVerifierConfig:
     """TPU data-plane routing (no reference analog — the new component)."""
     tpu_threshold: int = 32
@@ -61,6 +72,7 @@ class Config:
     rpc: RPCConfig = field(default_factory=RPCConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     block_sync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    state_sync: StateSyncConfig = field(default_factory=StateSyncConfig)
     batch_verifier: BatchVerifierConfig = field(
         default_factory=BatchVerifierConfig)
 
@@ -129,6 +141,13 @@ enabled = {str(self.rpc.enabled).lower()}
 [block_sync]
 enable = {str(self.block_sync.enable).lower()}
 
+[state_sync]
+enable = {str(self.state_sync.enable).lower()}
+rpc_servers = "{self.state_sync.rpc_servers}"
+trust_height = {self.state_sync.trust_height}
+trust_hash = "{self.state_sync.trust_hash}"
+trust_period = {self.state_sync.trust_period}
+
 [batch_verifier]
 tpu_threshold = {self.batch_verifier.tpu_threshold}
 enable = {str(self.batch_verifier.enable).lower()}
@@ -175,6 +194,13 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
                             enabled=r.get("enabled", True))
         bs = d.get("block_sync", {})
         cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
+        ss = d.get("state_sync", {})
+        cfg.state_sync = StateSyncConfig(
+            enable=ss.get("enable", False),
+            rpc_servers=ss.get("rpc_servers", ""),
+            trust_height=ss.get("trust_height", 0),
+            trust_hash=ss.get("trust_hash", ""),
+            trust_period=float(ss.get("trust_period", 86400.0 * 7)))
         bv = d.get("batch_verifier", {})
         cfg.batch_verifier = BatchVerifierConfig(
             tpu_threshold=bv.get("tpu_threshold", 32),

@@ -264,6 +264,41 @@ class Node:
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        # -- statesync (node.go:837 statesync.NewReactor + :993) -------
+        # every node serves its app's snapshots; a fresh node with
+        # state_sync enabled also restores from peers before blocksync
+        from tendermint_tpu.statesync.reactor import StateSyncReactor
+        self._statesync_active = bool(
+            cfg.state_sync.enable and self.state.last_block_height == 0
+            and self.block_store.height() == 0)
+        state_provider = None
+        if self._statesync_active:
+            servers = [a.strip() for a in
+                       cfg.state_sync.rpc_servers.split(",") if a.strip()]
+            if not (servers and cfg.state_sync.trust_height and
+                    cfg.state_sync.trust_hash):
+                raise NodeError(
+                    "state_sync requires rpc_servers, trust_height and "
+                    "trust_hash (reference config/config.go StateSync)")
+            from tendermint_tpu.light.client import (Client as LightClient,
+                                                     TrustOptions)
+            from tendermint_tpu.light.provider import HTTPProvider
+            from tendermint_tpu.light.store import LightStore
+            from tendermint_tpu.statesync.stateprovider import StateProvider
+            lc = LightClient(
+                self.genesis.chain_id,
+                TrustOptions(cfg.state_sync.trust_height,
+                             bytes.fromhex(cfg.state_sync.trust_hash),
+                             period_s=cfg.state_sync.trust_period),
+                HTTPProvider(self.genesis.chain_id, servers[0]),
+                witnesses=[HTTPProvider(self.genesis.chain_id, a)
+                           for a in servers[1:]],
+                store=LightStore(MemDB()))
+            state_provider = StateProvider(lc)
+        self.statesync_reactor = StateSyncReactor(
+            self.app_conns.snapshot, state_provider=state_provider)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
         # PEX + addr book (node.go:908 createPEXReactorAndAddToSwitch)
         self.pex_reactor = None
         if cfg.p2p.pex:
@@ -282,6 +317,7 @@ class Node:
             self.rpc_server = RPCServer(self, cfg.rpc.laddr)
 
         self._started = False
+        self._stopping = False
         self._consensus_started = threading.Event()
 
     def _pv_address(self) -> Optional[bytes]:
@@ -318,7 +354,13 @@ class Node:
         self.evidence_reactor.start()
         if self.pex_reactor is not None:
             self.pex_reactor.start()
-        if self.blocksync_reactor.fast_sync:
+        if self._statesync_active:
+            # restore from a snapshot first; blocksync/consensus start
+            # from the restored state once it lands (node.go:993
+            # startStateSync -> bcReactor.SwitchToBlockSync)
+            threading.Thread(target=self._statesync_routine,
+                             name="statesync", daemon=True).start()
+        elif self.blocksync_reactor.fast_sync:
             self.blocksync_reactor.start()
         else:
             self._on_caught_up(self.state)
@@ -326,6 +368,40 @@ class Node:
             self.rpc_server.start()
         if wait_for_sync:
             self._consensus_started.wait()
+
+    def _statesync_routine(self):
+        """Run the syncer, persist the restored state, then hand off to
+        blocksync (reference node/node.go startStateSync +
+        blocksync/reactor.go SwitchToBlockSync)."""
+        import time as _time
+
+        from tendermint_tpu.statesync.syncer import StateSyncError
+
+        deadline = _time.monotonic() + 300.0
+        state = commit = None
+        while _time.monotonic() < deadline and not self._stopping:
+            try:
+                state, commit = self.statesync_reactor.syncer.sync_any()
+                break
+            except StateSyncError:
+                # no (verifiable) snapshots yet; re-poll the peers — the
+                # serving side may take its first snapshot after connect
+                self.statesync_reactor.request_snapshots()
+                _time.sleep(1.0)
+        if state is None:
+            if not self._stopping:
+                print(f"node[{self.config.moniker}]: statesync found no "
+                      f"usable snapshot; falling back to blocksync",
+                      flush=True)
+            self.blocksync_reactor.start()
+            return
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.state = state
+        self.blocksync_reactor.switch_to_blocksync(state)
+        print(f"node[{self.config.moniker}]: statesync restored height "
+              f"{state.last_block_height}", flush=True)
+        self.blocksync_reactor.start()
 
     def _on_caught_up(self, state):
         """SwitchToConsensus (reference blocksync/reactor.go:316)."""
@@ -338,6 +414,7 @@ class Node:
         self._consensus_started.set()
 
     def stop(self):
+        self._stopping = True
         self.indexer_service.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()

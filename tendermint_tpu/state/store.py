@@ -49,6 +49,20 @@ class StateStore:
                     safe_codec.dumps(state.consensus_params))
         self.db.set(_STATE_KEY, safe_codec.dumps(state))
 
+    def bootstrap(self, state: State):
+        """Persist a statesync-restored state INCLUDING the validator sets
+        for its own height and height+1 (reference state/store.go:155
+        Bootstrap).  A plain save() only writes height+2, which would
+        leave load_validators(H)/H+1 empty forever on a restored node."""
+        h = state.last_block_height
+        if h > 0 and state.last_validators is not None:
+            self._save_validators(h, state.last_validators)
+        self._save_validators(h + 1, state.validators)
+        self._save_validators(h + 2, state.next_validators)
+        self.db.set(_params_key(h + 1),
+                    safe_codec.dumps(state.consensus_params))
+        self.db.set(_STATE_KEY, safe_codec.dumps(state))
+
     def load(self) -> Optional[State]:
         raw = self.db.get(_STATE_KEY)
         return safe_codec.loads(raw) if raw is not None else None

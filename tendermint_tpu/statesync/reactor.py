@@ -75,6 +75,13 @@ class StateSyncReactor(Reactor):
         if self.syncer is not None:
             peer.try_send(SNAPSHOT_CHANNEL, SnapshotsRequest())
 
+    def request_snapshots(self):
+        """Re-poll every peer for snapshots (the serving side may only
+        take its first snapshot after we connected)."""
+        if self.switch is not None:
+            for peer in list(self.switch.peers.values()):
+                peer.try_send(SNAPSHOT_CHANNEL, SnapshotsRequest())
+
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
         msg = loads(msg_bytes)
         if ch_id == SNAPSHOT_CHANNEL:

@@ -16,17 +16,20 @@ from tendermint_tpu.types.basic import BlockID, Timestamp
 
 
 class StateProvider:
-    def __init__(self, light_client: LightClient, now: Timestamp,
-                 params_fn=None):
+    def __init__(self, light_client: LightClient,
+                 now: Timestamp | None = None, params_fn=None):
         """params_fn(height) -> ConsensusParams fetches the chain's params
         (the reference's RPC provider queries /consensus_params); defaults
-        are used when unavailable."""
+        are used when unavailable.  `now` pins verification time for
+        deterministic tests; None means wall clock per call (a live chain
+        keeps minting headers after construction)."""
         self.lc = light_client
         self.now = now
         self.params_fn = params_fn
 
     def _lb(self, height: int):
-        return self.lc.verify_light_block_at_height(height, self.now)
+        return self.lc.verify_light_block_at_height(
+            height, self.now if self.now is not None else Timestamp.now())
 
     def app_hash(self, height: int) -> bytes:
         """Trusted app hash of the state AFTER block `height`

@@ -17,6 +17,11 @@ class StateSyncError(Exception):
     pass
 
 
+class SnapshotUnverifiable(StateSyncError):
+    """The chain has not outgrown the snapshot yet (headers H+1/H+2
+    missing) — retriable, unlike a rejection."""
+
+
 class SnapshotRejected(StateSyncError):
     pass
 
@@ -61,6 +66,8 @@ class Syncer:
         for snapshot, peer_id in self._best_snapshots():
             try:
                 return self._sync_one(snapshot, peer_id)
+            except SnapshotUnverifiable:
+                continue  # may verify on a later attempt; do not blacklist
             except SnapshotRejected:
                 with self._lock:
                     self._rejected.add(
@@ -80,8 +87,9 @@ class Syncer:
             state = self.state_provider.state(snapshot.height)
             commit = self.state_provider.commit(snapshot.height)
         except Exception as e:
-            raise SnapshotRejected(
-                f"cannot verify snapshot height {snapshot.height}: {e}")
+            raise SnapshotUnverifiable(
+                f"cannot verify snapshot height {snapshot.height} "
+                f"(chain may not have outgrown it yet): {e}")
         try:
             resp = self.app.offer_snapshot(snapshot, app_hash)
             if resp.result != abci.ResponseOfferSnapshot.ACCEPT:
@@ -106,8 +114,13 @@ class Syncer:
             info = self.app.info(abci.RequestInfo())
         except SnapshotRejected:
             raise
+        except StateSyncError as e:
+            # transport-layer trouble (chunk timeout, momentary zero-peer
+            # window, snapshot pruned server-side): retriable — do NOT
+            # blacklist a snapshot for the network's weather
+            raise SnapshotUnverifiable(f"chunk fetch failed: {e}")
         except Exception as e:
-            # app/fetch blew up on peer-shaped data: this snapshot is bad,
+            # app blew up on peer-shaped data: this snapshot is bad,
             # not the whole sync
             raise SnapshotRejected(f"restore failed: {e}")
         if info.last_block_height != snapshot.height:

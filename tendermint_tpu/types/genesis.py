@@ -77,6 +77,8 @@ class GenesisDoc:
                 "block": {
                     "max_bytes": str(self.consensus_params.block.max_bytes),
                     "max_gas": str(self.consensus_params.block.max_gas),
+                    "time_iota_ms":
+                        str(self.consensus_params.block.time_iota_ms),
                 },
                 "evidence": {
                     "max_age_num_blocks": str(
@@ -113,7 +115,8 @@ class GenesisDoc:
             dcp = d["consensus_params"]
             cp.block = BlockParams(
                 max_bytes=int(dcp["block"]["max_bytes"]),
-                max_gas=int(dcp["block"]["max_gas"]))
+                max_gas=int(dcp["block"]["max_gas"]),
+                time_iota_ms=int(dcp["block"].get("time_iota_ms", 1000)))
             cp.evidence = EvidenceParams(
                 max_age_num_blocks=int(dcp["evidence"]["max_age_num_blocks"]),
                 max_age_duration_seconds=int(

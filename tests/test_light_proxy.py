@@ -47,8 +47,15 @@ def node(tmp_path_factory):
                                  cfg.priv_validator_state_file())
     NodeKey.load_or_generate(cfg.node_key_file())
     pub = pv.get_pub_key()
+    from tendermint_tpu.types.params import ConsensusParams
+    params = ConsensusParams()
+    # fast localnet: block cadence ~0.1s real time; the default 1000ms
+    # time iota would mint header times into the future and the light
+    # verifier would (correctly) refuse them
+    params.block.time_iota_ms = 1
     gdoc = GenesisDoc(chain_id="light-proxy-chain",
                       genesis_time=Timestamp(1700000000, 0),
+                      consensus_params=params,
                       validators=[GenesisValidator(
                           address=pub.address(), pub_key_type=pub.type_name,
                           pub_key_bytes=pub.bytes(), power=10)])

@@ -118,3 +118,21 @@ def test_statesync_rejects_corrupt_snapshot():
     from tendermint_tpu.statesync import StateSyncError
     with pytest.raises(StateSyncError):
         syncer.sync_any()
+
+
+def test_statestore_bootstrap_persists_validator_sets():
+    """Reference state/store.go Bootstrap: a snapshot-restored state must
+    make load_validators(H), H+1 and H+2 answer — a plain save() only
+    writes H+2, starving evidence verification and light providers."""
+    from tendermint_tpu.libs.kvdb import MemDB
+    from tendermint_tpu.state.store import StateStore
+
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    st = states[10]
+    h = st.last_block_height
+    ss = StateStore(MemDB())
+    ss.bootstrap(st)
+    assert ss.load().last_block_height == h
+    for hh in (h, h + 1, h + 2):
+        assert ss.load_validators(hh) is not None, hh
+    assert ss.load_consensus_params(h + 1) is not None
