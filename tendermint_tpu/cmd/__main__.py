@@ -397,6 +397,19 @@ def cmd_light(args):
         proxy.stop()
 
 
+def cmd_e2e(args):
+    """Run a manifest-driven multi-process testnet end to end
+    (reference test/e2e/runner/main.go)."""
+    from tendermint_tpu.e2e import E2ERunner, load_manifest
+
+    m = load_manifest(args.manifest)
+    workdir = args.workdir or os.path.join(
+        os.path.dirname(os.path.abspath(args.manifest)),
+        f"e2e-{m.chain_id}")
+    stats = E2ERunner(m, workdir).run()
+    print(json.dumps({"ok": True, **stats}))
+
+
 def cmd_abci_kvstore(args):
     """Run the example kvstore as a standalone ABCI server process
     (reference abci/cmd/abci-cli kvstore)."""
@@ -464,6 +477,12 @@ def main(argv=None):
                         help="run the kvstore app as an ABCI server")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
     sp.set_defaults(fn=cmd_abci_kvstore)
+
+    sp = sub.add_parser("e2e",
+                        help="run a manifest-driven multi-process testnet")
+    sp.add_argument("manifest", help="path to the testnet TOML manifest")
+    sp.add_argument("--workdir", default="")
+    sp.set_defaults(fn=cmd_e2e)
 
     sp = sub.add_parser("rollback",
                         help="roll the state back one height")

@@ -1,0 +1,7 @@
+"""Manifest-driven end-to-end testnet harness (reference test/e2e/)."""
+from tendermint_tpu.e2e.manifest import (Manifest, NodeManifest,
+                                         load_manifest, manifest_from_dict)
+from tendermint_tpu.e2e.runner import E2EError, E2ERunner
+
+__all__ = ["Manifest", "NodeManifest", "load_manifest",
+           "manifest_from_dict", "E2ERunner", "E2EError"]

@@ -1,0 +1,89 @@
+"""Testnet manifest (reference test/e2e/pkg/manifest.go): a TOML file
+declares the topology — validators, full nodes, apps, mempool versions,
+state sync, perturbations, and the load profile — and the runner
+(e2e/runner.py) drives the stages against real node processes.
+"""
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class NodeManifest:
+    name: str
+    mode: str = "validator"        # validator | full | seed
+    app: str = "kvstore"           # cmd._load_app spec
+    mempool: str = "v0"            # v0 | v1
+    state_sync: bool = False       # bootstrap from a snapshot
+    start_at: int = 0              # launch once the net reaches this height
+    perturb: List[str] = field(default_factory=list)  # kill|pause|restart
+    power: int = 10                # validator voting power
+
+
+@dataclass
+class LoadManifest:
+    rate: float = 2.0              # txs per second
+    total: int = 20                # stop after this many
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    nodes: List[NodeManifest] = field(default_factory=list)
+    load: LoadManifest = field(default_factory=LoadManifest)
+    # consensus cadence for the whole net (written into every config.toml)
+    timeout_propose: float = 0.4
+    timeout_commit: float = 0.3
+    wait_height: int = 8           # the `wait` stage's minimum height
+
+    def validators(self) -> List[NodeManifest]:
+        return [n for n in self.nodes if n.mode == "validator"]
+
+    def validate(self):
+        if not self.validators():
+            raise ValueError("manifest needs at least one validator")
+        names = [n.name for n in self.nodes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names in {names}")
+        for n in self.nodes:
+            if n.mode not in ("validator", "full", "seed"):
+                raise ValueError(f"{n.name}: unknown mode {n.mode!r}")
+            for p in n.perturb:
+                if p not in ("kill", "pause", "restart"):
+                    raise ValueError(f"{n.name}: unknown perturbation {p!r}")
+            if n.state_sync and not n.start_at:
+                raise ValueError(
+                    f"{n.name}: state_sync requires start_at > 0 (the "
+                    f"chain must have snapshots before the node launches)")
+
+
+def load_manifest(path: str) -> Manifest:
+    with open(path, "rb") as f:
+        d = tomllib.load(f)
+    return manifest_from_dict(d)
+
+
+def manifest_from_dict(d: Dict) -> Manifest:
+    m = Manifest(chain_id=d.get("chain_id", "e2e-net"))
+    for key in ("timeout_propose", "timeout_commit"):
+        if key in d:
+            setattr(m, key, float(d[key]))
+    if "wait_height" in d:
+        m.wait_height = int(d["wait_height"])
+    for name, nd in (d.get("node") or {}).items():
+        m.nodes.append(NodeManifest(
+            name=name,
+            mode=nd.get("mode", "validator"),
+            app=nd.get("app", "kvstore"),
+            mempool=nd.get("mempool", "v0"),
+            state_sync=bool(nd.get("state_sync", False)),
+            start_at=int(nd.get("start_at", 0)),
+            perturb=list(nd.get("perturb", [])),
+            power=int(nd.get("power", 10))))
+    ld = d.get("load") or {}
+    m.load = LoadManifest(rate=float(ld.get("rate", 2.0)),
+                          total=int(ld.get("total", 20)))
+    m.validate()
+    return m

@@ -1,0 +1,408 @@
+"""Manifest-driven multi-process testnet runner (reference
+test/e2e/runner/main.go stages: setup -> start -> load -> perturb ->
+wait -> test -> benchmark -> cleanup).
+
+Each node is a real OS process (`python -m tendermint_tpu.cmd start`)
+with its own home dir, talking to its peers over real sockets; the
+runner observes and perturbs the net exclusively from outside (RPC +
+signals), like the reference's docker-compose harness does.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.e2e.manifest import Manifest, NodeManifest
+from tendermint_tpu.rpc.client import HTTPClient, RPCClientError
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class E2EError(Exception):
+    pass
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _NodeHandle:
+    def __init__(self, manifest: NodeManifest, home: str, p2p_port: int,
+                 rpc_port: int):
+        self.m = manifest
+        self.home = home
+        self.p2p_port = p2p_port
+        self.rpc_port = rpc_port
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = os.path.join(home, "node.log")
+
+    @property
+    def rpc(self) -> HTTPClient:
+        return HTTPClient(f"127.0.0.1:{self.rpc_port}", timeout=5.0)
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def height(self) -> int:
+        try:
+            return int(self.rpc.status()["sync_info"]["latest_block_height"])
+        except Exception:
+            return -1
+
+
+class E2ERunner:
+    def __init__(self, manifest: Manifest, workdir: str,
+                 log=print):
+        self.m = manifest
+        self.workdir = os.path.abspath(workdir)
+        self.log = log
+        self.nodes: Dict[str, _NodeHandle] = {}
+        self._load_sent = 0
+        self._load_failed = 0
+        self._stop_load = threading.Event()
+
+    # -- stage: setup ------------------------------------------------------
+
+    def setup(self):
+        """Write every node's home dir: keys, shared genesis, config."""
+        from tendermint_tpu.config.config import Config
+        from tendermint_tpu.p2p.key import NodeKey
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tendermint_tpu.types.basic import Timestamp
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tendermint_tpu.types.params import ConsensusParams
+
+        os.makedirs(self.workdir, exist_ok=True)
+        keys = {}
+        pvs = {}
+        for n in self.m.nodes:
+            home = os.path.join(self.workdir, n.name)
+            h = _NodeHandle(n, home, _free_port(), _free_port())
+            self.nodes[n.name] = h
+            cfg = self._node_config(h)
+            cfg.ensure_dirs()
+            keys[n.name] = NodeKey.load_or_generate(cfg.node_key_file())
+            pvs[n.name] = FilePV.load_or_generate(
+                cfg.priv_validator_key_file(),
+                cfg.priv_validator_state_file())
+
+        params = ConsensusParams()
+        # fast block cadence: keep header times on the wall clock
+        params.block.time_iota_ms = 1
+        gdoc = GenesisDoc(
+            chain_id=self.m.chain_id,
+            genesis_time=Timestamp(int(time.time()) - 1, 0),
+            consensus_params=params,
+            validators=[GenesisValidator(
+                address=pvs[n.name].get_pub_key().address(),
+                pub_key_type=pvs[n.name].get_pub_key().type_name,
+                pub_key_bytes=pvs[n.name].get_pub_key().bytes(),
+                power=n.power)
+                for n in self.m.validators()])
+        gjson = gdoc.to_json()
+
+        for name, h in self.nodes.items():
+            cfg = self._node_config(h)
+            cfg.save()
+            with open(cfg.genesis_file(), "w") as f:
+                f.write(gjson)
+        self._node_keys = keys
+        self.log(f"e2e setup: {len(self.nodes)} nodes in {self.workdir}")
+
+    def _node_config(self, h: _NodeHandle):
+        from tendermint_tpu.config.config import Config
+
+        cfg = Config(home=h.home, moniker=h.m.name)
+        cfg.p2p.laddr = f"127.0.0.1:{h.p2p_port}"
+        cfg.rpc.laddr = f"127.0.0.1:{h.rpc_port}"
+        cfg.mempool.version = h.m.mempool
+        c = cfg.consensus
+        c.timeout_propose = self.m.timeout_propose
+        c.timeout_prevote = c.timeout_precommit = self.m.timeout_propose
+        c.timeout_commit = self.m.timeout_commit
+        c.skip_timeout_commit = False
+        if hasattr(self, "_node_keys"):
+            cfg.p2p.persistent_peers = ",".join(
+                f"{self._node_keys[o.m.name].node_id}@127.0.0.1:{o.p2p_port}"
+                for o in self.nodes.values() if o.m.name != h.m.name)
+        return cfg
+
+    # -- stage: start ------------------------------------------------------
+
+    def _launch(self, h: _NodeHandle):
+        cfg = self._node_config(h)
+        if h.m.state_sync:
+            # trust anchor from a live peer, chosen at launch time
+            peer = self._any_live_node(exclude=h.m.name)
+            anchor_h = max(1, peer.height() - 5)
+            from tendermint_tpu.light.provider import HTTPProvider
+            anchor = HTTPProvider(self.m.chain_id,
+                                  f"127.0.0.1:{peer.rpc_port}"
+                                  ).light_block(anchor_h)
+            cfg.state_sync.enable = True
+            cfg.state_sync.rpc_servers = f"127.0.0.1:{peer.rpc_port}"
+            cfg.state_sync.trust_height = anchor.height
+            cfg.state_sync.trust_hash = anchor.hash().hex()
+        cfg.save()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        logf = open(h.log_path, "ab")
+        h.proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd", "--home", h.home,
+             "start", "--app", h.m.app],
+            stdout=logf, stderr=logf, cwd=REPO, env=env)
+        self.log(f"e2e start: {h.m.name} pid={h.proc.pid} "
+                 f"rpc=127.0.0.1:{h.rpc_port}")
+
+    def _any_live_node(self, exclude: str = "") -> _NodeHandle:
+        for h in self.nodes.values():
+            if h.m.name != exclude and h.running() and h.height() > 0:
+                return h
+        raise E2EError("no live node available")
+
+    def start(self, timeout: float = 120.0):
+        """Launch all start_at == 0 nodes; wait for the net to produce a
+        block.  Delayed nodes (start_at > 0) launch from wait()."""
+        for h in self.nodes.values():
+            if h.m.start_at == 0:
+                self._launch(h)
+        deadline = time.time() + timeout
+        pending = {n for n, h in self.nodes.items() if h.m.start_at == 0}
+        while pending and time.time() < deadline:
+            for name in sorted(pending):
+                h = self.nodes[name]
+                if not h.running():
+                    raise E2EError(
+                        f"{name} died at startup; log tail:\n"
+                        + self._log_tail(h))
+                if h.height() >= 1:
+                    pending.discard(name)
+                    break
+            time.sleep(0.3)
+        if pending:
+            raise E2EError(f"nodes never reached height 1: {sorted(pending)}")
+        self.log("e2e start: all initial nodes at height >= 1")
+
+    def _log_tail(self, h: _NodeHandle, n: int = 2000) -> str:
+        try:
+            with open(h.log_path, "rb") as f:
+                return f.read()[-n:].decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    # -- stage: load -------------------------------------------------------
+
+    def start_load(self):
+        """Background tx generator (reference test/e2e/runner/load.go)."""
+        def run():
+            i = 0
+            while not self._stop_load.is_set() and \
+                    self._load_sent < self.m.load.total:
+                h = self.nodes[sorted(self.nodes)[i % len(self.nodes)]]
+                i += 1
+                if h.running():
+                    tx = f"load-{i}={os.urandom(4).hex()}".encode()
+                    try:
+                        import base64
+                        h.rpc.call("broadcast_tx_sync",
+                                   tx=base64.b64encode(tx).decode())
+                        self._load_sent += 1
+                    except Exception:
+                        self._load_failed += 1
+                self._stop_load.wait(1.0 / max(self.m.load.rate, 0.1))
+            self.log(f"e2e load: sent {self._load_sent} txs "
+                     f"({self._load_failed} failed)")
+        self._load_thread = threading.Thread(target=run, daemon=True)
+        self._load_thread.start()
+
+    def stop_load(self):
+        self._stop_load.set()
+
+    # -- stage: perturb ----------------------------------------------------
+
+    def perturb(self):
+        """kill -9 + relaunch, SIGSTOP/SIGCONT pause, or graceful restart
+        per the manifest (reference test/e2e/runner/perturb.go:28)."""
+        for h in self.nodes.values():
+            for p in h.m.perturb:
+                if not h.running():
+                    continue
+                before = max(x.height() for x in self.nodes.values())
+                if p == "kill":
+                    self.log(f"e2e perturb: SIGKILL {h.m.name}")
+                    h.proc.kill()
+                    h.proc.wait()
+                    time.sleep(1.0)
+                    self._launch(h)
+                elif p == "pause":
+                    self.log(f"e2e perturb: pausing {h.m.name} 3s")
+                    os.kill(h.proc.pid, signal.SIGSTOP)
+                    time.sleep(3.0)
+                    os.kill(h.proc.pid, signal.SIGCONT)
+                elif p == "restart":
+                    self.log(f"e2e perturb: restarting {h.m.name}")
+                    h.proc.terminate()
+                    try:
+                        h.proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        h.proc.kill()
+                        h.proc.wait()
+                    self._launch(h)
+                # the net must keep committing through the perturbation
+                self._wait_all_above(before + 2, timeout=90.0,
+                                     include=lambda x: x.m.name != h.m.name)
+        self.log("e2e perturb: done")
+
+    # -- stage: wait -------------------------------------------------------
+
+    def wait(self, height: Optional[int] = None, timeout: float = 180.0):
+        """Wait for every (running) node to reach `height`, launching
+        delayed nodes as their start_at heights are passed."""
+        target = height or self.m.wait_height
+        deadline = time.time() + timeout
+        launched = {n for n, h in self.nodes.items() if h.proc is not None}
+        while time.time() < deadline:
+            head = max((h.height() for h in self.nodes.values()), default=0)
+            for name, h in self.nodes.items():
+                if name not in launched and h.m.start_at and \
+                        head >= h.m.start_at:
+                    self._launch(h)
+                    launched.add(name)
+            if launched == set(self.nodes) and \
+                    all(self.nodes[n].height() >= target for n in launched):
+                self.log(f"e2e wait: all nodes at height >= {target}")
+                return
+            for name in sorted(launched):
+                h = self.nodes[name]
+                if not h.running():
+                    raise E2EError(f"{name} died; log tail:\n"
+                                   + self._log_tail(h))
+            time.sleep(0.5)
+        raise E2EError(
+            f"wait({target}) timed out; heights: "
+            f"{ {n: h.height() for n, h in self.nodes.items()} }")
+
+    def _wait_all_above(self, height: int, timeout: float, include):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            hs = [h.height() for h in self.nodes.values()
+                  if include(h) and h.running()]
+            if hs and min(hs) >= height:
+                return
+            time.sleep(0.5)
+        raise E2EError(f"net stalled below {height} during perturbation")
+
+    # -- stage: test (invariants) ------------------------------------------
+
+    def test(self):
+        """Per-node invariants (reference test/e2e/tests/*_test.go):
+        block-hash and app-hash agreement at sampled heights, and every
+        validator signed at least one sampled commit."""
+        heights = sorted(h.height() for h in self.nodes.values())
+        common = heights[0]
+        if common < 2:
+            raise E2EError(f"no common height to test (heights {heights})")
+        sample = sorted({2, max(2, common // 2), common})
+
+        for hh in sample:
+            ids = {}
+            apps = {}
+            for name, h in self.nodes.items():
+                try:
+                    b = h.rpc.call("block", height=hh)
+                except RPCClientError:
+                    # a state-synced node has no blocks below its
+                    # snapshot height — that is the point of state sync
+                    if not h.m.state_sync:
+                        raise
+                    continue
+                ids[name] = b["block_id"]["hash"]
+                apps[name] = b["block"]["header"]["app_hash"]
+            if len(set(ids.values())) != 1:
+                raise E2EError(f"block-hash divergence at {hh}: {ids}")
+            if len(set(apps.values())) != 1:
+                raise E2EError(f"app-hash divergence at {hh}: {apps}")
+            if not ids:
+                raise E2EError(f"no node could serve height {hh}")
+
+        # signing presence: every validator appears in >= 1 sampled commit
+        any_node = next(iter(self.nodes.values()))
+        vals = any_node.rpc.call("validators", height=common)
+        expected = {v["address"] for v in vals["validators"]}
+        signed = set()
+        for hh in range(max(2, common - 8), common + 1):
+            c = any_node.rpc.call("commit", height=hh)
+            for s in c["signed_header"]["commit"]["signatures"]:
+                if s["signature"]:
+                    signed.add(s["validator_address"])
+        missing = expected - signed
+        if missing:
+            raise E2EError(
+                f"validators never signed in the last 8 commits: {missing}")
+        self.log(f"e2e test: invariants hold at heights {sample}, "
+                 f"{len(expected)} validators all signing")
+
+    # -- stage: benchmark --------------------------------------------------
+
+    def benchmark(self) -> dict:
+        """Block-interval stats over the last blocks (reference
+        test/e2e/runner/benchmark.go:22)."""
+        h = self.nodes[sorted(self.nodes)[0]]
+        head = h.height()
+        first = max(2, head - 20)
+        metas = h.rpc.call("blockchain", minHeight=first, maxHeight=head)
+        times = sorted(
+            (int(m["header"]["height"]),
+             m["header"]["time"]["seconds"]
+             + m["header"]["time"]["nanos"] / 1e9)
+            for m in metas["block_metas"])
+        gaps = [b[1] - a[1] for a, b in zip(times, times[1:])]
+        stats = {
+            "blocks": len(times),
+            "interval_avg_s": round(sum(gaps) / len(gaps), 3) if gaps else 0,
+            "interval_max_s": round(max(gaps), 3) if gaps else 0,
+            "txs_sent": self._load_sent,
+        }
+        self.log(f"e2e benchmark: {json.dumps(stats)}")
+        return stats
+
+    # -- stage: cleanup ----------------------------------------------------
+
+    def stop(self):
+        self.stop_load()
+        for h in self.nodes.values():
+            if h.running():
+                h.proc.terminate()
+        for h in self.nodes.values():
+            if h.proc is not None:
+                try:
+                    h.proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    h.proc.kill()
+                    h.proc.wait()
+        self.log("e2e stop: all nodes down")
+
+    # -- all together ------------------------------------------------------
+
+    def run(self) -> dict:
+        try:
+            self.setup()
+            self.start()
+            self.start_load()
+            self.perturb()
+            self.wait()
+            self.stop_load()
+            self.test()
+            return self.benchmark()
+        finally:
+            self.stop()

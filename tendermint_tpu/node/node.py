@@ -379,11 +379,16 @@ class Node:
 
         deadline = _time.monotonic() + 300.0
         state = commit = None
+        attempts = 0
         while _time.monotonic() < deadline and not self._stopping:
             try:
                 state, commit = self.statesync_reactor.syncer.sync_any()
                 break
-            except StateSyncError:
+            except StateSyncError as e:
+                attempts += 1
+                if attempts % 10 == 1:
+                    print(f"node[{self.config.moniker}]: statesync attempt "
+                          f"{attempts}: {e}", flush=True)
                 # no (verifiable) snapshots yet; re-poll the peers — the
                 # serving side may take its first snapshot after connect
                 self.statesync_reactor.request_snapshots()

@@ -55,6 +55,12 @@ class Syncer:
 
     def _best_snapshots(self):
         with self._lock:
+            # drop blacklisted entries so retries never re-download
+            # known-bad snapshots (_rejected otherwise only gates
+            # add_snapshot, not selection)
+            self._snapshots = [
+                (s, p) for s, p in self._snapshots
+                if (s.height, s.format, s.hash) not in self._rejected]
             return sorted(self._snapshots,
                           key=lambda sp: (-sp[0].height, -sp[0].format))
 
@@ -63,17 +69,23 @@ class Syncer:
     def sync_any(self) -> Tuple[State, "object"]:
         """Try discovered snapshots best-first.  Returns (bootstrapped
         state, certifying commit for the snapshot height)."""
+        reasons = []
         for snapshot, peer_id in self._best_snapshots():
             try:
                 return self._sync_one(snapshot, peer_id)
-            except SnapshotUnverifiable:
-                continue  # may verify on a later attempt; do not blacklist
-            except SnapshotRejected:
+            except SnapshotUnverifiable as e:
+                # may verify on a later attempt; do not blacklist
+                reasons.append(f"h{snapshot.height}: {e}")
+                continue
+            except SnapshotRejected as e:
+                reasons.append(f"h{snapshot.height}: REJECTED {e}")
                 with self._lock:
                     self._rejected.add(
                         (snapshot.height, snapshot.format, snapshot.hash))
                 continue
-        raise StateSyncError("no viable snapshots")
+        raise StateSyncError(
+            "no viable snapshots" + (": " + "; ".join(reasons[:3])
+                                     if reasons else ""))
 
     def _sync_one(self, snapshot: abci.Snapshot, peer_id: str):
         # trusted app hash for the snapshot height comes from the light

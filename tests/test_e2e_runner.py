@@ -1,0 +1,45 @@
+"""Manifest-driven e2e harness over real node processes (reference
+test/e2e: setup -> start -> load -> perturb -> wait -> test ->
+benchmark).  The small manifest still covers the interesting axes:
+multiple validators, a delayed state-syncing full node, a priority
+mempool, a kill and a pause perturbation, and tx load."""
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.e2e import E2ERunner, manifest_from_dict
+
+
+@pytest.mark.slow
+def test_manifest_testnet_with_perturbations(tmp_path):
+    m = manifest_from_dict({
+        "chain_id": "e2e-ci",
+        "timeout_propose": 0.4,
+        "timeout_commit": 0.25,
+        "wait_height": 8,
+        "node": {
+            "validator0": {"perturb": ["kill"],
+                           "app": "kvstore@snapshots=4"},
+            "validator1": {"mempool": "v1", "app": "kvstore@snapshots=4"},
+            "validator2": {"perturb": ["pause"],
+                           "app": "kvstore@snapshots=4"},
+            "full0": {"mode": "full", "app": "kvstore",
+                      "state_sync": True, "start_at": 6},
+        },
+        "load": {"rate": 2.0, "total": 10},
+    })
+    runner = E2ERunner(m, str(tmp_path / "net"))
+    stats = runner.run()
+    assert stats["blocks"] >= 2
+    assert stats["txs_sent"] >= 1
+    assert stats["interval_avg_s"] < 10.0
+
+
+def test_manifest_validation():
+    with pytest.raises(ValueError, match="at least one validator"):
+        manifest_from_dict({"node": {"f": {"mode": "full"}}})
+    with pytest.raises(ValueError, match="unknown perturbation"):
+        manifest_from_dict({"node": {"v": {"perturb": ["explode"]}}})
+    with pytest.raises(ValueError, match="state_sync requires"):
+        manifest_from_dict({"node": {"v": {}, "f": {
+            "mode": "full", "state_sync": True}}})
