@@ -397,6 +397,32 @@ def cmd_light(args):
         proxy.stop()
 
 
+def cmd_signer_harness(args):
+    """Conformance-test an external remote signer (reference
+    tools/tm-signer-harness): listen on --laddr, wait for the signer to
+    dial in, run the protocol checks, exit nonzero on failure."""
+    from tendermint_tpu.privval.harness import run_harness
+    from tendermint_tpu.privval.signer import SignerClient
+
+    client = SignerClient(args.laddr, accept_timeout_s=args.accept_timeout)
+    bound = client._listener.getsockname()
+    addr = f"{bound[0]}:{bound[1]}" if isinstance(bound, tuple) else bound
+    print(f"signer harness listening on {addr}; waiting for the "
+          f"signer to dial in...", flush=True)
+    try:
+        res = run_harness(client, chain_id=args.chain_id)
+    finally:
+        client.close()
+    for name in res.passed:
+        print(f"PASS {name}")
+    for name in res.failed:
+        print(f"FAIL {name}")
+    print(json.dumps({"ok": res.ok, "passed": len(res.passed),
+                      "failed": len(res.failed)}))
+    if not res.ok:
+        raise SystemExit(1)
+
+
 def cmd_e2e(args):
     """Run a manifest-driven multi-process testnet end to end
     (reference test/e2e/runner/main.go)."""
@@ -477,6 +503,14 @@ def main(argv=None):
                         help="run the kvstore app as an ABCI server")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
     sp.set_defaults(fn=cmd_abci_kvstore)
+
+    sp = sub.add_parser("signer-harness",
+                        help="conformance-test a remote signer")
+    sp.add_argument("--laddr", default="127.0.0.1:0",
+                    help="address to listen on for the signer")
+    sp.add_argument("--chain-id", default="signer-harness-chain")
+    sp.add_argument("--accept-timeout", type=float, default=60.0)
+    sp.set_defaults(fn=cmd_signer_harness)
 
     sp = sub.add_parser("e2e",
                         help="run a manifest-driven multi-process testnet")

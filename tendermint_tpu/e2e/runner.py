@@ -67,6 +67,7 @@ class E2ERunner:
         self.workdir = os.path.abspath(workdir)
         self.log = log
         self.nodes: Dict[str, _NodeHandle] = {}
+        self._node_keys: Dict[str, object] = {}
         self._load_sent = 0
         self._load_failed = 0
         self._stop_load = threading.Event()
@@ -111,12 +112,12 @@ class E2ERunner:
                 for n in self.m.validators()])
         gjson = gdoc.to_json()
 
+        self._node_keys = keys
         for name, h in self.nodes.items():
             cfg = self._node_config(h)
             cfg.save()
             with open(cfg.genesis_file(), "w") as f:
                 f.write(gjson)
-        self._node_keys = keys
         self.log(f"e2e setup: {len(self.nodes)} nodes in {self.workdir}")
 
     def _node_config(self, h: _NodeHandle):
@@ -131,7 +132,7 @@ class E2ERunner:
         c.timeout_prevote = c.timeout_precommit = self.m.timeout_propose
         c.timeout_commit = self.m.timeout_commit
         c.skip_timeout_commit = False
-        if hasattr(self, "_node_keys"):
+        if self._node_keys:
             cfg.p2p.persistent_peers = ",".join(
                 f"{self._node_keys[o.m.name].node_id}@127.0.0.1:{o.p2p_port}"
                 for o in self.nodes.values() if o.m.name != h.m.name)
@@ -328,15 +329,17 @@ class E2ERunner:
                     continue
                 ids[name] = b["block_id"]["hash"]
                 apps[name] = b["block"]["header"]["app_hash"]
+            if not ids:
+                raise E2EError(f"no node could serve height {hh}")
             if len(set(ids.values())) != 1:
                 raise E2EError(f"block-hash divergence at {hh}: {ids}")
             if len(set(apps.values())) != 1:
                 raise E2EError(f"app-hash divergence at {hh}: {apps}")
-            if not ids:
-                raise E2EError(f"no node could serve height {hh}")
 
         # signing presence: every validator appears in >= 1 sampled commit
-        any_node = next(iter(self.nodes.values()))
+        # (read from a full-history node — a state-synced one has no
+        # commits below its snapshot)
+        any_node = self._full_history_node()
         vals = any_node.rpc.call("validators", height=common)
         expected = {v["address"] for v in vals["validators"]}
         signed = set()
@@ -354,10 +357,16 @@ class E2ERunner:
 
     # -- stage: benchmark --------------------------------------------------
 
+    def _full_history_node(self) -> _NodeHandle:
+        for name in sorted(self.nodes):
+            if not self.nodes[name].m.state_sync:
+                return self.nodes[name]
+        return self.nodes[sorted(self.nodes)[0]]
+
     def benchmark(self) -> dict:
         """Block-interval stats over the last blocks (reference
         test/e2e/runner/benchmark.go:22)."""
-        h = self.nodes[sorted(self.nodes)[0]]
+        h = self._full_history_node()
         head = h.height()
         first = max(2, head - 20)
         metas = h.rpc.call("blockchain", minHeight=first, maxHeight=head)

@@ -84,7 +84,10 @@ class LightProxy:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
-                except json.JSONDecodeError:
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be an object")
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        ValueError):
                     self._reply(proxy._err(None, -32700, "parse error"))
                     return
                 self._reply(proxy.dispatch(req.get("method", ""),

@@ -16,9 +16,9 @@ machine-checked int32 bounds (tests/test_field.py::test_carry_pass_count_proof):
 fold-first wide reduction, 3-pass loose carry, 2-pass lazy carry.
 
 Layout inside the kernel: a field element is (NLIMB=22, T) int32 — limbs on
-sublanes, batch tile T on lanes.  Convolutions accumulate into a (48, T)
-register value via static-shift adds (sublane concat), the only non-
-elementwise op.
+sublanes, batch tile T on lanes.  Convolutions accumulate into (NLIMB, T)
+lo/hi column halves via static-shift adds (sublane concat), the only
+non-elementwise op.
 """
 from __future__ import annotations
 
@@ -40,7 +40,6 @@ NLIMB = F.NLIMB
 MASK = F.MASK
 TOP = 255 - RADIX * (NLIMB - 1)  # 3
 FOLD = F.FOLD
-WIDE = 2 * NLIMB - 1  # 43 conv columns; padded buffer rows = 48
 
 _i32 = jnp.int32
 
@@ -94,16 +93,31 @@ def _carry_lazy(v):  # |limb| <= 3L + 2^10 -> loose (1 pass + limb0 tail)
     return _tail_pass(_carry_pass(v))
 
 
+def _shift_up(x, i):
+    """Rows 0..i-1 take x's top i rows (the conv spill above row NLIMB-1);
+    zero-fill below."""
+    T = x.shape[1]
+    z = jnp.zeros((NLIMB - i, T), _i32)
+    return jnp.concatenate([x[NLIMB - i :], z], axis=0)
+
+
 def _mul(a, b):
     """Field multiply, loose-carried output.  Same operand contract as
-    field.mul (22 * |a| * |b| + folds < 2^31)."""
-    T = a.shape[1]
-    z = jnp.zeros((48 - NLIMB, T), _i32)
-    bw = jnp.concatenate([b, z], axis=0)  # (48, T)
-    acc = bw * a[0:1]
+    field.mul (22 * |a| * |b| + folds < 2^31).
+
+    The schoolbook conv accumulates directly into the (lo, hi) column
+    halves _reduce_wide consumes: each partial product is computed on the
+    true (NLIMB, T) operand rows and split at the NLIMB boundary — the
+    earlier single (48, T) buffer multiplied and added ~26 rows of
+    structural zeros per iteration (>2x the row traffic)."""
+    lo = b * a[0:1]                       # cols 0..21
+    hi = None                             # cols 22..43 (top row stays 0)
     for i in range(1, NLIMB):
-        acc = acc + _shift_down(bw * a[i : i + 1], i, 48)
-    return _reduce_wide(acc)
+        p = b * a[i : i + 1]
+        lo = lo + _shift_down(p, i, NLIMB)
+        up = _shift_up(p, i)
+        hi = up if hi is None else hi + up
+    return _reduce_wide_pair(lo, hi)
 
 
 def _sqr(a):
@@ -115,13 +129,12 @@ def _sqr(a):
     return _mul(a, a)
 
 
-def _reduce_wide(c48):
-    """Fold-first reduction of (48, T) conv columns (rows 43..47 zero) to
-    loose (NLIMB, T) limbs; bounds as field._reduce_wide."""
-    T = c48.shape[1]
+def _reduce_wide_pair(lo, hi):
+    """Fold-first reduction of conv columns given as the (NLIMB, T) lo
+    half (cols 0..21) and hi half (cols 22..43; row 21 — col 43 — is
+    zero); bounds as field._reduce_wide."""
+    T = lo.shape[1]
     rows = _rows(T)
-    lo = c48[:NLIMB]
-    hi = c48[NLIMB : 2 * NLIMB]  # rows 22..43; row 43 (t=21) is zero
     h_hi = (hi + (1 << (RADIX - 1))) >> RADIX
     h0 = hi - (h_hi << RADIX)
     h2 = (h_hi + (1 << (RADIX - 1))) >> RADIX

@@ -94,7 +94,10 @@ class RPCServer:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
-                except json.JSONDecodeError:
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be an object")
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        ValueError):
                     self._reply(server._err(None, -32700, "parse error"))
                     return
                 self._reply(server.dispatch(req.get("method", ""),

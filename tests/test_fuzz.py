@@ -1,0 +1,185 @@
+"""Deterministic fuzz entry points (reference test/fuzz/{mempool,p2p,rpc}
+go-fuzz harnesses): peer-shaped garbage must raise controlled errors or
+be rejected — never crash the process, hang, or corrupt state.
+
+Seeded PRNG keeps failures reproducible; structure-aware mutations
+(valid prefix + flipped bytes) hit deeper paths than pure noise.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+ROUNDS = 300
+
+
+def _rng():
+    return np.random.default_rng(0xF0220)
+
+
+def _mutations(rng, valid: bytes):
+    """Pure noise, truncations, and bit-flips of a valid encoding."""
+    yield bytes(rng.integers(0, 256, int(rng.integers(0, 200)),
+                             dtype=np.uint8))
+    if valid:
+        n = len(valid)
+        yield valid[: int(rng.integers(0, n))]
+        b = bytearray(valid)
+        for _ in range(int(rng.integers(1, 6))):
+            b[int(rng.integers(0, n))] ^= int(rng.integers(1, 256))
+        yield bytes(b)
+
+
+def test_fuzz_proto_decoding_block_vote_commit():
+    """protodec + typed from_proto on garbage (the wire path every peer
+    message crosses)."""
+    from tendermint_tpu.libs import protodec as pd
+    from tendermint_tpu.types.block import Block
+    from tendermint_tpu.types.commit import Commit
+    from tendermint_tpu.types.light_block import SignedHeader
+    from tendermint_tpu.types.vote import Vote
+    from tests.helpers import build_chain, make_genesis
+
+    gdoc, privs = make_genesis(2)
+    blocks, commits, _ = build_chain(gdoc, privs, 2)
+    valids = {
+        Block: blocks[-1].proto(),
+        Commit: commits[-1].proto(),
+        Vote: None,
+        SignedHeader: None,
+    }
+    rng = _rng()
+    for _ in range(ROUNDS):
+        for cls, valid in valids.items():
+            for data in _mutations(rng, valid or b""):
+                try:
+                    obj = cls.from_proto(data)
+                    # decoded objects must survive validate_basic-ish use
+                    if hasattr(obj, "hash"):
+                        obj.hash()
+                except Exception as e:
+                    assert not isinstance(e, (SystemExit, MemoryError)), e
+                try:
+                    pd.parse(data)
+                except pd.ProtoError:
+                    pass
+
+
+def test_fuzz_mempool_check_tx():
+    """Random txs through both mempool versions (reference
+    test/fuzz/mempool)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.mempool.priority_mempool import PriorityMempool
+
+    rng = _rng()
+    for cls in (Mempool, PriorityMempool):
+        mp = cls(KVStoreApplication(), size_limit=50)
+        for _ in range(ROUNDS):
+            tx = bytes(rng.integers(0, 256, int(rng.integers(0, 80)),
+                                    dtype=np.uint8))
+            try:
+                mp.check_tx(tx)
+            except Exception as e:
+                assert "mempool" in type(e).__name__.lower() or \
+                    isinstance(e, ValueError), e
+        assert mp.size() <= 50
+
+
+def test_fuzz_secret_connection_handshake_garbage():
+    """A peer speaking garbage during the handshake must be rejected,
+    not crash the acceptor (reference test/fuzz/p2p + secretconnection)."""
+    import socket
+    import threading
+
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.p2p.secret_connection import SecretConnection
+
+    rng = _rng()
+    for i in range(12):
+        a, b = socket.socketpair()
+        errs = []
+
+        def accept():
+            try:
+                SecretConnection(a, edkeys.PrivKey.generate())
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        try:
+            b.sendall(bytes(rng.integers(0, 256, 64, dtype=np.uint8)))
+            b.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        t.join(timeout=10)
+        assert not t.is_alive(), "handshake hung on garbage"
+        assert errs, "garbage handshake unexpectedly succeeded"
+        a.close()
+        b.close()
+
+
+def test_fuzz_rpc_http_bodies():
+    """Garbage JSON-RPC requests against a live server (reference
+    test/fuzz/rpc/jsonrpc)."""
+    import http.client
+
+    from tests.helpers import make_genesis
+    from tendermint_tpu.rpc.server import RPCServer
+
+    class _Node:
+        pass
+
+    # minimal node stub: the dispatcher must survive bad requests even
+    # when handlers blow up on a half-wired node
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.libs.kvdb import MemDB
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.state.store import StateStore
+
+    node = _Node()
+    node.app = KVStoreApplication()
+    node.block_store = BlockStore(MemDB())
+    node.state_store = StateStore(MemDB())
+    srv = RPCServer(node, "127.0.0.1:0")
+    srv.start()
+    rng = _rng()
+    try:
+        for i in range(60):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+            kind = i % 4
+            if kind == 0:
+                body = bytes(rng.integers(0, 256,
+                                          int(rng.integers(0, 300)),
+                                          dtype=np.uint8))
+            elif kind == 1:
+                body = json.dumps({"method": "block", "params": {
+                    "height": rng.choice(
+                        ["-1", "999999999999999999999", "NaN", "[]",
+                         "1e309"])}, "id": 1}).encode()
+            elif kind == 2:
+                body = json.dumps({"method": "".join(
+                    chr(int(x)) for x in rng.integers(32, 127, 12)),
+                    "id": 1}).encode()
+            else:
+                body = b'{"method": "broadcast_tx_sync", "params": ' \
+                       b'{"tx": "%%%not-base64%%%"}, "id": 1}'
+            try:
+                c.request("POST", "/", body=body,
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                assert r.status == 200  # JSON-RPC errors ride 200s
+                payload = json.loads(r.read())
+                assert "error" in payload or "result" in payload
+            finally:
+                c.close()
+        # server still alive and sane after the storm
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        c.request("GET", "/health")
+        assert c.getresponse().status == 200
+        c.close()
+    finally:
+        srv.stop()

@@ -1,0 +1,53 @@
+"""Remote-signer conformance harness (reference tools/tm-signer-harness):
+run the checks against our own SignerServer/FilePV as the implementation
+under test, and against a deliberately broken signer."""
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.privval.harness import run_harness
+from tendermint_tpu.privval.signer import SignerClient, SignerServer
+
+
+def _pv():
+    return FilePV(edkeys.PrivKey((0xFACE).to_bytes(32, "big")))
+
+
+def test_harness_passes_for_conforming_signer():
+    client = SignerClient("tcp://127.0.0.1:0", accept_timeout_s=20.0)
+    port = client._listener.getsockname()[1]
+    srv = SignerServer(_pv(), f"tcp://127.0.0.1:{port}")
+    srv.start()
+    try:
+        res = run_harness(client)
+    finally:
+        client.close()
+        srv.stop()
+    assert res.ok, res.failed
+    assert set(res.passed) == {"pubkey", "sign_proposal", "sign_prevote",
+                               "sign_precommit", "double_sign_refusal",
+                               "same_block_resign"}
+
+
+def test_harness_catches_double_signer(monkeypatch):
+    """A signer whose sign-state tracking is broken must fail the
+    double-sign check."""
+    from tendermint_tpu.privval import file_pv as fpv
+    # forget all history: every sign looks like a fresh HRS
+    monkeypatch.setattr(fpv._LastSignState, "check_hrs",
+                        lambda self, h, r, s: False)
+    pv = _pv()
+
+    client = SignerClient("tcp://127.0.0.1:0", accept_timeout_s=20.0)
+    port = client._listener.getsockname()[1]
+    srv = SignerServer(pv, f"tcp://127.0.0.1:{port}")
+    srv.start()
+    try:
+        res = run_harness(client)
+    finally:
+        client.close()
+        srv.stop()
+    assert not res.ok
+    assert any("double_sign_refusal" in f for f in res.failed), res.failed
