@@ -397,6 +397,73 @@ def cmd_light(args):
         proxy.stop()
 
 
+def cmd_abci_cli(args):
+    """Interactive/one-shot console against an ABCI server process
+    (reference abci/cmd/abci-cli: echo, info, deliver_tx, check_tx,
+    commit, query)."""
+    import shlex
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import SocketClient
+
+    client = SocketClient(args.address)
+
+    def _data(arg: str) -> bytes:
+        return bytes.fromhex(arg[2:]) if arg.startswith("0x") \
+            else arg.encode()
+
+    def run_one(cmd: str, cargs: list) -> int:
+        if cmd in ("deliver_tx", "check_tx", "query") and not cargs:
+            print(f"usage: {cmd} <data|0xHEX>")
+            return 1
+        if cmd == "echo":
+            print(client.echo(" ".join(cargs)))
+        elif cmd == "info":
+            r = client.info(abci.RequestInfo())
+            print(json.dumps({"data": r.data,
+                              "last_block_height": r.last_block_height,
+                              "last_block_app_hash":
+                                  (r.last_block_app_hash or b"").hex()}))
+        elif cmd == "deliver_tx":
+            r = client.deliver_tx(_data(cargs[0]))
+            print(json.dumps({"code": r.code, "log": r.log}))
+        elif cmd == "check_tx":
+            r = client.check_tx(abci.RequestCheckTx(tx=_data(cargs[0])))
+            print(json.dumps({"code": r.code, "log": r.log}))
+        elif cmd == "commit":
+            r = client.commit()
+            print(json.dumps({"data": (r.data or b"").hex()}))
+        elif cmd == "query":
+            r = client.query(abci.RequestQuery(data=_data(cargs[0])))
+            print(json.dumps({"code": r.code, "log": r.log,
+                              "key": (r.key or b"").hex(),
+                              "value": (r.value or b"").hex()}))
+        else:
+            print(f"unknown command {cmd!r}; commands: echo info "
+                  f"deliver_tx check_tx commit query", flush=True)
+            return 1
+        return 0
+
+    try:
+        if args.command:
+            raise SystemExit(run_one(args.command[0], args.command[1:]))
+        print("abci-cli console; commands: echo info deliver_tx check_tx "
+              "commit query; ^D exits", flush=True)
+        while True:
+            try:
+                line = input("> ")
+            except EOFError:
+                break
+            parts = shlex.split(line)
+            if parts:
+                try:
+                    run_one(parts[0], parts[1:])
+                except (ValueError, IndexError) as e:
+                    print(f"error: {e}")
+    finally:
+        client.close()
+
+
 def cmd_signer_harness(args):
     """Conformance-test an external remote signer (reference
     tools/tm-signer-harness): listen on --laddr, wait for the signer to
@@ -504,9 +571,16 @@ def main(argv=None):
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
     sp.set_defaults(fn=cmd_abci_kvstore)
 
+    sp = sub.add_parser("abci-cli",
+                        help="console against an ABCI server")
+    sp.add_argument("--address", default="tcp://127.0.0.1:26658")
+    sp.add_argument("command", nargs="*",
+                    help="one-shot command (omit for interactive)")
+    sp.set_defaults(fn=cmd_abci_cli)
+
     sp = sub.add_parser("signer-harness",
                         help="conformance-test a remote signer")
-    sp.add_argument("--laddr", default="127.0.0.1:0",
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:0",
                     help="address to listen on for the signer")
     sp.add_argument("--chain-id", default="signer-harness-chain")
     sp.add_argument("--accept-timeout", type=float, default=60.0)

@@ -42,6 +42,14 @@ class BlockSyncConfig:
 
 
 @dataclass
+class TxIndexConfig:
+    """Reference config/config.go TxIndexConfig + the psql event sink
+    selection (state/indexer/sink)."""
+    indexer: str = "kv"        # "kv" | "null"
+    sink_dsn: str = ""         # optional write-only SQL event sink
+
+
+@dataclass
 class StateSyncConfig:
     """Reference config/config.go StateSyncConfig: bootstrap a fresh node
     from an app snapshot verified through the light client."""
@@ -73,6 +81,7 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     block_sync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     state_sync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     batch_verifier: BatchVerifierConfig = field(
         default_factory=BatchVerifierConfig)
 
@@ -114,38 +123,47 @@ class Config:
 
     # -- TOML --------------------------------------------------------------
 
+    @staticmethod
+    def _q(v: str) -> str:
+        """TOML basic-string escape for template interpolation."""
+        return v.replace("\\", "\\\\").replace('"', '\\"')
+
     def save(self):
         self.ensure_dirs()
         c = self.consensus
         text = f"""# tendermint_tpu node configuration
-moniker = "{self.moniker}"
-priv_validator_laddr = "{self.priv_validator_laddr}"
+moniker = "{self._q(self.moniker)}"
+priv_validator_laddr = "{self._q(self.priv_validator_laddr)}"
 
 [p2p]
-laddr = "{self.p2p.laddr}"
-persistent_peers = "{self.p2p.persistent_peers}"
+laddr = "{self._q(self.p2p.laddr)}"
+persistent_peers = "{self._q(self.p2p.persistent_peers)}"
 max_num_peers = {self.p2p.max_num_peers}
 pex = {str(self.p2p.pex).lower()}
-seeds = "{self.p2p.seeds}"
+seeds = "{self._q(self.p2p.seeds)}"
 
 [mempool]
-version = "{self.mempool.version}"
+version = "{self._q(self.mempool.version)}"
 size = {self.mempool.size}
 cache_size = {self.mempool.cache_size}
 max_tx_bytes = {self.mempool.max_tx_bytes}
 
 [rpc]
-laddr = "{self.rpc.laddr}"
+laddr = "{self._q(self.rpc.laddr)}"
 enabled = {str(self.rpc.enabled).lower()}
 
 [block_sync]
 enable = {str(self.block_sync.enable).lower()}
 
+[tx_index]
+indexer = "{self._q(self.tx_index.indexer)}"
+sink_dsn = "{self._q(self.tx_index.sink_dsn)}"
+
 [state_sync]
 enable = {str(self.state_sync.enable).lower()}
-rpc_servers = "{self.state_sync.rpc_servers}"
+rpc_servers = "{self._q(self.state_sync.rpc_servers)}"
 trust_height = {self.state_sync.trust_height}
-trust_hash = "{self.state_sync.trust_hash}"
+trust_hash = "{self._q(self.state_sync.trust_hash)}"
 trust_period = {self.state_sync.trust_period}
 
 [batch_verifier]
@@ -194,6 +212,10 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
                             enabled=r.get("enabled", True))
         bs = d.get("block_sync", {})
         cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
+        ti = d.get("tx_index", {})
+        cfg.tx_index = TxIndexConfig(
+            indexer=ti.get("indexer", "kv"),
+            sink_dsn=ti.get("sink_dsn", ""))
         ss = d.get("state_sync", {})
         cfg.state_sync = StateSyncConfig(
             enable=ss.get("enable", False),

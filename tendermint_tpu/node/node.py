@@ -210,12 +210,27 @@ class Node:
         self.event_bus = EventBus()
         from tendermint_tpu.state.indexer import (BlockIndexer,
                                                   IndexerService, TxIndexer)
-        ix_db = MemDB() if in_memory else SQLiteDB(
-            os.path.join(cfg.data_dir(), "tx_index.db"))
-        self.tx_indexer = TxIndexer(ix_db)
-        self.block_indexer = BlockIndexer(ix_db)
+        from tendermint_tpu.state.sinks import (NullBlockIndexer,
+                                                NullTxIndexer, SQLEventSink)
+        if cfg.tx_index.indexer == "null":
+            self.tx_indexer = NullTxIndexer()
+            self.block_indexer = NullBlockIndexer()
+        elif cfg.tx_index.indexer == "kv":
+            ix_db = MemDB() if in_memory else SQLiteDB(
+                os.path.join(cfg.data_dir(), "tx_index.db"))
+            self.tx_indexer = TxIndexer(ix_db)
+            self.block_indexer = BlockIndexer(ix_db)
+        else:
+            raise NodeError(
+                f"unknown indexer {cfg.tx_index.indexer!r} "
+                "(expected 'kv' or 'null')")
+        sinks = []
+        if cfg.tx_index.sink_dsn:
+            sinks.append(SQLEventSink(cfg.tx_index.sink_dsn,
+                                      self.genesis.chain_id))
         self.indexer_service = IndexerService(
-            self.tx_indexer, self.block_indexer, self.event_bus)
+            self.tx_indexer, self.block_indexer, self.event_bus,
+            sinks=sinks)
         if cfg.mempool.version not in ("v0", "v1"):
             raise NodeError(
                 f"unknown mempool version {cfg.mempool.version!r} "

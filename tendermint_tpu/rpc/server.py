@@ -107,6 +107,11 @@ class RPCServer:
             def do_GET(self):
                 u = urlparse(self.path)
                 method = u.path.strip("/")
+                if method == "websocket" and \
+                        "websocket" in (self.headers.get("Upgrade", "")
+                                        .lower()):
+                    server._serve_websocket(self)
+                    return
                 if method == "metrics":
                     # Prometheus text exposition (reference serves this on
                     # a dedicated Instrumentation listener,
@@ -152,6 +157,205 @@ class RPCServer:
     @property
     def laddr(self) -> str:
         return f"{self.host}:{self.port}"
+
+    # -- websocket subscriptions (reference rpc/jsonrpc/server/ws_handler
+    # + rpc/core/events.go Subscribe/Unsubscribe) --------------------------
+
+    def _serve_websocket(self, handler):
+        import base64 as _b64
+        import hashlib
+        import struct
+
+        sock = handler.connection
+        key = handler.headers.get("Sec-WebSocket-Key", "")
+        accept = _b64.b64encode(hashlib.sha1(
+            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode())
+            .digest()).decode()
+        handler.wfile.write(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + accept.encode() + b"\r\n\r\n")
+        handler.wfile.flush()
+
+        send_lock = threading.Lock()
+
+        def send_text(text: str):
+            payload = text.encode()
+            n = len(payload)
+            if n < 126:
+                hdr = struct.pack("!BB", 0x81, n)
+            elif n < 1 << 16:
+                hdr = struct.pack("!BBH", 0x81, 126, n)
+            else:
+                hdr = struct.pack("!BBQ", 0x81, 127, n)
+            with send_lock:
+                sock.sendall(hdr + payload)
+
+        def recv_exact(n):
+            # handler.rfile is buffered: frame bytes pipelined with the
+            # upgrade request may already sit in its buffer, so a raw
+            # sock.recv would hang forever waiting for them
+            buf = handler.rfile.read(n)
+            if buf is None or len(buf) < n:
+                raise ConnectionError("ws closed")
+            return buf
+
+        def recv_frame():
+            b1, b2 = recv_exact(2)
+            opcode = b1 & 0x0F
+            masked = b2 & 0x80
+            ln = b2 & 0x7F
+            if ln == 126:
+                (ln,) = struct.unpack("!H", recv_exact(2))
+            elif ln == 127:
+                (ln,) = struct.unpack("!Q", recv_exact(8))
+            if opcode >= 8 and ln > 125:
+                raise ConnectionError("ws control frame too large")
+            if ln > 1 << 20:
+                raise ConnectionError("ws frame too large")
+            mask = recv_exact(4) if masked else b"\x00" * 4
+            data = bytearray(recv_exact(ln))
+            for i in range(ln):
+                data[i] ^= mask[i % 4]
+            return opcode, bytes(data)
+
+        # per-connection subscriptions: query string -> (Query, bus sub)
+        from tendermint_tpu.libs.pubsub_query import Query, QueryError
+        subs = {}
+        stop = threading.Event()
+
+        def pump():
+            """Deliver matching events as JSON-RPC notifications shaped
+            like the reference's #event responses."""
+            import queue as _q
+            while not stop.is_set():
+                delivered = False
+                for qstr, (query, sub) in list(subs.items()):
+                    try:
+                        ev = sub.queue.get_nowait()
+                    except _q.Empty:
+                        continue
+                    if not query.matches(self._event_terms(ev)):
+                        continue
+                    delivered = True
+                    try:
+                        send_text(json.dumps({
+                            "jsonrpc": "2.0", "id": "0#event",
+                            "result": {
+                                "query": qstr,
+                                "data": {
+                                    "type": f"tendermint/event/{ev.type}",
+                                    "value": self._event_json(ev)}}}))
+                    except OSError:
+                        stop.set()
+                        return
+                if not delivered:
+                    stop.wait(0.05)
+
+        pump_thread = threading.Thread(target=pump, daemon=True)
+        pump_thread.start()
+        try:
+            while not stop.is_set():
+                opcode, data = recv_frame()
+                if opcode == 8:  # close
+                    break
+                if opcode == 9:  # ping -> pong
+                    with send_lock:
+                        sock.sendall(b"\x8a" + bytes([len(data)]) + data)
+                    continue
+                if opcode != 1:
+                    continue
+                try:
+                    req = json.loads(data)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    send_text(json.dumps(self._err(None, -32700,
+                                                   "parse error")))
+                    continue
+                rid = req.get("id", -1)
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                if method == "subscribe":
+                    qstr = params.get("query", "")
+                    try:
+                        query = Query(qstr)
+                    except QueryError as e:
+                        send_text(json.dumps(self._err(rid, -32602,
+                                                       str(e))))
+                        continue
+                    stale = subs.pop(qstr, None)
+                    if stale is not None:  # re-subscribe: drop the old sub
+                        self.node.event_bus.unsubscribe(stale[1])
+                    sub = self.node.event_bus.subscribe()
+                    subs[qstr] = (query, sub)
+                    send_text(json.dumps({"jsonrpc": "2.0", "id": rid,
+                                          "result": {}}))
+                elif method == "unsubscribe":
+                    qstr = params.get("query", "")
+                    entry = subs.pop(qstr, None)
+                    if entry is not None:
+                        self.node.event_bus.unsubscribe(entry[1])
+                    send_text(json.dumps({"jsonrpc": "2.0", "id": rid,
+                                          "result": {}}))
+                elif method == "unsubscribe_all":
+                    for _, sub in subs.values():
+                        self.node.event_bus.unsubscribe(sub)
+                    subs.clear()
+                    send_text(json.dumps({"jsonrpc": "2.0", "id": rid,
+                                          "result": {}}))
+                else:
+                    send_text(json.dumps(self.dispatch(method, params,
+                                                       rid)))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            stop.set()
+            for _, sub in subs.values():
+                self.node.event_bus.unsubscribe(sub)
+            handler.close_connection = True
+
+    def _event_terms(self, ev) -> dict:
+        """Composite query terms for an event: tm.event plus attributes,
+        plus app event attributes for Tx results (reference
+        libs/pubsub/query semantics, e.g. tx.height / app.creator)."""
+        terms = {"tm.event": [ev.type]}
+        for k, v in (ev.attributes or {}).items():
+            terms.setdefault(f"tm.{k}", []).append(str(v))
+        data = ev.data or {}
+        if isinstance(data, dict):
+            if "height" in (ev.attributes or {}):
+                terms.setdefault("tx.height" if ev.type == "Tx"
+                                 else "block.height",
+                                 []).append(ev.attributes["height"])
+            res = data.get("result")
+            for app_ev in (getattr(res, "events", None) or []):
+                for k, v in (getattr(app_ev, "attributes", None)
+                             or {}).items():
+                    terms.setdefault(
+                        f"{getattr(app_ev, 'type', '')}.{k}",
+                        []).append(str(v))
+        return terms
+
+    def _event_json(self, ev) -> dict:
+        """Shallow JSON projection of event data."""
+        data = ev.data or {}
+        if not isinstance(data, dict):
+            return {"repr": str(data)}
+        out = {}
+        for k, v in data.items():
+            if isinstance(v, (int, str, bool, float)) or v is None:
+                out[k] = v
+            elif isinstance(v, bytes):
+                out[k] = _b64(v)
+            elif k == "block":
+                out["height"] = v.header.height
+                out["hash"] = v.hash().hex().upper()
+                out["num_txs"] = len(v.data.txs)
+            elif k == "result":
+                out["code"] = getattr(v, "code", 0)
+                out["log"] = getattr(v, "log", "")
+            else:
+                out[k] = str(v)
+        return out
 
     # -- dispatch ----------------------------------------------------------
 

@@ -124,10 +124,11 @@ class IndexerService:
     on the event bus and feeds both indexers."""
 
     def __init__(self, tx_indexer: "TxIndexer", block_indexer: "BlockIndexer",
-                 event_bus):
+                 event_bus, sinks=None):
         import threading
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
+        self.sinks = list(sinks or [])  # SQLEventSink etc (state/sinks.py)
         self._sub = event_bus.subscribe("NewBlock")
         self._bus = event_bus
         self._stop = threading.Event()
@@ -160,6 +161,16 @@ class IndexerService:
                     responses.end_block else [])
                 self.tx_indexer.index_block_txs(
                     h, block.data.txs, responses.deliver_txs or [])
+                for sink in self.sinks:
+                    t = block.header.time
+                    sink.index_block(
+                        h, f"{t.seconds}.{t.nanos:09d}",
+                        getattr(responses.begin_block, "events", []) if
+                        responses.begin_block else [],
+                        getattr(responses.end_block, "events", []) if
+                        responses.end_block else [])
+                    sink.index_txs(h, block.data.txs,
+                                   responses.deliver_txs or [])
             except Exception:
                 continue
 

@@ -1,0 +1,148 @@
+"""WebSocket event subscriptions over the RPC server (reference
+rpc/jsonrpc/server/ws_handler.go + rpc/core/events.go): subscribe with a
+pubsub query, receive matching events as JSON-RPC notifications,
+unsubscribe."""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+
+class MiniWSClient:
+    """Minimal RFC6455 client (client frames must be masked)."""
+
+    def __init__(self, host, port, timeout=10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n".encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0], resp
+
+    def send_json(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        n = len(payload)
+        if n < 126:
+            hdr = struct.pack("!BB", 0x81, 0x80 | n)
+        else:
+            hdr = struct.pack("!BBH", 0x81, 0x80 | 126, n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(hdr + mask + masked)
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError("closed")
+            buf += c
+        return buf
+
+    def recv_json(self):
+        b1, b2 = self._recv_exact(2)
+        ln = b2 & 0x7F
+        if ln == 126:
+            (ln,) = struct.unpack("!H", self._recv_exact(2))
+        elif ln == 127:
+            (ln,) = struct.unpack("!Q", self._recv_exact(8))
+        data = self._recv_exact(ln)
+        if (b1 & 0x0F) != 1:
+            return self.recv_json()
+        return json.loads(data)
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.mark.slow
+def test_ws_subscribe_new_block_and_tx(tmp_path):
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.consensus.config import test_config as fast_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = str(tmp_path / "node")
+    cfg = Config(home=home)
+    cfg.consensus = fast_config()
+    cfg.p2p.laddr = "127.0.0.1:0"
+    cfg.p2p.pex = False
+    cfg.rpc.laddr = "127.0.0.1:0"
+    cfg.ensure_dirs()
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    NodeKey.load_or_generate(cfg.node_key_file())
+    pub = pv.get_pub_key()
+    gdoc = GenesisDoc(chain_id="ws-chain",
+                      genesis_time=Timestamp(1700000000, 0),
+                      validators=[GenesisValidator(
+                          address=pub.address(), pub_key_type=pub.type_name,
+                          pub_key_bytes=pub.bytes(), power=10)])
+    with open(cfg.genesis_file(), "w") as f:
+        f.write(gdoc.to_json())
+
+    node = Node(cfg, KVStoreApplication())
+    node.start()
+    try:
+        host, port = node.rpc_server.host, node.rpc_server.port
+        ws = MiniWSClient(host, port)
+
+        # subscribe to new blocks
+        ws.send_json({"jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                      "params": {"query": "tm.event='NewBlock'"}})
+        ack = ws.recv_json()
+        assert ack["id"] == 1 and "result" in ack, ack
+
+        ev = ws.recv_json()
+        assert ev["result"]["query"] == "tm.event='NewBlock'"
+        assert ev["result"]["data"]["type"] == "tendermint/event/NewBlock"
+        h1 = ev["result"]["data"]["value"]["height"]
+        ev2 = ws.recv_json()
+        assert ev2["result"]["data"]["value"]["height"] > h1
+
+        # tx subscription with an app-attribute filter
+        ws.send_json({"jsonrpc": "2.0", "id": 2, "method": "subscribe",
+                      "params": {
+                          "query": "tm.event='Tx' AND app.creator="
+                                   "'kvstore'"}})
+        assert "result" in ws.recv_json()
+        node.mempool.check_tx(b"wskey=wsvalue")
+        deadline = time.time() + 30
+        got_tx = False
+        while time.time() < deadline and not got_tx:
+            msg = ws.recv_json()
+            if msg["result"]["data"]["type"] == "tendermint/event/Tx":
+                assert msg["result"]["data"]["value"]["code"] == 0
+                got_tx = True
+        assert got_tx, "tx event never delivered"
+
+        # unsubscribe stops block delivery
+        ws.send_json({"jsonrpc": "2.0", "id": 3, "method":
+                      "unsubscribe_all", "params": {}})
+        # drain until the ack; then no further frames should arrive
+        while True:
+            msg = ws.recv_json()
+            if msg.get("id") == 3:
+                break
+        ws.sock.settimeout(1.5)
+        with pytest.raises((TimeoutError, socket.timeout,
+                            ConnectionError)):
+            ws.recv_json()
+        ws.close()
+    finally:
+        node.stop()
