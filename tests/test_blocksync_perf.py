@@ -1,6 +1,6 @@
 """Blocksync replay throughput at BASELINE config-4 shape (150-validator
-commits), scaled down for CI.  The full-scale run (10k+ blocks) is
-scripts/bench_blocksync.py; this asserts the coalesced path works at the
+commits), scaled down for CI.  The full-scale run is
+scripts/bench_report.py (config 4); this asserts the coalesced path works at the
 real validator count and reports blocks/s + where the time goes."""
 from __future__ import annotations
 
