@@ -1,11 +1,13 @@
 """Consensus reactor (reference consensus/reactor.go): gossips round
 state, proposals/parts and votes over three channels (0x20-0x22).
 
-Simplifications vs the reference (full part-by-part/bit-array gossip comes
-with larger nets): new proposals/parts/votes are broadcast to all peers,
-and a per-peer catch-up thread re-sends votes/parts to peers that report
-(via NewRoundStep) being behind in the current height — enough for
-localnet-scale operation plus blocksync for big gaps.
+Vote gossip is bit-array-targeted like the reference: every added vote is
+announced with HasVote, each peer's have-bitmap is tracked per round, the
+gossip loop sends a peer only votes it lacks, and observed 2/3 majorities
+are announced with VoteSetMaj23 and answered with VoteSetBits (reference
+consensus/reactor.go gossipVotesRoutine + queryMaj23Routine).  New
+proposals/parts are broadcast; a per-peer catch-up thread serves
+store-backed history to peers behind our height.
 """
 from __future__ import annotations
 
@@ -58,11 +60,85 @@ class VoteGossip:
     vote: object
 
 
+@register
+@dataclass
+class HasVoteMessage:
+    """We hold this vote (reference consensus/reactor.go HasVoteMessage);
+    peers use it to avoid re-sending votes we already have."""
+    height: int
+    round: int
+    type: int       # SignedMsgType
+    index: int      # validator index
+
+
+@register
+@dataclass
+class VoteSetMaj23Message:
+    """We observed +2/3 on block_id (reference VoteSetMaj23Message); the
+    peer answers with its have-bitmap for that vote set."""
+    height: int
+    round: int
+    type: int
+    block_id: object
+
+
+@register
+@dataclass
+class VoteSetBitsMessage:
+    """Have-bitmap for (height, round, type, block_id) (reference
+    VoteSetBitsMessage)."""
+    height: int
+    round: int
+    type: int
+    block_id: object
+    bits_size: int
+    bits: bytes
+
+
+class _PeerState:
+    """Per-peer view (reference consensus/types/peer_round_state.go):
+    last reported round step + have-bitmaps for the current round."""
+
+    def __init__(self, step_msg: NewRoundStepMessage):
+        self.step = step_msg
+        self.prevotes: Optional[object] = None    # BitArray
+        self.precommits: Optional[object] = None
+
+    def apply_step(self, msg: NewRoundStepMessage):
+        if (msg.height, msg.round) != (self.step.height, self.step.round):
+            self.prevotes = None
+            self.precommits = None
+        self.step = msg
+
+    def _arr(self, type_: int, size: int):
+        from tendermint_tpu.libs.bits import BitArray
+        name = "prevotes" if type_ == int(SignedMsgType.PREVOTE)             else "precommits"
+        arr = getattr(self, name)
+        if arr is None or arr.size() != size:
+            arr = BitArray(size)
+            setattr(self, name, arr)
+        return arr
+
+    def set_has_vote(self, height: int, round_: int, type_: int,
+                     index: int, size: int):
+        if (height, round_) != (self.step.height, self.step.round):
+            return
+        if 0 <= index < size:
+            self._arr(type_, size).set_index(index, True)
+
+    def apply_bits(self, height: int, round_: int, type_: int, bits):
+        if (height, round_) != (self.step.height, self.step.round):
+            return
+        arr = self._arr(type_, bits.size())
+        setattr(self, "prevotes" if type_ == int(SignedMsgType.PREVOTE)
+                else "precommits", arr.or_(bits))
+
+
 class ConsensusReactor(Reactor):
     def __init__(self, cs: ConsensusState):
         super().__init__("CONSENSUS")
         self.cs = cs
-        self._peer_state: Dict[str, NewRoundStepMessage] = {}
+        self._peer_state: Dict[str, _PeerState] = {}
         self._catchup_sent: Dict[str, tuple] = {}  # peer -> (height, time)
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -73,6 +149,12 @@ class ConsensusReactor(Reactor):
         if cs.event_bus is not None:
             self._sub = cs.event_bus.subscribe("NewRoundStep")
             threading.Thread(target=self._step_broadcaster,
+                             daemon=True).start()
+            # every vote the state machine ADDS (own or peer) is announced
+            # so peers can subtract it from their gossip (reference
+            # broadcastHasVoteMessage, consensus/state.go:2124)
+            self._vote_sub = cs.event_bus.subscribe("Vote")
+            threading.Thread(target=self._has_vote_broadcaster,
                              daemon=True).start()
         threading.Thread(target=self._catchup_routine, daemon=True).start()
 
@@ -99,6 +181,19 @@ class ConsensusReactor(Reactor):
     def _on_new_vote(self, vote):
         if self.switch is not None:
             self.switch.broadcast(VOTE_CHANNEL, VoteGossip(vote))
+
+    def _has_vote_broadcaster(self):
+        while not self._stop.is_set():
+            try:
+                ev = self._vote_sub.queue.get(timeout=0.2)
+            except Exception:  # queue.Empty
+                continue
+            vote = (ev.data or {}).get("vote")
+            if vote is None or self.switch is None:
+                continue
+            self.switch.broadcast(STATE_CHANNEL, HasVoteMessage(
+                vote.height, vote.round, int(vote.type),
+                vote.validator_index))
 
     def _on_new_proposal(self, proposal):
         if self.switch is not None:
@@ -135,7 +230,35 @@ class ConsensusReactor(Reactor):
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, NewRoundStepMessage):
                 with self._lock:
-                    self._peer_state[peer.id] = msg
+                    ps = self._peer_state.get(peer.id)
+                    if ps is None:
+                        self._peer_state[peer.id] = _PeerState(msg)
+                    else:
+                        ps.apply_step(msg)
+            elif isinstance(msg, HasVoteMessage):
+                size = self._vote_set_size(msg.height)
+                with self._lock:
+                    ps = self._peer_state.get(peer.id)
+                    if ps is not None and size:
+                        ps.set_has_vote(msg.height, msg.round, msg.type,
+                                        msg.index, size)
+            elif isinstance(msg, VoteSetMaj23Message):
+                self._on_maj23(peer, msg)
+            elif isinstance(msg, VoteSetBitsMessage):
+                from tendermint_tpu.libs.bits import BitArray
+                # peer-controlled size: must equal our validator-set size
+                # for that height or the allocation is refused (a huge
+                # bits_size would otherwise allocate bits_size/8 bytes)
+                size = self._vote_set_size(msg.height)
+                if size == 0 or msg.bits_size != size \
+                        or len(msg.bits) != (size + 7) // 8:
+                    return
+                bits = BitArray.from_bytes(msg.bits_size, msg.bits)
+                with self._lock:
+                    ps = self._peer_state.get(peer.id)
+                    if ps is not None:
+                        ps.apply_bits(msg.height, msg.round, msg.type,
+                                      bits)
         elif ch_id == DATA_CHANNEL:
             if isinstance(msg, ProposalGossip):
                 self.cs.set_proposal(msg.proposal, peer_id=peer.id)
@@ -145,6 +268,44 @@ class ConsensusReactor(Reactor):
         elif ch_id == VOTE_CHANNEL:
             if isinstance(msg, VoteGossip):
                 self.cs.add_vote(msg.vote, peer_id=peer.id)
+
+    def _vote_set_size(self, height: int) -> int:
+        with self.cs._mtx:
+            rs = self.cs.rs
+            if rs.height != height or rs.validators is None:
+                return 0
+            return rs.validators.size()
+
+    def _on_maj23(self, peer: Peer, msg: "VoteSetMaj23Message"):
+        """Record the peer's claimed majority and answer with our
+        have-bitmap for that (height, round, type, block_id) (reference
+        handling of VoteSetMaj23Message -> VoteSetBitsMessage)."""
+        with self.cs._mtx:
+            rs = self.cs.rs
+            if rs.height != msg.height or rs.votes is None:
+                return
+            # bound the peer-supplied round: prevotes()/precommits()
+            # create vote sets on demand, so an unbounded round would let
+            # a peer allocate validator-sized sets for arbitrary rounds
+            if not 0 <= msg.round <= rs.round:
+                return
+            vs = rs.votes.prevotes(msg.round) \
+                if msg.type == int(SignedMsgType.PREVOTE) \
+                else rs.votes.precommits(msg.round)
+            if vs is None:
+                return
+            try:
+                vs.set_peer_maj23(peer.id, msg.block_id)
+            except Exception:
+                pass  # conflicting claims are the peer's problem
+            bits = vs.bit_array_by_block_id(msg.block_id)
+            if bits is None:
+                bits = vs.bit_array()
+        peer.try_send(STATE_CHANNEL, VoteSetBitsMessage(
+            msg.height, msg.round, msg.type, msg.block_id,
+            bits.size(), bits.to_bytes()))
+
+    MAJ23_QUERY_INTERVAL_S = 2.0
 
     # -- store-backed catch-up for peers behind our height -----------------
 
@@ -205,6 +366,7 @@ class ConsensusReactor(Reactor):
 
     def _catchup_routine(self):
         rng = random.Random()
+        last_maj23 = 0.0
         while not self._stop.is_set():
             time.sleep(0.1)
             if self.switch is None:
@@ -221,13 +383,28 @@ class ConsensusReactor(Reactor):
                 parts = rs.proposal_block_parts
                 if votes is None:
                     continue
-                prevotes = list(votes.prevotes(round_).votes)
-                precommits = list(votes.precommits(round_).votes)
+                pv_set = votes.prevotes(round_)
+                pc_set = votes.precommits(round_)
+                prevotes = list(pv_set.votes)
+                precommits = list(pc_set.votes)
+                pv_bits = pv_set.bit_array()
+                pc_bits = pc_set.bit_array()
+                pv_maj23 = pv_set.two_thirds_majority()
+                pc_maj23 = pc_set.two_thirds_majority()
+
+            # announce observed 2/3 majorities so peers can tell us which
+            # of those votes they still lack (reference queryMaj23Routine)
+            now = time.monotonic()
+            announce_maj23 = now - last_maj23 >= self.MAJ23_QUERY_INTERVAL_S
+            if announce_maj23:
+                last_maj23 = now
+
             for pid, ps in peer_states.items():
                 peer = self.switch.peers.get(pid)
                 if peer is None:
                     continue
-                if ps.height < height:
+                step = ps.step
+                if step.height < height:
                     # peer fell behind consensus while we're past its
                     # height: serve the decided block from the store —
                     # stored-commit precommits first (so the peer's
@@ -236,20 +413,55 @@ class ConsensusReactor(Reactor):
                     # consensus/reactor.go gossipDataForCatchup + the
                     # LoadBlockCommit branch of gossipVotesRoutine).
                     try:
-                        self._serve_catchup(peer, ps.height)
+                        self._serve_catchup(peer, step.height)
                     except Exception:  # noqa: BLE001 - keep routine alive
                         pass
                     continue
-                if ps.height != height:
+                if step.height != height:
                     continue
-                # re-send current-round votes the peer may be missing
-                candidates = [v for v in prevotes + precommits
-                              if v is not None]
-                if ps.round < round_ or ps.step < int(Step.PRECOMMIT):
-                    if candidates:
-                        v = rng.choice(candidates)
-                        peer.try_send(VOTE_CHANNEL, VoteGossip(v))
-                    if proposal is not None and ps.round == round_:
+                if announce_maj23:
+                    for type_, (bid, ok) in (
+                            (int(SignedMsgType.PREVOTE), pv_maj23),
+                            (int(SignedMsgType.PRECOMMIT), pc_maj23)):
+                        if ok and bid is not None:
+                            peer.try_send(STATE_CHANNEL, VoteSetMaj23Message(
+                                height, round_, type_, bid))
+                # send ONE vote the peer provably lacks (its HasVote /
+                # VoteSetBits bitmap subtracted from ours); fall back to a
+                # random known vote only when we have no bitmap for it
+                if step.round < round_ or step.step < int(Step.PRECOMMIT):
+                    if (step.height, step.round) == (height, round_):
+                        # same round: send one vote the peer provably
+                        # lacks; a missing bitmap means the peer reported
+                        # nothing — treat as empty (everything missing),
+                        # matching the reference's EnsureVoteBitArrays
+                        from tendermint_tpu.libs.bits import BitArray
+                        for type_, ours, vlist in (
+                                (int(SignedMsgType.PREVOTE), pv_bits,
+                                 prevotes),
+                                (int(SignedMsgType.PRECOMMIT), pc_bits,
+                                 precommits)):
+                            theirs = ps.prevotes \
+                                if type_ == int(SignedMsgType.PREVOTE) \
+                                else ps.precommits
+                            if theirs is None:
+                                theirs = BitArray(ours.size())
+                            missing = ours.sub(theirs)
+                            idx, ok = missing.pick_random(rng)
+                            if ok and vlist[idx] is not None:
+                                peer.try_send(VOTE_CHANNEL,
+                                              VoteGossip(vlist[idx]))
+                                break
+                    else:
+                        # peer behind in round: its bitmaps describe its
+                        # OLD round; send a random current-round vote so
+                        # it can observe 2/3 and advance
+                        candidates = [v for v in prevotes + precommits
+                                      if v is not None]
+                        if candidates:
+                            peer.try_send(VOTE_CHANNEL,
+                                          VoteGossip(rng.choice(candidates)))
+                    if proposal is not None and step.round == round_:
                         peer.try_send(DATA_CHANNEL, ProposalGossip(proposal))
                         if parts is not None:
                             for i in range(parts.header().total):
