@@ -140,6 +140,7 @@ class ConsensusReactor(Reactor):
         self.cs = cs
         self._peer_state: Dict[str, _PeerState] = {}
         self._catchup_sent: Dict[str, tuple] = {}  # peer -> (height, time)
+        self._data_resend: Dict[str, tuple] = {}  # peer -> ((h, r), time)
         self._lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -160,6 +161,12 @@ class ConsensusReactor(Reactor):
 
     def stop(self):
         self._stop.set()
+        bus = self.cs.event_bus
+        if bus is not None:
+            for attr in ("_sub", "_vote_sub"):
+                sub = getattr(self, attr, None)
+                if sub is not None:
+                    bus.unsubscribe(sub)
 
     def get_channels(self):
         return [
@@ -222,6 +229,7 @@ class ConsensusReactor(Reactor):
         with self._lock:
             self._peer_state.pop(peer.id, None)
             self._catchup_sent.pop(peer.id, None)
+            self._data_resend.pop(peer.id, None)
 
     # -- inbound -----------------------------------------------------------
 
@@ -292,8 +300,6 @@ class ConsensusReactor(Reactor):
             vs = rs.votes.prevotes(msg.round) \
                 if msg.type == int(SignedMsgType.PREVOTE) \
                 else rs.votes.precommits(msg.round)
-            if vs is None:
-                return
             try:
                 vs.set_peer_maj23(peer.id, msg.block_id)
             except Exception:
@@ -306,6 +312,8 @@ class ConsensusReactor(Reactor):
             bits.size(), bits.to_bytes()))
 
     MAJ23_QUERY_INTERVAL_S = 2.0
+
+    DATA_RESEND_S = 0.5  # per-peer proposal/part-set resend throttle
 
     # -- store-backed catch-up for peers behind our height -----------------
 
@@ -429,39 +437,56 @@ class ConsensusReactor(Reactor):
                 # send ONE vote the peer provably lacks (its HasVote /
                 # VoteSetBits bitmap subtracted from ours); fall back to a
                 # random known vote only when we have no bitmap for it
-                if step.round < round_ or step.step < int(Step.PRECOMMIT):
-                    if (step.height, step.round) == (height, round_):
-                        # same round: send one vote the peer provably
-                        # lacks; a missing bitmap means the peer reported
-                        # nothing — treat as empty (everything missing),
-                        # matching the reference's EnsureVoteBitArrays
-                        from tendermint_tpu.libs.bits import BitArray
-                        for type_, ours, vlist in (
-                                (int(SignedMsgType.PREVOTE), pv_bits,
-                                 prevotes),
-                                (int(SignedMsgType.PRECOMMIT), pc_bits,
-                                 precommits)):
-                            theirs = ps.prevotes \
-                                if type_ == int(SignedMsgType.PREVOTE) \
-                                else ps.precommits
-                            if theirs is None:
-                                theirs = BitArray(ours.size())
-                            missing = ours.sub(theirs)
-                            idx, ok = missing.pick_random(rng)
-                            if ok and vlist[idx] is not None:
-                                peer.try_send(VOTE_CHANNEL,
-                                              VoteGossip(vlist[idx]))
-                                break
-                    else:
-                        # peer behind in round: its bitmaps describe its
-                        # OLD round; send a random current-round vote so
-                        # it can observe 2/3 and advance
-                        candidates = [v for v in prevotes + precommits
-                                      if v is not None]
-                        if candidates:
+                if (step.height, step.round) == (height, round_):
+                    # targeted vote gossip for EVERY same-round peer — a
+                    # peer sitting in PRECOMMIT_WAIT still needs the
+                    # precommits it provably lacks (reference
+                    # gossipVotesRoutine serves precommits through
+                    # RoundStepPrecommitWait).  A missing bitmap means
+                    # the peer reported nothing — treat as empty
+                    # (everything missing), matching the reference's
+                    # EnsureVoteBitArrays.
+                    from tendermint_tpu.libs.bits import BitArray
+                    for type_, ours, vlist in (
+                            (int(SignedMsgType.PREVOTE), pv_bits,
+                             prevotes),
+                            (int(SignedMsgType.PRECOMMIT), pc_bits,
+                             precommits)):
+                        theirs = ps.prevotes \
+                            if type_ == int(SignedMsgType.PREVOTE) \
+                            else ps.precommits
+                        if theirs is None:
+                            theirs = BitArray(ours.size())
+                        missing = ours.sub(theirs)
+                        idx, ok = missing.pick_random(rng)
+                        if ok and vlist[idx] is not None:
                             peer.try_send(VOTE_CHANNEL,
-                                          VoteGossip(rng.choice(candidates)))
-                    if proposal is not None and step.round == round_:
+                                          VoteGossip(vlist[idx]))
+                            break
+                elif step.round < round_:
+                    # peer behind in round: its bitmaps describe its OLD
+                    # round; send a random current-round vote so it can
+                    # observe 2/3 and advance
+                    candidates = [v for v in prevotes + precommits
+                                  if v is not None]
+                    if candidates:
+                        peer.try_send(VOTE_CHANNEL,
+                                      VoteGossip(rng.choice(candidates)))
+                if proposal is not None and step.round == round_ \
+                        and step.step < int(Step.PRECOMMIT):
+                    # full proposal+parts resend, throttled per peer: an
+                    # unthrottled 0.1 s tick would re-queue the whole
+                    # block every tick and starve the DATA channel
+                    with self._lock:
+                        last = self._data_resend.get(pid)
+                        due = last is None or \
+                            last[0] != (height, round_) or \
+                            time.monotonic() - last[1] \
+                            >= self.DATA_RESEND_S
+                        if due:
+                            self._data_resend[pid] = ((height, round_),
+                                                      time.monotonic())
+                    if due:
                         peer.try_send(DATA_CHANNEL, ProposalGossip(proposal))
                         if parts is not None:
                             for i in range(parts.header().total):
