@@ -154,6 +154,29 @@ class BatchVerifier:
         return bool(out.all()), out
 
 
+def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
+                     tpu_threshold: int = 32) -> np.ndarray:
+    """Bitmap for n (pub, msg, sig) triples without per-item _Item objects
+    — the whole-commit path (types/validator_set.py), where n can be 100k+
+    and BatchVerifier's per-item add/dispatch bookkeeping would cost more
+    than the verification itself.  `msgs` may be a RaggedBytes (the batched
+    sign-bytes assembler's output) or any sequence of bytes.
+
+    Routing matches BatchVerifier: device kernel for big all-ed25519
+    batches, per-item host verify otherwise.  Skips the SigCache (a 100k
+    commit would evict the live-vote window; callers that need cache
+    population use BatchVerifier)."""
+    n = len(pubs)
+    if (n >= tpu_threshold and _use_device()
+            and all(p.type_name == ed.KEY_TYPE for p in pubs)):
+        return verify_ed25519_batch([p.bytes() for p in pubs], msgs, sigs)
+    bv = BatchVerifier(tpu_threshold=tpu_threshold)
+    for i in range(n):
+        bv.add(pubs[i], msgs[i], sigs[i])
+    _, bits = bv.verify()
+    return bits
+
+
 def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],
                          sigs: Sequence[bytes]) -> np.ndarray:
     """Raw-bytes ed25519 batch verify on the device (malformed lengths are

@@ -29,6 +29,18 @@ except ImportError:  # pragma: no cover
     _HAVE_OSSL = False
 
 
+def _pub_from_seed(seed: bytes) -> bytes:
+    """Seed -> public key, via OpenSSL when available (the pure-Python
+    ladder in _edref costs ~2.5 ms per key, which dominates large synthetic
+    validator-set construction)."""
+    if _HAVE_OSSL:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat)
+        return _OsslPriv.from_private_bytes(seed).public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw)
+    return _edref.pubkey_from_seed(seed)
+
+
 def _ossl_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     try:
         _OsslPub.from_public_bytes(pub).verify(sig, msg)
@@ -71,11 +83,11 @@ class PrivKey(_PrivKey):
         if len(data) == PRIVKEY_SIZE:
             self._seed = bytes(data[:32])
             self._pub = bytes(data[32:])
-            if _edref.pubkey_from_seed(self._seed) != self._pub:
+            if _pub_from_seed(self._seed) != self._pub:
                 raise ValueError("ed25519 privkey: pubkey half mismatch")
         elif len(data) == 32:
             self._seed = bytes(data)
-            self._pub = _edref.pubkey_from_seed(self._seed)
+            self._pub = _pub_from_seed(self._seed)
         else:
             raise ValueError("ed25519 privkey must be 32 or 64 bytes")
 

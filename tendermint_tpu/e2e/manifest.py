@@ -57,6 +57,15 @@ class Manifest:
                 raise ValueError(
                     f"{n.name}: state_sync requires start_at > 0 (the "
                     f"chain must have snapshots before the node launches)")
+        # app_hash is consensus-critical for every node (execution.py
+        # rejects blocks whose header app_hash differs from local state),
+        # so a heterogeneous app base — e.g. kvstore vs kvstore-provable,
+        # which hash state differently — forks the net at height 2.
+        bases = {n.app.split("@", 1)[0] or "kvstore" for n in self.nodes}
+        if len(bases) > 1:
+            raise ValueError(
+                f"all nodes must run the same app base (app_hash must "
+                f"agree across the net); manifest mixes {sorted(bases)}")
 
 
 def load_manifest(path: str) -> Manifest:

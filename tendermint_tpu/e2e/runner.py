@@ -45,6 +45,15 @@ class _NodeHandle:
         self.rpc_port = rpc_port
         self.proc: Optional[subprocess.Popen] = None
         self.log_path = os.path.join(home, "node.log")
+        self.logf = None  # open log handle for the current process, if any
+
+    def close_log(self):
+        if self.logf is not None:
+            try:
+                self.logf.close()
+            except OSError:
+                pass
+            self.logf = None
 
     @property
     def rpc(self) -> HTTPClient:
@@ -157,11 +166,12 @@ class E2ERunner:
         cfg.save()
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        logf = open(h.log_path, "ab")
+        h.close_log()  # kill/restart perturbations relaunch repeatedly
+        h.logf = open(h.log_path, "ab")
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cmd", "--home", h.home,
              "start", "--app", h.m.app],
-            stdout=logf, stderr=logf, cwd=REPO, env=env)
+            stdout=h.logf, stderr=h.logf, cwd=REPO, env=env)
         self.log(f"e2e start: {h.m.name} pid={h.proc.pid} "
                  f"rpc=127.0.0.1:{h.rpc_port}")
 
@@ -399,6 +409,7 @@ class E2ERunner:
                 except subprocess.TimeoutExpired:
                     h.proc.kill()
                     h.proc.wait()
+            h.close_log()
         self.log("e2e stop: all nodes down")
 
     # -- all together ------------------------------------------------------

@@ -92,10 +92,14 @@ def get_lib():
                 lib.tm_mod_l.argtypes = [u8p, u8p, u64]
                 lib.tm_challenge_prefixed.argtypes = [u8p, u8p, u64, u8p, u64]
                 lib.tm_challenge_batch.argtypes = [u8p, u8p, u64p, u8p, u64]
+                i64p = ctypes.POINTER(ctypes.c_int64)
+                lib.tm_vote_sign_bytes.argtypes = [
+                    i64p, i64p, u8p, u8p, u64, u8p, u64, u8p, u64,
+                    u8p, u64p, u64]
                 for fn in (lib.tm_sha512_prefixed, lib.tm_sha512_batch,
                            lib.tm_sha512_plain, lib.tm_scalar_canonical,
                            lib.tm_mod_l, lib.tm_challenge_prefixed,
-                           lib.tm_challenge_batch):
+                           lib.tm_challenge_batch, lib.tm_vote_sign_bytes):
                     fn.restype = None
                 _lib = lib
             except OSError:
@@ -136,15 +140,29 @@ def sha512_prefixed(prefix: np.ndarray, msgs, out: np.ndarray | None = None
                                ctypes.c_uint64(msgs.shape[1]), _u8p(out),
                                ctypes.c_uint64(B))
         return out
+    buf, offsets = _ragged(msgs, B)
+    lib.tm_sha512_batch(_u8p(prefix), _u8p(buf), _u64p(offsets), _u8p(out),
+                        ctypes.c_uint64(B))
+    return out
+
+
+def _ragged(msgs, B):
+    """(buf, offsets) for a list of bytes or a RaggedBytes (zero-copy)."""
+    from tendermint_tpu.libs.ragged import RaggedBytes
+
+    if isinstance(msgs, RaggedBytes):
+        assert len(msgs) == B
+        buf = np.ascontiguousarray(msgs.buf)
+        if buf.size == 0:
+            buf = np.zeros(1, dtype=np.uint8)
+        return buf, np.ascontiguousarray(msgs.offsets, dtype=np.uint64)
     lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
     offsets = np.zeros(B + 1, dtype=np.uint64)
     np.cumsum(lens, out=offsets[1:])
     buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
     if buf.size == 0:
         buf = np.zeros(1, dtype=np.uint8)
-    lib.tm_sha512_batch(_u8p(prefix), _u8p(buf), _u64p(offsets), _u8p(out),
-                        ctypes.c_uint64(B))
-    return out
+    return buf, offsets
 
 
 def sha512_plain(msgs) -> np.ndarray | None:
@@ -196,15 +214,43 @@ def challenge_scalars(prefix: np.ndarray, msgs) -> np.ndarray | None:
                                   ctypes.c_uint64(msgs.shape[1]), _u8p(out),
                                   ctypes.c_uint64(B))
         return out
-    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
-    offsets = np.zeros(B + 1, dtype=np.uint64)
-    np.cumsum(lens, out=offsets[1:])
-    buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
-    if buf.size == 0:
-        buf = np.zeros(1, dtype=np.uint8)
+    buf, offsets = _ragged(msgs, B)
     lib.tm_challenge_batch(_u8p(prefix), _u8p(buf), _u64p(offsets),
                            _u8p(out), ctypes.c_uint64(B))
     return out
+
+
+def vote_sign_bytes(seconds: np.ndarray, nanos: np.ndarray,
+                    variant: np.ndarray, prefix0: bytes, prefix1: bytes,
+                    suffix: bytes):
+    """Batch-assemble CanonicalVote sign bytes that differ only in the
+    Timestamp field and BlockID variant (types/canonical.py
+    commit_sign_bytes_batch).  Returns (buf, offsets) — message i is
+    buf[offsets[i]:offsets[i+1]] — or None when the library is missing."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = seconds.shape[0]
+    seconds = np.ascontiguousarray(seconds, dtype=np.int64)
+    nanos = np.ascontiguousarray(nanos, dtype=np.int64)
+    variant = np.ascontiguousarray(variant, dtype=np.uint8)
+    p0 = np.frombuffer(prefix0, dtype=np.uint8) if prefix0 else \
+        np.zeros(1, dtype=np.uint8)
+    p1 = np.frombuffer(prefix1, dtype=np.uint8) if prefix1 else \
+        np.zeros(1, dtype=np.uint8)
+    sf = np.frombuffer(suffix, dtype=np.uint8) if suffix else \
+        np.zeros(1, dtype=np.uint8)
+    worst = 10 + 2 + 22 + max(len(prefix0), len(prefix1)) + len(suffix)
+    buf = np.empty(n * worst, dtype=np.uint8)
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.tm_vote_sign_bytes(
+        seconds.ctypes.data_as(i64p), nanos.ctypes.data_as(i64p),
+        _u8p(variant), _u8p(p0), ctypes.c_uint64(len(prefix0)),
+        _u8p(p1), ctypes.c_uint64(len(prefix1)),
+        _u8p(sf), ctypes.c_uint64(len(suffix)),
+        _u8p(buf), _u64p(offsets), ctypes.c_uint64(n))
+    return buf, offsets
 
 
 def scalar_canonical(s_bytes: np.ndarray) -> np.ndarray | None:

@@ -1,0 +1,58 @@
+"""RaggedBytes: a batch of variable-length byte strings stored as one
+contiguous buffer plus offsets.
+
+The batched sign-bytes assembler (types/canonical.py
+commit_sign_bytes_batch) produces 100k+ messages per VerifyCommit; keeping
+them as one numpy buffer lets the native staging (native/staging.c
+tm_challenge_batch) hash the whole batch without materializing 100k Python
+bytes objects, while __getitem__ still yields ordinary bytes for the
+hashlib fallback and error paths.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class RaggedBytes:
+    __slots__ = ("buf", "offsets", "_bytes")
+
+    def __init__(self, buf: np.ndarray, offsets: np.ndarray):
+        self.buf = buf                  # (total,) uint8
+        self.offsets = offsets          # (n + 1,) uint64
+        self._bytes = None              # lazy bytes(buf) for cheap slicing
+
+    @classmethod
+    def from_list(cls, msgs) -> "RaggedBytes":
+        lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64,
+                           count=len(msgs))
+        offsets = np.zeros(len(msgs) + 1, dtype=np.uint64)
+        np.cumsum(lens, out=offsets[1:])
+        joined = b"".join(bytes(m) for m in msgs)
+        buf = np.frombuffer(joined, dtype=np.uint8) if joined else \
+            np.zeros(0, dtype=np.uint8)
+        return cls(buf, offsets)
+
+    def __len__(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def __getitem__(self, i) -> bytes:
+        if self._bytes is None:
+            self._bytes = self.buf.tobytes()
+        return self._bytes[int(self.offsets[i]):int(self.offsets[i + 1])]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def lengths(self) -> np.ndarray:
+        return (self.offsets[1:] - self.offsets[:-1]).astype(np.int64)
+
+    def fixed_width(self) -> np.ndarray | None:
+        """(n, w) uint8 view when every message has the same length w
+        (the fixed-width fast path of native.sha512_prefixed), else None."""
+        lens = self.lengths()
+        if lens.size and (lens == lens[0]).all():
+            w = int(lens[0])
+            end = int(self.offsets[-1])
+            return self.buf[:end].reshape(len(self), w)
+        return None

@@ -241,6 +241,17 @@ class LightProxy:
         resp = r["response"]
         pops = resp.get("proof_ops") or []
         if not pops:
+            # the client asked for proof; a proof-less answer (including an
+            # empty-value "does not exist") must not pass silently, or a
+            # malicious primary could deny any key by stripping the proof
+            # (reference light/rpc/client.go ABCIQueryWithOptions errors on
+            # an empty proof)
+            if prove:
+                raise ProxyError(
+                    -32603,
+                    "primary returned no proof_ops for an abci_query with "
+                    "prove=true (cannot verify the response, including "
+                    "absence claims)")
             resp["verified"] = False
             return {"response": resp}
         res_height = int(resp.get("height") or 0)

@@ -299,3 +299,68 @@ EXPORT void tm_scalar_canonical(const uint8_t *s, uint8_t *out, uint64_t n) {
         out[i] = (uint8_t)ok;
     }
 }
+
+/* ------------------------------------------------- vote sign-bytes batch */
+
+/* Protobuf uvarint; returns number of bytes written. */
+static int uvarint_enc(uint64_t v, uint8_t *out) {
+    int n = 0;
+    while (v >= 0x80) {
+        out[n++] = (uint8_t)(v & 0x7F) | 0x80;
+        v >>= 7;
+    }
+    out[n++] = (uint8_t)v;
+    return n;
+}
+
+/* Assemble the per-validator CanonicalVote sign bytes of a whole commit
+ * (reference types/block.go:799-811): within one commit the encodings
+ * differ only in the Timestamp field and the BlockID variant (for-block
+ * vs nil), so the caller passes the two precomputed prefix variants
+ * (fields 1..4) and the shared suffix (field 6, chain_id) and this
+ * routine encodes only the timestamp per entry.
+ *
+ *   seconds/nanos: per-entry google.protobuf.Timestamp components
+ *   variant[i]:    0 -> prefix0 (voted for the block), 1 -> prefix1 (nil)
+ *   outbuf:        caller-allocated, worst case n*(10+2+17+max_plen+slen)
+ *   offsets:       n+1 entries; offsets[0] is read as the starting offset
+ *
+ * Layout per entry: uvarint(body_len) || prefix || 0x2a || uvarint(ts_len)
+ * || ts_body || suffix, where ts_body = [0x08 uvarint(seconds)]
+ * [0x10 uvarint(nanos)] with proto3 zero omission. */
+EXPORT void tm_vote_sign_bytes(const int64_t *seconds, const int64_t *nanos,
+                               const uint8_t *variant,
+                               const uint8_t *prefix0, uint64_t p0len,
+                               const uint8_t *prefix1, uint64_t p1len,
+                               const uint8_t *suffix, uint64_t slen,
+                               uint8_t *outbuf, uint64_t *offsets,
+                               uint64_t n) {
+    uint64_t off = offsets[0];
+    for (uint64_t i = 0; i < n; i++) {
+        const uint8_t *pre = variant[i] ? prefix1 : prefix0;
+        uint64_t plen = variant[i] ? p1len : p0len;
+        uint8_t ts[22]; /* worst case: two 10-byte varints + two tags */
+        int tslen = 0;
+        if (seconds[i] != 0) {
+            ts[tslen++] = 0x08;
+            tslen += uvarint_enc((uint64_t)seconds[i], ts + tslen);
+        }
+        if (nanos[i] != 0) {
+            ts[tslen++] = 0x10;
+            tslen += uvarint_enc((uint64_t)nanos[i], ts + tslen);
+        }
+        uint64_t body_len = plen + 2 + (uint64_t)tslen + slen;
+        uint8_t *p = outbuf + off;
+        p += uvarint_enc(body_len, p);
+        memcpy(p, pre, plen);
+        p += plen;
+        *p++ = 0x2a; /* tag(5, BYTES): the Timestamp field */
+        *p++ = (uint8_t)tslen;
+        memcpy(p, ts, (size_t)tslen);
+        p += tslen;
+        memcpy(p, suffix, slen);
+        p += slen;
+        off = (uint64_t)(p - outbuf);
+        offsets[i + 1] = off;
+    }
+}

@@ -30,6 +30,54 @@ def canonical_vote_bytes(chain_id: str, vtype: SignedMsgType, height: int,
     return pe.length_delimited(body)
 
 
+def commit_sign_bytes_batch(chain_id: str, commit, indices):
+    """Sign bytes of the precommits at `indices` of one commit, assembled
+    as a batch (RaggedBytes).
+
+    Within a commit the per-validator encodings share everything except the
+    Timestamp field and the BlockID variant (for-block vs nil — reference
+    types/block.go:799-811), so fields 1..4 are encoded once per variant
+    and only the timestamp is encoded per entry (native/staging.c
+    tm_vote_sign_bytes; numpy-free Python fallback below).  Byte-identical
+    to canonical_vote_bytes per index (tests/test_types.py).
+    """
+    import numpy as np
+
+    from tendermint_tpu.libs import native
+    from tendermint_tpu.libs.ragged import RaggedBytes
+
+    from .basic import BlockIDFlag
+
+    head = (pe.varint_field(1, int(SignedMsgType.PRECOMMIT))
+            + pe.sfixed64_field(2, commit.height)
+            + pe.sfixed64_field(3, commit.round))
+    prefix0 = head + pe.message_field(4, commit.block_id.canonical_proto())
+    prefix1 = head  # nil vote: zero BlockID encodes to an absent field 4
+    suffix = pe.string_field(6, chain_id)
+
+    sigs = commit.signatures
+    n = len(indices)
+    seconds = np.fromiter((sigs[i].timestamp.seconds for i in indices),
+                          dtype=np.int64, count=n)
+    nanos = np.fromiter((sigs[i].timestamp.nanos for i in indices),
+                        dtype=np.int64, count=n)
+    variant = np.fromiter(
+        (0 if sigs[i].block_id_flag == BlockIDFlag.COMMIT else 1
+         for i in indices), dtype=np.uint8, count=n)
+    out = native.vote_sign_bytes(seconds, nanos, variant,
+                                 prefix0, prefix1, suffix)
+    if out is not None:
+        return RaggedBytes(*out)
+    # no C toolchain: per-index Python assembly (same shared-prefix trick)
+    pieces = []
+    for j in range(n):
+        ts = pe.timestamp_msg(int(seconds[j]), int(nanos[j]))
+        body = ((prefix1 if variant[j] else prefix0)
+                + pe.message_field_always(5, ts) + suffix)
+        pieces.append(pe.length_delimited(body))
+    return RaggedBytes.from_list(pieces)
+
+
 def canonical_proposal_bytes(chain_id: str, height: int, round_: int,
                              pol_round: int, block_id: BlockID,
                              timestamp: Timestamp) -> bytes:

@@ -27,8 +27,10 @@ from dataclasses import dataclass
 from fractions import Fraction
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from tendermint_tpu.crypto import merkle
-from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.crypto.batch import verify_sigs_bulk
 from tendermint_tpu.libs.safemath import (
     INT64_MAX, INT64_MIN, safe_add_clip, safe_mul, safe_sub_clip, trunc_div)
 
@@ -324,21 +326,10 @@ class ValidatorSet:
         batch; tallies for-block power; raises on any bad signature or
         insufficient power."""
         self._check_commit_header(chain_id, block_id, height, commit)
-        bv = BatchVerifier()
-        batch_idx = []
-        for idx, cs in enumerate(commit.signatures):
-            if cs.is_absent():
-                continue
-            val = self.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-                   cs.signature)
-            batch_idx.append(idx)
-        all_ok, bits = bv.verify()
-        if not all_ok:
-            bad = batch_idx[int(next(i for i, b in enumerate(bits) if not b))]
-            raise CommitVerifyError(
-                f"wrong signature (#{bad}): "
-                f"{commit.signatures[bad].signature.hex()}")
+        batch_idx = [idx for idx, cs in enumerate(commit.signatures)
+                     if not cs.is_absent()]
+        self._verify_sigs_batch(chain_id, commit, batch_idx,
+                                [self.validators[i] for i in batch_idx])
         tallied = sum(self.validators[i].voting_power
                       for i in batch_idx if commit.signatures[i].for_block())
         needed = self.total_voting_power() * 2 // 3
@@ -443,13 +434,24 @@ class ValidatorSet:
 
     def _verify_prefix_batch(self, chain_id: str, commit: Commit,
                              prefix: List[int], vals: List[Validator]):
-        bv = BatchVerifier()
-        for idx, val in zip(prefix, vals):
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-                   commit.signatures[idx].signature)
-        all_ok, bits = bv.verify()
-        if not all_ok:
-            bad = prefix[int(next(i for i, b in enumerate(bits) if not b))]
+        self._verify_sigs_batch(chain_id, commit, prefix, vals)
+
+    def _verify_sigs_batch(self, chain_id: str, commit: Commit,
+                           idxs: List[int], vals: List[Validator]):
+        """Exact check-all verification of the signatures at `idxs`
+        (belonging to `vals`, same order) in one batch: sign bytes come
+        from the shared-prefix batch assembler (types/canonical.py
+        commit_sign_bytes_batch) and verification from the bulk routing
+        path (crypto/batch.verify_sigs_bulk) — no per-signature Python
+        objects on the 100k-validator path."""
+        from .canonical import commit_sign_bytes_batch
+
+        msgs = commit_sign_bytes_batch(chain_id, commit, idxs)
+        bits = verify_sigs_bulk([v.pub_key for v in vals], msgs,
+                                [commit.signatures[i].signature
+                                 for i in idxs])
+        if not bits.all():
+            bad = idxs[int(np.argmin(bits))]
             raise CommitVerifyError(
                 f"wrong signature (#{bad}): "
                 f"{commit.signatures[bad].signature.hex()}")

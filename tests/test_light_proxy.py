@@ -144,6 +144,27 @@ def test_proxy_abci_query_proof_verified(node, proxy):
     assert base64.b64decode(q["response"]["value"]) == b"lightvalue"
 
 
+def test_proxy_abci_query_stripped_proof_rejected(node, proxy):
+    """A primary stripping proof_ops (e.g. to deny a key's existence)
+    must error when the client asked for proof, not pass with
+    verified=False (reference light/rpc/client.go errors on empty
+    proof)."""
+    orig = proxy.primary.call
+
+    def stripped(method, **params):
+        r = orig(method, **params)
+        if method == "abci_query":
+            r["response"]["proof_ops"] = []
+        return r
+
+    proxy.primary.call = stripped
+    try:
+        with pytest.raises(RPCClientError, match="no proof_ops"):
+            _call(proxy, "abci_query", data=b"lightkey".hex())
+    finally:
+        proxy.primary.call = orig
+
+
 def test_proxy_abci_query_bad_proof_rejected(node, proxy):
     """A primary serving a value that does not match its own app hash
     must be caught (tamper with the forwarded response)."""

@@ -276,3 +276,36 @@ def test_commit_hash_covers_signatures():
     _, _, c2 = _make_commit(pairs, nil={1})
     assert c1.hash() != c2.hash()
     assert len(c1.hash()) == 32
+
+
+def test_commit_sign_bytes_batch_byte_exact():
+    """commit_sign_bytes_batch must be byte-identical to the per-index
+    canonical_vote_bytes encoder, for both the native C assembler and the
+    pure-Python fallback (nil votes, zero nanos, Go-zero timestamps)."""
+    from tendermint_tpu.libs import native
+    from tendermint_tpu.types.canonical import commit_sign_bytes_batch
+
+    pairs = _mkvals(9)
+    vs, bid, commit = _make_commit(pairs, nil={1, 5})
+    # edge-case timestamps: zero nanos, Go zero time (negative seconds)
+    commit.signatures[2].__dict__["timestamp"] = Timestamp(1700000000, 0)
+    commit.signatures[5].__dict__["timestamp"] = Timestamp.zero()
+    idxs = list(range(len(commit.signatures)))
+    want = [commit.vote_sign_bytes(CHAIN, i) for i in idxs]
+
+    got = commit_sign_bytes_batch(CHAIN, commit, idxs)
+    assert len(got) == len(want)
+    assert [got[i] for i in idxs] == want
+
+    if native.get_lib() is not None:  # force the no-C fallback too
+        orig = native.vote_sign_bytes
+        native.vote_sign_bytes = lambda *a, **k: None
+        try:
+            fb = commit_sign_bytes_batch(CHAIN, commit, idxs)
+        finally:
+            native.vote_sign_bytes = orig
+        assert [fb[i] for i in idxs] == want
+
+    # subsets and duplicates resolve by index
+    sub = commit_sign_bytes_batch(CHAIN, commit, [7, 0, 7])
+    assert [sub[0], sub[1], sub[2]] == [want[7], want[0], want[7]]
