@@ -444,7 +444,15 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
 
     On TPU the fused Pallas kernel (ops/pallas_ed25519.py) runs the whole
     verification in VMEM (~3.5x the XLA-composed kernel); elsewhere the
-    XLA kernel is used."""
+    XLA kernel is used.  On a multi-device host the batch shards across
+    the local mesh (parallel/sharding.data_plane) — this function is the
+    single seam every verifier in the node goes through, so multi-chip is
+    the production path, not a side demo."""
+    from tendermint_tpu.parallel.sharding import data_plane
+
+    plane = data_plane()
+    if plane is not None and plane.worth_sharding(len(pubkeys)):
+        return plane.verify_batch(pubkeys, msgs, sigs)
     if _use_pallas():
         from . import pallas_ed25519 as pe
         packed, host_ok = prepare_batch_packed(pubkeys, sigs, msgs)
