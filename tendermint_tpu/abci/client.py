@@ -11,7 +11,8 @@ import threading
 import time
 
 from . import types as abci
-from .server import parse_addr, read_frame, write_frame
+from . import wire
+from .server import parse_addr
 
 
 class ABCIClientError(Exception):
@@ -51,14 +52,18 @@ class SocketClient(abci.Application):
     def _call(self, method: str, req):
         with self._lock:
             try:
-                write_frame(self._sock, (method, req))
-                frame = read_frame(self._sock)
+                wire.write_frame(self._sock,
+                                 wire.encode_request(method, req))
+                frame = wire.read_frame(self._sock)
             except OSError as e:
                 raise ABCIClientError(f"app connection broken: {e}")
         if frame is None:
             raise ABCIClientError("app closed the connection")
-        rmethod, resp = frame
-        if rmethod == "error":
+        try:
+            rmethod, resp = wire.decode_response(frame)
+        except ValueError as e:
+            raise ABCIClientError(f"undecodable app response: {e}")
+        if rmethod == "exception":
             raise ABCIClientError(str(resp))
         if rmethod != method:
             raise ABCIClientError(

@@ -1,11 +1,10 @@
 """ABCI socket server (reference abci/server/socket_server.go): serve an
 Application to an external node process over unix/tcp sockets.
 
-Framing: 4-byte big-endian length + allowlisted-codec payload of
-(method_name, request).  The ABCI socket is the operator's own app process
-— a trusted local channel (the reference's socket protocol makes the same
-assumption); Byzantine-exposed wire paths (p2p gossip, storage of gossiped
-data) use the canonical proto codecs instead.
+Framing: uvarint length-delimited canonical proto Request/Response
+(abci/wire.py; reference abci/types/messages.go WriteMessage) — the same
+bytes a Go node or Go app would put on this socket, so non-Python
+applications interoperate.
 
 Requests on one connection are handled strictly in order (the reference's
 per-connection ordering guarantee that consensus relies on).
@@ -14,22 +13,11 @@ from __future__ import annotations
 
 import os
 import socket
-import struct
 import threading
 from typing import Optional, Tuple
 
-from tendermint_tpu.libs import safe_codec
-
 from . import types as abci
-
-# every request/response dataclass is already registered with safe_codec
-# via _register_defaults; method names double as the dispatch table
-METHODS = (
-    "echo", "flush", "info", "init_chain", "query", "check_tx",
-    "begin_block", "deliver_tx", "end_block", "commit",
-    "list_snapshots", "offer_snapshot", "load_snapshot_chunk",
-    "apply_snapshot_chunk", "prepare_proposal", "process_proposal",
-)
+from . import wire
 
 
 def parse_addr(addr: str) -> Tuple[str, object]:
@@ -42,34 +30,6 @@ def parse_addr(addr: str) -> Tuple[str, object]:
         host, _, port = hostport.rpartition(":")
         return "tcp", (host or "127.0.0.1", int(port))
     raise ValueError(f"unsupported ABCI address {addr!r}")
-
-
-def read_frame(sock: socket.socket):
-    hdr = _read_exact(sock, 4)
-    if hdr is None:
-        return None
-    (n,) = struct.unpack(">I", hdr)
-    if n > 64 * 1024 * 1024:
-        raise ConnectionError("ABCI frame too large")
-    body = _read_exact(sock, n)
-    if body is None:
-        return None
-    return safe_codec.loads(body)
-
-
-def write_frame(sock: socket.socket, obj) -> None:
-    body = safe_codec.dumps(obj)
-    sock.sendall(struct.pack(">I", len(body)) + body)
-
-
-def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
 
 
 class ABCIServer:
@@ -120,32 +80,42 @@ class ABCIServer:
     def _serve_conn(self, conn: socket.socket):
         try:
             while not self._stop.is_set():
-                frame = read_frame(conn)
+                frame = wire.read_frame(conn)
                 if frame is None:
                     return
-                method, req = frame
+                try:
+                    method, req = wire.decode_request(frame)
+                except ValueError as e:
+                    wire.write_frame(conn,
+                                     wire.encode_response("exception", e))
+                    continue
                 if method == "echo":
-                    write_frame(conn, ("echo", req))
+                    wire.write_frame(conn,
+                                     wire.encode_response("echo", req))
                     continue
                 if method == "flush":
-                    write_frame(conn, ("flush", None))
+                    wire.write_frame(conn,
+                                     wire.encode_response("flush", None))
                     continue
-                if method not in METHODS:
-                    write_frame(conn, ("error", f"unknown method {method}"))
+                try:
+                    with self._app_lock:
+                        if method == "deliver_tx":
+                            resp = self.app.deliver_tx(req)
+                        elif method == "end_block":
+                            resp = self.app.end_block(req)
+                        elif method in ("commit", "list_snapshots"):
+                            resp = getattr(self.app, method)()
+                        elif method in ("offer_snapshot",
+                                        "load_snapshot_chunk",
+                                        "apply_snapshot_chunk"):
+                            resp = getattr(self.app, method)(*req)
+                        else:
+                            resp = getattr(self.app, method)(req)
+                except Exception as e:  # noqa: BLE001 - app bug -> exception
+                    wire.write_frame(conn,
+                                     wire.encode_response("exception", e))
                     continue
-                with self._app_lock:
-                    if method == "deliver_tx":
-                        resp = self.app.deliver_tx(req)
-                    elif method == "end_block":
-                        resp = self.app.end_block(req)
-                    elif method in ("commit", "list_snapshots"):
-                        resp = getattr(self.app, method)()
-                    elif method in ("offer_snapshot", "load_snapshot_chunk",
-                                    "apply_snapshot_chunk"):
-                        resp = getattr(self.app, method)(*req)
-                    else:
-                        resp = getattr(self.app, method)(req)
-                write_frame(conn, (method, resp))
+                wire.write_frame(conn, wire.encode_response(method, resp))
         except (ConnectionError, OSError):
             pass
         finally:

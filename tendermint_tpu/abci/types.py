@@ -92,6 +92,29 @@ class ResponseQuery:
 
 
 @dataclass
+class ValidatorInfo:
+    """The slice of a validator ABCI apps consume (reference abci
+    Validator{address, power}); duck-type compatible with types.Validator
+    (.address / .voting_power), which the in-process path passes."""
+    address: bytes = b""
+    voting_power: int = 0
+
+
+@dataclass
+class Misbehavior:
+    """Evidence as ABCI apps see it over the socket (reference abci
+    Misbehavior; types/evidence.go ABCI() conversion).  type: 1 =
+    duplicate vote, 2 = light-client attack."""
+    type: int = 0
+    validator_address: bytes = b""
+    validator_power: int = 0
+    height: int = 0
+    time_seconds: int = 0
+    time_nanos: int = 0
+    total_voting_power: int = 0
+
+
+@dataclass
 class RequestBeginBlock:
     hash: bytes = b""
     header_proto: bytes = b""
@@ -179,6 +202,11 @@ class ResponsePrepareProposal:
 class RequestProcessProposal:
     txs: List[bytes] = field(default_factory=list)
     header_proto: bytes = b""
+    # filled from the wire fields when the request crosses the socket
+    # (the header itself does not; reference RequestProcessProposal
+    # carries hash/height/time/... instead of a Header)
+    hash: bytes = b""
+    height: int = 0
 
 
 @dataclass

@@ -13,7 +13,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.p2p import wire
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.block import Block
@@ -27,35 +29,79 @@ STATUS_UPDATE_INTERVAL_S = 10.0     # reference reactor.go:41
 SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0  # reference reactor.go:44
 
 
-@register
 @dataclass
 class BlockRequest:
     height: int
 
 
-@register
 @dataclass
 class NoBlockResponse:
     height: int
 
 
-@register
 @dataclass
 class BlockResponse:
     block_proto: bytes
 
 
-@register
 @dataclass
 class StatusRequest:
     pass
 
 
-@register
 @dataclass
 class StatusResponse:
     base: int
     height: int
+
+
+# -- wire codec (proto/tendermint/blocksync/types.proto Message oneof:
+# block_request=1, no_block_response=2, block_response=3{block=1},
+# status_request=4, status_response=5{height=1, base=2}) ------------------
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, BlockRequest):
+        return wire.oneof_encode(1, pe.varint_field(1, msg.height))
+    if isinstance(msg, NoBlockResponse):
+        return wire.oneof_encode(2, pe.varint_field(1, msg.height))
+    if isinstance(msg, BlockResponse):
+        return wire.oneof_encode(
+            3, pe.message_field_always(1, msg.block_proto))
+    if isinstance(msg, StatusRequest):
+        return wire.oneof_encode(4, b"")
+    if isinstance(msg, StatusResponse):
+        return wire.oneof_encode(5, (pe.varint_field(1, msg.height)
+                                     + pe.varint_field(2, msg.base)))
+    raise TypeError(f"unknown blocksync message {type(msg).__name__}")
+
+
+def _dec_status_response(body: bytes) -> StatusResponse:
+    f = pd.parse(body)
+    return StatusResponse(base=pd.get_int(f, 2), height=pd.get_int(f, 1))
+
+
+def _dec_block_response(body: bytes) -> BlockResponse:
+    f = pd.parse(body)
+    b = pd.get_message(f, 1)
+    if b is None:
+        raise pd.ProtoError("BlockResponse: missing block")
+    return BlockResponse(b)
+
+
+_HANDLERS = {
+    1: lambda b: BlockRequest(pd.get_int(pd.parse(b), 1)),
+    2: lambda b: NoBlockResponse(pd.get_int(pd.parse(b), 1)),
+    3: _dec_block_response,
+    4: lambda b: StatusRequest(),
+    5: _dec_status_response,
+}
+
+
+def decode_msg(data: bytes):
+    return wire.oneof_decode(data, _HANDLERS)
+
+
+wire.register_codec(BLOCKSYNC_CHANNEL, encode_msg, decode_msg)
 
 
 class BlocksyncReactor(Reactor):
@@ -66,6 +112,8 @@ class BlocksyncReactor(Reactor):
         up (the node wires this to ConsensusState start / SwitchToConsensus,
         reference reactor.go:322-330)."""
         super().__init__("BLOCKSYNC")
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("blocksync")
         self.executor = executor
         self.store = store
         self.state = state
@@ -77,6 +125,11 @@ class BlocksyncReactor(Reactor):
                               self._send_request, self._peer_error)
         self._stop = threading.Event()
         self._switched = False
+        # self-reported sync rate, EMA logged every 100 blocks
+        # (reference blocksync/reactor.go:416-421)
+        self._rate_t0 = time.monotonic()
+        self._rate_marked = 0
+        self._rate_ema = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -128,7 +181,7 @@ class BlocksyncReactor(Reactor):
             sw.stop_peer_for_error(peer, reason)
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
+        msg = decode_msg(msg_bytes)
         if isinstance(msg, BlockRequest):
             block = self.store.load_block(msg.height)
             if block is not None:
@@ -202,4 +255,16 @@ class BlocksyncReactor(Reactor):
             return e.applied > 0
         self.pool.pop_requests(n)
         self.blocks_synced += n
+        if self.blocks_synced - self._rate_marked >= 100:
+            now = time.monotonic()
+            dt = max(now - self._rate_t0, 1e-9)
+            rate = (self.blocks_synced - self._rate_marked) / dt
+            self._rate_ema = rate if self._rate_ema == 0.0 \
+                else 0.9 * self._rate_ema + 0.1 * rate
+            self.log.info("fast sync rate",
+                          height=self.state.last_block_height,
+                          max_peer_height=self.pool.max_peer_height,
+                          blocks_per_s=round(self._rate_ema, 1))
+            self._rate_t0 = now
+            self._rate_marked = self.blocks_synced
         return n > 0

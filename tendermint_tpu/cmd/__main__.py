@@ -52,6 +52,9 @@ def cmd_start(args):
 
     cfg = Config.load(_home(args))
     cfg.home = _home(args)
+    from tendermint_tpu.libs import log as tmlog
+    tmlog.setup(level=getattr(args, "log_level", "") or cfg.log_level,
+                module_levels=cfg.log_module_levels)
     if args.p2p_laddr:
         cfg.p2p.laddr = args.p2p_laddr
     if args.rpc_laddr:
@@ -534,6 +537,8 @@ def main(argv=None):
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
     sp.add_argument("--persistent-peers", dest="persistent_peers",
                     default="")
+    sp.add_argument("--log-level", dest="log_level", default="",
+                    help="debug|info|error|none (default: config)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("testnet", help="initialize a local testnet")

@@ -71,6 +71,10 @@ class BatchVerifierConfig:
 class Config:
     home: str = ""
     moniker: str = "node"
+    # reference config.go LogLevel: default level, with optional
+    # per-module overrides "consensus:debug,p2p:error" in log_module_levels
+    log_level: str = "info"
+    log_module_levels: str = ""
     # if set ("unix:///..." or "tcp://host:port"), the node listens here
     # and uses the remote signer that dials in instead of the file PV
     # (reference config.go PrivValidatorListenAddr)
@@ -134,6 +138,8 @@ class Config:
         text = f"""# tendermint_tpu node configuration
 moniker = "{self._q(self.moniker)}"
 priv_validator_laddr = "{self._q(self.priv_validator_laddr)}"
+log_level = "{self._q(self.log_level)}"
+log_module_levels = "{self._q(self.log_module_levels)}"
 
 [p2p]
 laddr = "{self._q(self.p2p.laddr)}"
@@ -195,6 +201,8 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             d = tomllib.load(f)
         cfg.moniker = d.get("moniker", cfg.moniker)
         cfg.priv_validator_laddr = d.get("priv_validator_laddr", "")
+        cfg.log_level = d.get("log_level", cfg.log_level)
+        cfg.log_module_levels = d.get("log_module_levels", "")
         p = d.get("p2p", {})
         cfg.p2p = P2PConfig(
             laddr=p.get("laddr", cfg.p2p.laddr),

@@ -14,85 +14,19 @@ from __future__ import annotations
 import random
 import threading
 import time
-from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from tendermint_tpu.libs.safe_codec import loads, register
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.basic import SignedMsgType
 from tendermint_tpu.types.vote import Vote
 
+from .messages import (DATA_CHANNEL, STATE_CHANNEL, VOTE_CHANNEL,
+                       BlockPartGossip, HasVoteMessage, NewRoundStepMessage,
+                       ProposalGossip, VoteGossip, VoteSetBitsMessage,
+                       VoteSetMaj23Message, decode_msg)
 from .round_types import Step
 from .state import ConsensusState
-
-STATE_CHANNEL = 0x20
-DATA_CHANNEL = 0x21
-VOTE_CHANNEL = 0x22
-
-
-@register
-@dataclass
-class NewRoundStepMessage:
-    height: int
-    round: int
-    step: int
-    last_commit_round: int
-
-
-@register
-@dataclass
-class ProposalGossip:
-    proposal: object
-
-
-@register
-@dataclass
-class BlockPartGossip:
-    height: int
-    round: int
-    part: object
-
-
-@register
-@dataclass
-class VoteGossip:
-    vote: object
-
-
-@register
-@dataclass
-class HasVoteMessage:
-    """We hold this vote (reference consensus/reactor.go HasVoteMessage);
-    peers use it to avoid re-sending votes we already have."""
-    height: int
-    round: int
-    type: int       # SignedMsgType
-    index: int      # validator index
-
-
-@register
-@dataclass
-class VoteSetMaj23Message:
-    """We observed +2/3 on block_id (reference VoteSetMaj23Message); the
-    peer answers with its have-bitmap for that vote set."""
-    height: int
-    round: int
-    type: int
-    block_id: object
-
-
-@register
-@dataclass
-class VoteSetBitsMessage:
-    """Have-bitmap for (height, round, type, block_id) (reference
-    VoteSetBitsMessage)."""
-    height: int
-    round: int
-    type: int
-    block_id: object
-    bits_size: int
-    bits: bytes
 
 
 class _PeerState:
@@ -234,7 +168,9 @@ class ConsensusReactor(Reactor):
     # -- inbound -----------------------------------------------------------
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
+        # proto decode: malformed peer bytes raise ProtoError and the
+        # switch disconnects the peer (no pickle on the wire)
+        msg = decode_msg(msg_bytes)
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, NewRoundStepMessage):
                 with self._lock:

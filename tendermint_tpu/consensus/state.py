@@ -68,6 +68,9 @@ class ConsensusState:
                              if priv_validator else None)
         self.event_bus = event_bus
         self.name = name
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("consensus").with_(node=name) if name \
+            else tmlog.logger("consensus")
 
         self.rs = RoundState()
         self.state: Optional[SMState] = None
@@ -148,8 +151,8 @@ class ConsensusState:
                 # fsync leaves the WAL one marker behind the handshake-
                 # recovered state; the handshake already applied the
                 # block, so there is nothing left to replay)
-                print(f"consensus[{self.name}]: catchup replay error, "
-                      f"proceeding to start state anyway: {e}", flush=True)
+                self.log.info("catchup replay error, proceeding to "
+                              "start state anyway", err=str(e))
         self._stop.clear()
         self._thread = threading.Thread(target=self._receive_routine,
                                         name=f"consensus-{self.name}",
@@ -347,7 +350,8 @@ class ConsensusState:
             if peer_id == "":
                 raise
             # TODO: punish peer through the switch (reference StopPeerForError)
-            print(f"[consensus-{self.name}] bad msg from {peer_id}: {e}")
+            self.log.error("bad message from peer", peer=peer_id,
+                           err=str(e))
 
     def _on_ticker_timeout(self, ti: TimeoutInfo):
         self._internal_queue.put((ti, ""))
@@ -435,6 +439,7 @@ class ConsensusState:
         self.metrics.round_duration.observe(
             max(time.time() - self._round_t0, 0.0))
         self._round_t0 = time.time()
+        self.log.debug("entering new round", height=height, round=round_)
         rs.round = round_
         rs.step = Step.NEW_ROUND
         rs.validators = validators
@@ -815,6 +820,10 @@ class ConsensusState:
         state_copy = self.state.copy()
         new_state, _ = self.block_exec.apply_block(
             state_copy, block_id, block)
+        from tendermint_tpu.libs.log import Lazy
+        self.log.info("finalized block", height=height,
+                      round=rs.commit_round, txs=len(block.data.txs),
+                      hash=Lazy(block.hash))  # lazy: reference state.go:1647
 
         m = self.metrics  # reference consensus/metrics.go recordMetrics
         m.num_txs.set(len(block.data.txs))

@@ -362,8 +362,25 @@ class E2ERunner:
         if missing:
             raise E2EError(
                 f"validators never signed in the last 8 commits: {missing}")
+
+        # structured logging invariant: every node emits parseable
+        # leveled lines (libs/log); committing nodes log finalized blocks
+        for name, h in self.nodes.items():
+            if h.proc is None:
+                continue
+            try:
+                with open(h.log_path, "rb") as f:
+                    logtext = f.read().decode(errors="replace")
+            except OSError:
+                raise E2EError(f"{name}: no node log at {h.log_path}")
+            if " node: starting node" not in logtext:
+                raise E2EError(f"{name}: missing structured startup line")
+            if not h.m.state_sync and \
+                    " consensus: finalized block" not in logtext:
+                raise E2EError(f"{name}: no structured commit lines")
         self.log(f"e2e test: invariants hold at heights {sample}, "
-                 f"{len(expected)} validators all signing")
+                 f"{len(expected)} validators all signing, "
+                 f"structured logs present")
 
     # -- stage: benchmark --------------------------------------------------
 

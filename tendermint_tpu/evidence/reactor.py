@@ -6,7 +6,9 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
-from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.p2p import wire
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.evidence import (EvidenceError,
@@ -19,12 +21,25 @@ EVIDENCE_CHANNEL = 0x38
 BROADCAST_INTERVAL_S = 10.0
 
 
-@register
 @dataclass
 class EvidenceGossip:
-    """Carries the canonical proto encoding (reference evidence/reactor.go
-    evidenceListToProto)."""
-    evidence_proto: bytes
+    """One or more canonical Evidence proto encodings — the wire format is
+    tendermint.types.EvidenceList {repeated Evidence evidence = 1}
+    (reference evidence/reactor.go evidenceListToProto)."""
+    evidence_protos: list
+
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, EvidenceGossip):
+        return pe.repeated_message_field(1, msg.evidence_protos)
+    raise TypeError(f"unknown evidence message {type(msg).__name__}")
+
+
+def decode_msg(data: bytes) -> EvidenceGossip:
+    return EvidenceGossip(pd.get_messages(pd.parse(data), 1))
+
+
+wire.register_codec(EVIDENCE_CHANNEL, encode_msg, decode_msg)
 
 
 class EvidenceReactor(Reactor):
@@ -52,28 +67,31 @@ class EvidenceReactor(Reactor):
         self._sent.pop(peer.id, None)
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
-        if not isinstance(msg, EvidenceGossip):
-            return
-        try:
-            ev = evidence_from_proto(msg.evidence_proto)
-            self.pool.add_evidence(ev)
-        except (EvidenceError, Exception) as e:
-            # invalid evidence from a peer: drop it (reference reactor.go
-            # punishes the peer; the switch hook does that here)
-            sw = self.switch
-            if sw is not None and isinstance(e, EvidenceError):
-                sw.stop_peer_for_error(peer, f"bad evidence: {e}")
+        msg = decode_msg(msg_bytes)
+        for ev_proto in msg.evidence_protos:
+            try:
+                ev = evidence_from_proto(ev_proto)
+                self.pool.add_evidence(ev)
+            except EvidenceError as e:
+                # provably invalid evidence: punish the peer (reference
+                # reactor.go); the remaining items die with the peer
+                sw = self.switch
+                if sw is not None:
+                    sw.stop_peer_for_error(peer, f"bad evidence: {e}")
+                return
+            except Exception:  # noqa: BLE001
+                # undecodable/unverifiable item (e.g. missing state):
+                # drop IT, keep processing the rest of the batch
+                continue
 
     def _send_pending(self, peer: Peer):
         sent = self._sent.get(peer.id, set())
-        for ev in self.pool.pending_evidence():
-            h = ev.hash()
-            if h in sent:
-                continue
-            if peer.try_send(EVIDENCE_CHANNEL,
-                             EvidenceGossip(evidence_proto(ev))):
-                sent.add(h)
+        fresh = [(ev.hash(), evidence_proto(ev))
+                 for ev in self.pool.pending_evidence()
+                 if ev.hash() not in sent]
+        if fresh and peer.try_send(
+                EVIDENCE_CHANNEL, EvidenceGossip([p for _, p in fresh])):
+            sent.update(h for h, _ in fresh)
 
     def _broadcast_routine(self):
         while not self._stop.is_set():

@@ -118,3 +118,35 @@ class BitArray:
         ba = cls(bits)
         ba.elems[: len(data)] = data[: len(ba.elems)]
         return ba
+
+    # -- proto codec (tendermint.libs.bits.BitArray) -----------------------
+    # {int64 bits = 1; repeated uint64 elems = 2}: the reference stores
+    # 64-bit words with bit i at word i/64, bit i%64 — identical overall
+    # bit order to our little-endian byte layout.
+
+    def proto(self) -> bytes:
+        from tendermint_tpu.libs import protoenc as pe
+
+        nwords = (self.bits + 63) // 64
+        padded = bytes(self.elems) + b"\0" * (nwords * 8 - len(self.elems))
+        body = pe.varint_field(1, self.bits)
+        if nwords:
+            packed = b"".join(
+                pe.uvarint(int.from_bytes(padded[8 * i:8 * i + 8], "little"))
+                for i in range(nwords))
+            body += pe.tag(2, pe.WT_BYTES) + pe.uvarint(len(packed)) + packed
+        return body
+
+    @classmethod
+    def from_proto(cls, body: bytes) -> "BitArray":
+        from tendermint_tpu.libs import protodec as pd
+
+        f = pd.parse(body)
+        bits = pd.get_int(f, 1, 0)
+        if bits < 0 or bits > 1 << 24:  # sanity cap on peer input
+            raise pd.ProtoError(f"BitArray: bad size {bits}")
+        words = pd.get_packed_uvarints(f, 2)
+        ba = cls(bits)
+        raw = b"".join(w.to_bytes(8, "little") for w in words)
+        ba.elems[: len(raw)] = raw[: len(ba.elems)]
+        return ba

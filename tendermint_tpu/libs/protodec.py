@@ -160,6 +160,24 @@ def get_messages(fields: Fields, num: int) -> List[bytes]:
     return out
 
 
+def get_packed_uvarints(fields: Fields, num: int) -> List[int]:
+    """repeated uint64: accepts both the packed proto3 form (one BYTES
+    blob of concatenated varints) and the unpacked form (repeated VARINT
+    entries), like any conforming proto parser."""
+    out: List[int] = []
+    for wt, v in fields.get(num, ()):
+        if wt == WT_VARINT:
+            out.append(v)
+        elif wt == WT_BYTES:
+            pos = 0
+            while pos < len(v):
+                x, pos = read_uvarint(v, pos)
+                out.append(x)
+        else:
+            raise ProtoError(f"field {num}: expected packed varints")
+    return out
+
+
 def read_length_delimited(data: bytes) -> bytes:
     """Inverse of protoenc.length_delimited: uvarint(len) || msg."""
     ln, pos = read_uvarint(data, 0)

@@ -7,7 +7,9 @@ import time
 from dataclasses import dataclass
 from typing import Dict
 
-from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.p2p import wire
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 
@@ -16,10 +18,27 @@ from .mempool import Mempool
 MEMPOOL_CHANNEL = 0x30
 
 
-@register
 @dataclass
 class TxsMessage:
     txs: list
+
+
+# -- wire codec (proto/tendermint/mempool/types.proto: Message oneof
+# txs=1, Txs{repeated bytes txs=1}) ---------------------------------------
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, TxsMessage):
+        return wire.oneof_encode(
+            1, pe.repeated_bytes_field(1, [bytes(t) for t in msg.txs]))
+    raise TypeError(f"unknown mempool message {type(msg).__name__}")
+
+
+def decode_msg(data: bytes):
+    return wire.oneof_decode(data, {
+        1: lambda b: TxsMessage(pd.get_messages(pd.parse(b), 1))})
+
+
+wire.register_codec(MEMPOOL_CHANNEL, encode_msg, decode_msg)
 
 
 class MempoolReactor(Reactor):
@@ -47,7 +66,7 @@ class MempoolReactor(Reactor):
             self._peer_sent.pop(peer.id, None)
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
+        msg = decode_msg(msg_bytes)
         if isinstance(msg, TxsMessage):
             for tx in msg.txs:
                 self.mempool.check_tx(bytes(tx))

@@ -161,8 +161,10 @@ class Node:
 
     def __init__(self, config: Config, app, genesis: Optional[GenesisDoc]
                  = None, in_memory: bool = False):
+        from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.proxy import AppConns, ClientCreator
         self.config = config
+        self.log = tmlog.logger("node").with_(moniker=config.moniker)
         # four logical app connections (reference proxy/multi_app_conn.go);
         # a plain in-process Application shares one instance across all
         self.app_conns = app if isinstance(app, AppConns) \
@@ -361,6 +363,10 @@ class Node:
         if self._started:
             raise NodeError("node already started")
         self._started = True
+        self.log.info("starting node",
+                      node_id=self.node_key.node_id,
+                      chain_id=self.genesis.chain_id,
+                      height=self.state.last_block_height)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,
@@ -402,25 +408,25 @@ class Node:
             except StateSyncError as e:
                 attempts += 1
                 if attempts % 10 == 1:
-                    print(f"node[{self.config.moniker}]: statesync attempt "
-                          f"{attempts}: {e}", flush=True)
+                    self.log.info("statesync attempt failed",
+                                  attempt=attempts, err=str(e))
                 # no (verifiable) snapshots yet; re-poll the peers — the
                 # serving side may take its first snapshot after connect
                 self.statesync_reactor.request_snapshots()
                 _time.sleep(1.0)
         if state is None:
             if not self._stopping:
-                print(f"node[{self.config.moniker}]: statesync found no "
-                      f"usable snapshot; falling back to blocksync",
-                      flush=True)
+                self.log.info(
+                    "statesync found no usable snapshot; "
+                    "falling back to blocksync")
             self.blocksync_reactor.start()
             return
         self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(state.last_block_height, commit)
         self.state = state
         self.blocksync_reactor.switch_to_blocksync(state)
-        print(f"node[{self.config.moniker}]: statesync restored height "
-              f"{state.last_block_height}", flush=True)
+        self.log.info("statesync restored state",
+                      height=state.last_block_height)
         self.blocksync_reactor.start()
 
     def _on_caught_up(self, state):
@@ -435,6 +441,8 @@ class Node:
 
     def stop(self):
         self._stopping = True
+        self.log.info("stopping node",
+                      height=self.block_store.height())
         self.indexer_service.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()

@@ -28,8 +28,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
 
+from . import wire
 from .connection import ChannelDescriptor
 from .switch import Peer, Reactor
 
@@ -381,16 +383,58 @@ class AddrBook:
 # reactor
 # ---------------------------------------------------------------------------
 
-@register
 @dataclass
 class PexRequest:
     pass
 
 
-@register
 @dataclass
 class PexAddrs:
     addrs: list          # [(node_id, "host:port"), ...]
+
+
+# -- wire codec (proto/tendermint/p2p/pex.proto Message oneof:
+# pex_request=1, pex_addrs=2{repeated NetAddress addrs=1};
+# NetAddress{id=1, ip=2, port=3}) -----------------------------------------
+
+def _enc_net_address(node_id: str, addr: str) -> bytes:
+    host, _, port = addr.rpartition(":")
+    try:
+        port_n = int(port)
+    except ValueError:
+        port_n = 0
+    return (pe.string_field(1, node_id) + pe.string_field(2, host)
+            + pe.varint_field(3, port_n))
+
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, PexRequest):
+        return wire.oneof_encode(1, b"")
+    if isinstance(msg, PexAddrs):
+        body = pe.repeated_message_field(
+            1, [_enc_net_address(nid, a) for nid, a in msg.addrs])
+        return wire.oneof_encode(2, body)
+    raise TypeError(f"unknown pex message {type(msg).__name__}")
+
+
+def _dec_addrs(body: bytes) -> PexAddrs:
+    out = []
+    for m in pd.get_messages(pd.parse(body), 1):
+        f = pd.parse(m)
+        nid = pd.get_string(f, 1)
+        ip = pd.get_string(f, 2)
+        port = pd.get_uint(f, 3)
+        if nid and ip and 0 < port < 65536:
+            out.append((nid, f"{ip}:{port}"))
+    return PexAddrs(out)
+
+
+def decode_msg(data: bytes):
+    return wire.oneof_decode(data, {1: lambda b: PexRequest(),
+                                    2: _dec_addrs})
+
+
+wire.register_codec(PEX_CHANNEL, encode_msg, decode_msg)
 
 
 class PexReactor(Reactor):
@@ -467,7 +511,7 @@ class PexReactor(Reactor):
         peer.try_send(PEX_CHANNEL, PexRequest())
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
+        msg = decode_msg(msg_bytes)
         if isinstance(msg, PexRequest):
             # rate-limit: one request per peer per ensure period
             # (reference pex_reactor.go:83 receiveRequest).  NOTE: the

@@ -8,7 +8,6 @@ persistent peers with exponential backoff.
 """
 from __future__ import annotations
 
-import json
 import socket
 import threading
 import time
@@ -16,11 +15,15 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from tendermint_tpu.libs import safe_codec
-
+from . import wire
 from .connection import ChannelDescriptor, MConnection
 from .key import NodeKey
 from .secret_connection import SecretConnection
+
+
+# protocol versions (reference version/version.go:18-24)
+P2P_PROTOCOL = 8
+BLOCK_PROTOCOL = 11
 
 
 @dataclass
@@ -31,21 +34,67 @@ class NodeInfo:
     version: str
     channels: bytes        # supported channel ids
     moniker: str = ""
+    protocol_p2p: int = P2P_PROTOCOL
+    protocol_block: int = BLOCK_PROTOCOL
+    protocol_app: int = 0
+    tx_index: str = "on"
+    rpc_address: str = ""
 
     def to_bytes(self) -> bytes:
-        return json.dumps({
-            "node_id": self.node_id, "listen_addr": self.listen_addr,
-            "network": self.network, "version": self.version,
-            "channels": self.channels.hex(), "moniker": self.moniker,
-        }).encode()
+        """tendermint.p2p.DefaultNodeInfo proto body (p2p/types.proto):
+        protocol_version=1{p2p=1,block=2,app=3}, default_node_id=2,
+        listen_addr=3, network=4, version=5, channels=6, moniker=7,
+        other=8{tx_index=1, rpc_address=2}."""
+        from tendermint_tpu.libs import protoenc as pe
+        pv = (pe.varint_field(1, self.protocol_p2p)
+              + pe.varint_field(2, self.protocol_block)
+              + pe.varint_field(3, self.protocol_app))
+        other = (pe.string_field(1, self.tx_index)
+                 + pe.string_field(2, self.rpc_address))
+        return (pe.message_field_always(1, pv)
+                + pe.string_field(2, self.node_id)
+                + pe.string_field(3, self.listen_addr)
+                + pe.string_field(4, self.network)
+                + pe.string_field(5, self.version)
+                + pe.bytes_field(6, self.channels)
+                + pe.string_field(7, self.moniker)
+                + pe.message_field_always(8, other))
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "NodeInfo":
-        d = json.loads(data.decode())
-        return cls(node_id=d["node_id"], listen_addr=d["listen_addr"],
-                   network=d["network"], version=d["version"],
-                   channels=bytes.fromhex(d["channels"]),
-                   moniker=d.get("moniker", ""))
+        from tendermint_tpu.libs import protodec as pd
+        f = pd.parse(data)
+        pv = pd.parse(pd.get_message(f, 1) or b"")
+        other = pd.parse(pd.get_message(f, 8) or b"")
+        return cls(node_id=pd.get_string(f, 2),
+                   listen_addr=pd.get_string(f, 3),
+                   network=pd.get_string(f, 4),
+                   version=pd.get_string(f, 5),
+                   channels=pd.get_bytes(f, 6),
+                   moniker=pd.get_string(f, 7),
+                   protocol_p2p=pd.get_uint(pv, 1),
+                   protocol_block=pd.get_uint(pv, 2),
+                   protocol_app=pd.get_uint(pv, 3),
+                   tx_index=pd.get_string(other, 1),
+                   rpc_address=pd.get_string(other, 2))
+
+    def compatible_with(self, other: "NodeInfo") -> Optional[str]:
+        """None when compatible, else the reason (reference
+        p2p/node_info.go:179 CompatibleWith): same block protocol, same
+        network, and at least one common channel."""
+        if self.protocol_block != other.protocol_block:
+            return (f"peer is on a different Block version: "
+                    f"{other.protocol_block} != {self.protocol_block}")
+        if self.network != other.network:
+            return (f"peer is on a different network: "
+                    f"{other.network!r} != {self.network!r}")
+        if not self.channels:
+            return None  # no channels = just testing
+        if not set(self.channels) & set(other.channels):
+            return (f"no common channels: ours "
+                    f"{self.channels.hex()}, theirs "
+                    f"{other.channels.hex()}")
+        return None
 
 
 class Reactor:
@@ -83,10 +132,10 @@ class Peer:
         return self.node_info.node_id
 
     def send(self, ch_id: int, msg) -> bool:
-        return self.mconn.send(ch_id, safe_codec.dumps(msg))
+        return self.mconn.send(ch_id, wire.encode(ch_id, msg))
 
     def try_send(self, ch_id: int, msg) -> bool:
-        return self.mconn.try_send(ch_id, safe_codec.dumps(msg))
+        return self.mconn.try_send(ch_id, wire.encode(ch_id, msg))
 
     def stop(self):
         self.mconn.stop()
@@ -96,7 +145,10 @@ class Switch:
     def __init__(self, node_key: NodeKey, listen_addr: str, network: str,
                  moniker: str = "", version: str = "0.1.0",
                  metrics_registry=None):
+        from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.libs.metrics import P2PMetrics
+        self.log = tmlog.logger("p2p").with_(moniker=moniker) if moniker \
+            else tmlog.logger("p2p")
         self._metrics = P2PMetrics(metrics_registry)
         self.node_key = node_key
         self.listen_addr = listen_addr
@@ -236,9 +288,9 @@ class Switch:
         sock.settimeout(None)
         if their_info.node_id != sconn.remote_node_id:
             raise ValueError("node id does not match secret-connection key")
-        if their_info.network != self.network:
-            raise ValueError(
-                f"wrong network: {their_info.network} != {self.network}")
+        incompat = self.node_info().compatible_with(their_info)
+        if incompat is not None:
+            raise ValueError(f"incompatible peer: {incompat}")
         if their_info.node_id == self.node_key.node_id:
             raise ValueError("self connection")
         with self._lock:
@@ -256,7 +308,9 @@ class Switch:
                 try:
                     reactor.receive(ch_id, peer, msg)
                 except Exception as e:  # noqa: BLE001
-                    traceback.print_exc()
+                    self.log.error("reactor receive failed",
+                                   channel=f"{ch_id:#x}", peer=peer.id,
+                                   err=traceback.format_exc(limit=6))
                     self.stop_peer_for_error(peer, e)
 
         def on_error(e: Exception):
@@ -270,6 +324,8 @@ class Switch:
         with self._lock:
             self.peers[peer.id] = peer
             self._metrics.peers.set(len(self.peers))
+        self.log.info("added peer", peer=peer.id,
+                      addr=their_info.listen_addr, outbound=outbound)
         # introduce the peer to every reactor BEFORE the recv thread can
         # dispatch its messages (sends queue until mconn.start drains
         # them), so no reactor ever receives from an unknown peer
@@ -286,19 +342,21 @@ class Switch:
             self._metrics.peers.set(len(self.peers))
         if existing is None:
             return
+        self.log.info("stopping peer", peer=peer.id, reason=str(reason))
         peer.stop()
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)
             except Exception:  # noqa: BLE001
-                traceback.print_exc()
+                self.log.error("remove_peer hook failed", peer=peer.id,
+                               err=traceback.format_exc(limit=6))
         if peer.persistent and not self._stop.is_set():
             addr = peer.data.get("dial_addr") or peer.node_info.listen_addr
             self._schedule_reconnect(addr, peer.id)
 
     def broadcast(self, ch_id: int, msg) -> None:
         """Queue msg to all peers (reference p2p/switch.go:264)."""
-        data = safe_codec.dumps(msg)
+        data = wire.encode(ch_id, msg)
         with self._lock:
             peers = list(self.peers.values())
         for p in peers:

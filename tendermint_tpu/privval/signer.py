@@ -11,45 +11,43 @@ signing requests over the single connection.
   signer side: SignerServer (dials, loops: read request -> ask the
                wrapped FilePV -> respond)
 
-Framing: 4-byte big-endian length + allowlisted-codec payload — the same
-trusted-local-channel convention as the ABCI socket (abci/server.py).
-Double-sign protection stays with the key: the remote FilePV enforces its
-HRS monotonicity and the refusal travels back as a RemoteSignerError.
+Framing: uvarint length-delimited canonical proto
+tendermint.privval.Message (reference privval/types.proto,
+signer_endpoint.go protoio readers) — a Go remote signer (tmkms-style)
+interoperates.  Double-sign protection stays with the key: the remote
+FilePV enforces its HRS monotonicity and the refusal travels back as a
+RemoteSignerError.
 """
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
 
-from tendermint_tpu.libs import safe_codec
-from tendermint_tpu.libs.safe_codec import register
-
+from tendermint_tpu.abci import wire as abci_wire
 from tendermint_tpu.abci.server import parse_addr
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.p2p.wire import oneof_decode, oneof_encode
 
 
-@register
 @dataclass
 class PingRequest:
     pass
 
 
-@register
 @dataclass
 class PingResponse:
     pass
 
 
-@register
 @dataclass
 class PubKeyRequest:
     chain_id: str = ""
 
 
-@register
 @dataclass
 class PubKeyResponse:
     key_type: str = ""
@@ -57,28 +55,24 @@ class PubKeyResponse:
     error: str = ""
 
 
-@register
 @dataclass
 class SignVoteRequest:
     chain_id: str
     vote: object
 
 
-@register
 @dataclass
 class SignedVoteResponse:
     vote: object = None
     error: str = ""
 
 
-@register
 @dataclass
 class SignProposalRequest:
     chain_id: str
     proposal: object
 
 
-@register
 @dataclass
 class SignedProposalResponse:
     proposal: object = None
@@ -89,28 +83,126 @@ class RemoteSignerError(Exception):
     pass
 
 
+# -- proto codec (privval/types.proto Message oneof: pub_key_request=1,
+# pub_key_response=2, sign_vote_request=3, signed_vote_response=4,
+# sign_proposal_request=5, signed_proposal_response=6, ping_request=7,
+# ping_response=8) ----------------------------------------------------------
+
+def _enc_err(error: str) -> bytes:
+    if not error:
+        return b""
+    return pe.message_field_always(
+        2, pe.varint_field(1, 1) + pe.string_field(2, error))
+
+
+def _dec_err(f) -> str:
+    e = pd.get_message(f, 2)
+    if e is None:
+        return ""
+    return pd.get_string(pd.parse(e), 2) or "remote signer error"
+
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, PubKeyRequest):
+        return oneof_encode(1, pe.string_field(1, msg.chain_id))
+    if isinstance(msg, PubKeyResponse):
+        pub = abci_wire.enc_public_key(msg.key_type, msg.key_bytes) \
+            if msg.key_bytes else b""
+        return oneof_encode(2, pe.message_field_always(1, pub)
+                            + _enc_err(msg.error))
+    if isinstance(msg, SignVoteRequest):
+        return oneof_encode(3, pe.message_field_always(1, msg.vote.proto())
+                            + pe.string_field(2, msg.chain_id))
+    if isinstance(msg, SignedVoteResponse):
+        body = (pe.message_field_always(1, msg.vote.proto())
+                if msg.vote is not None else b"")
+        return oneof_encode(4, body + _enc_err(msg.error))
+    if isinstance(msg, SignProposalRequest):
+        return oneof_encode(
+            5, pe.message_field_always(1, msg.proposal.proto())
+            + pe.string_field(2, msg.chain_id))
+    if isinstance(msg, SignedProposalResponse):
+        body = (pe.message_field_always(1, msg.proposal.proto())
+                if msg.proposal is not None else b"")
+        return oneof_encode(6, body + _enc_err(msg.error))
+    if isinstance(msg, PingRequest):
+        return oneof_encode(7, b"")
+    if isinstance(msg, PingResponse):
+        return oneof_encode(8, b"")
+    raise TypeError(f"unknown privval message {type(msg).__name__}")
+
+
+def _dec_pub_key_response(body: bytes) -> PubKeyResponse:
+    f = pd.parse(body)
+    ktype, kbytes = "", b""
+    pub = pd.get_message(f, 1)
+    if pub is not None:
+        ktype, kbytes = abci_wire.dec_public_key(pub, default_type="")
+    return PubKeyResponse(key_type=ktype, key_bytes=kbytes,
+                          error=_dec_err(f))
+
+
+def _dec_sign_vote_request(body: bytes) -> SignVoteRequest:
+    from tendermint_tpu.types.vote import Vote
+    f = pd.parse(body)
+    v = pd.get_message(f, 1)
+    if v is None:
+        raise pd.ProtoError("SignVoteRequest: missing vote")
+    return SignVoteRequest(chain_id=pd.get_string(f, 2),
+                           vote=Vote.from_proto(v))
+
+
+def _dec_signed_vote_response(body: bytes) -> SignedVoteResponse:
+    from tendermint_tpu.types.vote import Vote
+    f = pd.parse(body)
+    v = pd.get_message(f, 1)
+    return SignedVoteResponse(
+        vote=Vote.from_proto(v) if v else None, error=_dec_err(f))
+
+
+def _dec_sign_proposal_request(body: bytes) -> SignProposalRequest:
+    from tendermint_tpu.types.proposal import Proposal
+    f = pd.parse(body)
+    p = pd.get_message(f, 1)
+    if p is None:
+        raise pd.ProtoError("SignProposalRequest: missing proposal")
+    return SignProposalRequest(chain_id=pd.get_string(f, 2),
+                               proposal=Proposal.from_proto(p))
+
+
+def _dec_signed_proposal_response(body: bytes) -> SignedProposalResponse:
+    from tendermint_tpu.types.proposal import Proposal
+    f = pd.parse(body)
+    p = pd.get_message(f, 1)
+    return SignedProposalResponse(
+        proposal=Proposal.from_proto(p) if p else None, error=_dec_err(f))
+
+
+_HANDLERS = {
+    1: lambda b: PubKeyRequest(pd.get_string(pd.parse(b), 1)),
+    2: _dec_pub_key_response,
+    3: _dec_sign_vote_request,
+    4: _dec_signed_vote_response,
+    5: _dec_sign_proposal_request,
+    6: _dec_signed_proposal_response,
+    7: lambda b: PingRequest(),
+    8: lambda b: PingResponse(),
+}
+
+
+def decode_msg(data: bytes):
+    return oneof_decode(data, _HANDLERS)
+
+
 def _read_frame(sock: socket.socket):
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack(">I", hdr)
-    if n > 16 * 1024 * 1024:
-        raise ConnectionError("privval frame too large")
-    body = b""
-    while len(body) < n:
-        chunk = sock.recv(n - len(body))
-        if not chunk:
-            return None
-        body += chunk
-    return safe_codec.loads(body)
+    data = abci_wire.read_frame(sock)
+    if data is None:
+        return None
+    return decode_msg(data)
 
 
 def _write_frame(sock: socket.socket, obj):
-    data = safe_codec.dumps(obj)
-    sock.sendall(struct.pack(">I", len(data)) + data)
+    abci_wire.write_frame(sock, encode_msg(obj))
 
 
 # ---------------------------------------------------------------------------
@@ -161,8 +253,11 @@ class SignerClient:
                 sock = self._ensure_conn()
                 _write_frame(sock, req)
                 resp = _read_frame(sock)
-            except (OSError, ConnectionError, socket.timeout) as e:
-                # drop the connection; the signer will redial
+            except (OSError, ConnectionError, socket.timeout,
+                    ValueError) as e:
+                # ValueError covers ProtoError: an undecodable frame is
+                # as broken as a dead socket — drop the connection (the
+                # signer will redial) and keep the error contract
                 self._drop()
                 raise RemoteSignerError(f"remote signer io: {e}") from e
             if resp is None:
@@ -271,7 +366,10 @@ class SignerServer:
                 return
             try:
                 self._serve(sock)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, ValueError):
+                # ValueError covers ProtoError from an undecodable frame:
+                # drop the connection and redial rather than killing the
+                # serve loop (the validator would silently stop signing)
                 pass
             finally:
                 try:

@@ -9,7 +9,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 from tendermint_tpu.abci import types as abci
-from tendermint_tpu.libs.safe_codec import loads, register
+from tendermint_tpu.libs import protodec as pd
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.p2p import wire
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 
@@ -20,13 +22,11 @@ CHUNK_CHANNEL = 0x61
 CHUNK_TIMEOUT_S = 15.0
 
 
-@register
 @dataclass
 class SnapshotsRequest:
     pass
 
 
-@register
 @dataclass
 class SnapshotsResponse:
     height: int
@@ -36,7 +36,6 @@ class SnapshotsResponse:
     metadata: bytes
 
 
-@register
 @dataclass
 class ChunkRequest:
     height: int
@@ -44,7 +43,6 @@ class ChunkRequest:
     index: int
 
 
-@register
 @dataclass
 class ChunkResponse:
     height: int
@@ -52,6 +50,68 @@ class ChunkResponse:
     index: int
     chunk: bytes
     missing: bool = False
+
+
+# -- wire codec (proto/tendermint/statesync/types.proto Message oneof:
+# snapshots_request=1, snapshots_response=2, chunk_request=3,
+# chunk_response=4) -------------------------------------------------------
+
+def encode_msg(msg) -> bytes:
+    if isinstance(msg, SnapshotsRequest):
+        return wire.oneof_encode(1, b"")
+    if isinstance(msg, SnapshotsResponse):
+        return wire.oneof_encode(2, (
+            pe.varint_field(1, msg.height) + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.chunks) + pe.bytes_field(4, msg.hash)
+            + pe.bytes_field(5, msg.metadata)))
+    if isinstance(msg, ChunkRequest):
+        return wire.oneof_encode(3, (
+            pe.varint_field(1, msg.height) + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.index)))
+    if isinstance(msg, ChunkResponse):
+        return wire.oneof_encode(4, (
+            pe.varint_field(1, msg.height) + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.index) + pe.bytes_field(4, msg.chunk)
+            + pe.varint_field(5, 1 if msg.missing else 0)))
+    raise TypeError(f"unknown statesync message {type(msg).__name__}")
+
+
+def _dec_snapshots_response(b: bytes) -> SnapshotsResponse:
+    f = pd.parse(b)
+    return SnapshotsResponse(
+        height=pd.get_uint(f, 1), format=pd.get_uint(f, 2),
+        chunks=pd.get_uint(f, 3), hash=pd.get_bytes(f, 4),
+        metadata=pd.get_bytes(f, 5))
+
+
+def _dec_chunk_response(b: bytes) -> ChunkResponse:
+    f = pd.parse(b)
+    return ChunkResponse(
+        height=pd.get_uint(f, 1), format=pd.get_uint(f, 2),
+        index=pd.get_uint(f, 3), chunk=pd.get_bytes(f, 4),
+        missing=bool(pd.get_uint(f, 5)))
+
+
+def _dec_chunk_request(b: bytes) -> ChunkRequest:
+    f = pd.parse(b)
+    return ChunkRequest(height=pd.get_uint(f, 1), format=pd.get_uint(f, 2),
+                        index=pd.get_uint(f, 3))
+
+
+_HANDLERS = {
+    1: lambda b: SnapshotsRequest(),
+    2: _dec_snapshots_response,
+    3: _dec_chunk_request,
+    4: _dec_chunk_response,
+}
+
+
+def decode_msg(data: bytes):
+    return wire.oneof_decode(data, _HANDLERS)
+
+
+wire.register_codec(SNAPSHOT_CHANNEL, encode_msg, decode_msg)
+wire.register_codec(CHUNK_CHANNEL, encode_msg, decode_msg)
 
 
 class StateSyncReactor(Reactor):
@@ -83,7 +143,7 @@ class StateSyncReactor(Reactor):
                 peer.try_send(SNAPSHOT_CHANNEL, SnapshotsRequest())
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
-        msg = loads(msg_bytes)
+        msg = decode_msg(msg_bytes)
         if ch_id == SNAPSHOT_CHANNEL:
             if isinstance(msg, SnapshotsRequest):
                 for s in (self.app.list_snapshots() or [])[-10:]:

@@ -1,0 +1,155 @@
+"""ABCI socket proto codec (reference proto/tendermint/abci/types.proto,
+abci/types/messages.go framing): golden layouts, roundtrips over every
+method, enum offset mapping, and decoder fuzz."""
+from __future__ import annotations
+
+import random
+import socket
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+from tendermint_tpu.libs import protodec as pd
+
+
+def test_request_golden_bytes():
+    # Request{deliver_tx=9{tx=1:"ab"}}: tag(9,BYTES)=0x4a
+    assert wire.encode_request("deliver_tx", b"ab") == \
+        b"\x4a\x04\x0a\x02ab"
+    m, req = wire.decode_request(b"\x4a\x04\x0a\x02ab")
+    assert (m, req) == ("deliver_tx", b"ab")
+    # Request{end_block=10{height=1:7}}: tag(10,BYTES)=0x52
+    assert wire.encode_request("end_block", 7) == b"\x52\x02\x08\x07"
+    # Request{echo=1{message="hi"}}
+    assert wire.encode_request("echo", "hi") == b"\x0a\x04\x0a\x02hi"
+    # Request{flush=2{}}
+    assert wire.encode_request("flush", None) == b"\x12\x00"
+
+
+def test_response_golden_bytes():
+    # Response{commit=12{data=2:"h"}}: tag(12,BYTES)=0x62
+    r = abci.ResponseCommit(data=b"h", retain_height=0)
+    assert wire.encode_response("commit", r) == b"\x62\x03\x12\x01h"
+    # offer_snapshot enum: internal ACCEPT=0 -> wire 1 (0 = UNKNOWN)
+    enc = wire.encode_response(
+        "offer_snapshot",
+        abci.ResponseOfferSnapshot(abci.ResponseOfferSnapshot.ACCEPT))
+    body = pd.get_message(pd.parse(enc), 14)
+    assert pd.get_uint(pd.parse(body), 1) == 1
+    m, resp = wire.decode_response(enc)
+    assert resp.result == abci.ResponseOfferSnapshot.ACCEPT
+
+
+def test_all_methods_roundtrip():
+    from tendermint_tpu.types.basic import Timestamp
+
+    cases = [
+        ("echo", "x"),
+        ("flush", None),
+        ("info", abci.RequestInfo("v1", 11, 8)),
+        ("init_chain", abci.RequestInitChain(
+            time_seconds=1700000000, chain_id="c",
+            consensus_params=abci.ConsensusParamsUpdate(1 << 20, -1),
+            validators=[abci.ValidatorUpdate("ed25519", b"\x01" * 32, 10)],
+            app_state_bytes=b"{}", initial_height=3)),
+        ("query", abci.RequestQuery(b"k", "/store", 9, True)),
+        ("check_tx", abci.RequestCheckTx(b"tx", abci.CheckTxType.RECHECK)),
+        ("deliver_tx", b"raw"),
+        ("end_block", 42),
+        ("commit", None),
+        ("list_snapshots", None),
+        ("offer_snapshot", (abci.Snapshot(9, 1, 4, b"h" * 32, b"m"),
+                            b"a" * 32)),
+        ("load_snapshot_chunk", (9, 1, 2)),
+        ("apply_snapshot_chunk", (2, b"chunk", "peer1")),
+        ("prepare_proposal", abci.RequestPrepareProposal(
+            block_data=[b"t1", b"t2"], block_data_size=100)),
+    ]
+    for method, req in cases:
+        data = wire.encode_request(method, req)
+        m, out = wire.decode_request(data)
+        assert m == method
+        assert wire.encode_request(m, out) == data, method
+
+    responses = [
+        ("info", abci.ResponseInfo("d", "v", 1, 5, b"hash")),
+        ("init_chain", abci.ResponseInitChain(
+            validators=[abci.ValidatorUpdate("ed25519", b"\x02" * 32, 7)],
+            app_hash=b"h")),
+        ("query", abci.ResponseQuery(
+            code=0, key=b"k", value=b"v", height=5,
+            proof_ops=[("ics23:iavl", b"k", b"proofdata")])),
+        ("begin_block", abci.ResponseBeginBlock(
+            events=[abci.Event("tx", {"k": "v"})])),
+        ("check_tx", abci.ResponseCheckTx(code=1, log="bad", priority=9,
+                                          sender="s")),
+        ("deliver_tx", abci.ResponseDeliverTx(
+            code=0, data=b"r", events=[abci.Event("e", {"a": "b"})])),
+        ("end_block", abci.ResponseEndBlock(
+            validator_updates=[abci.ValidatorUpdate("ed25519",
+                                                    b"\x03" * 32, 0)])),
+        ("commit", abci.ResponseCommit(b"apphash", 4)),
+        ("list_snapshots", [abci.Snapshot(9, 1, 4, b"h", b"m")]),
+        ("load_snapshot_chunk", b"chunkbytes"),
+        ("apply_snapshot_chunk", abci.ResponseApplySnapshotChunk(
+            result=abci.ResponseApplySnapshotChunk.RETRY,
+            refetch_chunks=[1, 3], reject_senders=["p1"])),
+        ("prepare_proposal", abci.ResponsePrepareProposal([b"t1"])),
+        ("process_proposal", abci.ResponseProcessProposal(accept=False)),
+        ("exception", "boom"),
+    ]
+    for method, resp in responses:
+        data = wire.encode_response(method, resp)
+        m, out = wire.decode_response(data)
+        assert m == method
+        if method != "exception":
+            assert wire.encode_response(m, out) == data, method
+        else:
+            assert out == "boom"
+
+
+def test_begin_block_misbehavior_conversion():
+    from tendermint_tpu.types.basic import Timestamp
+
+    mis = abci.Misbehavior(type=1, validator_address=b"\x09" * 20,
+                           validator_power=10, height=5,
+                           time_seconds=1700000000, total_voting_power=40)
+    req = abci.RequestBeginBlock(
+        hash=b"\x01" * 32, header_proto=b"",
+        last_commit_votes=[(abci.ValidatorInfo(b"\x07" * 20, 10), True),
+                           (abci.ValidatorInfo(b"\x08" * 20, 10), False)],
+        byzantine_validators=[mis])
+    data = wire.encode_request("begin_block", req)
+    m, out = wire.decode_request(data)
+    assert m == "begin_block"
+    assert [(v.address, v.voting_power, s)
+            for v, s in out.last_commit_votes] == \
+        [(b"\x07" * 20, 10, True), (b"\x08" * 20, 10, False)]
+    assert out.byzantine_validators == [mis]
+
+
+def test_framing_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        payload = wire.encode_request("deliver_tx", b"x" * 300)
+        wire.write_frame(a, payload)
+        wire.write_frame(a, wire.encode_request("flush", None))
+        assert wire.read_frame(b) == payload
+        assert wire.decode_request(wire.read_frame(b))[0] == "flush"
+        a.close()
+        assert wire.read_frame(b) is None  # clean EOF
+    finally:
+        b.close()
+
+
+def test_decoders_reject_garbage():
+    rng = random.Random(99)
+    for n in (1, 5, 40, 200):
+        for _ in range(50):
+            blob = bytes(rng.randrange(256) for _ in range(n))
+            for dec in (wire.decode_request, wire.decode_response):
+                try:
+                    dec(blob)
+                except ValueError:
+                    pass

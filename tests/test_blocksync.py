@@ -178,8 +178,8 @@ class _MemPeer:
         self._deliver = deliver
 
     def send(self, ch_id, msg):
-        from tendermint_tpu.libs import safe_codec
-        self._deliver(ch_id, self, safe_codec.dumps(msg))
+        from tendermint_tpu.p2p import wire
+        self._deliver(ch_id, self, wire.encode(ch_id, msg))
         return True
 
     try_send = send

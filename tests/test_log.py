@@ -1,0 +1,69 @@
+"""Structured leveled logging (reference libs/log/tm_logger.go): line
+shape, levels, module overrides, lazy values, bound context."""
+from __future__ import annotations
+
+import io
+
+from tendermint_tpu.libs import log as tmlog
+
+
+def _fresh(level="info", modules=""):
+    buf = io.StringIO()
+    tmlog.setup(level=level, stream=buf, module_levels=modules)
+    return buf
+
+
+def test_line_shape_and_levels():
+    buf = _fresh("info")
+    log = tmlog.logger("consensus")
+    log.info("entering new round", height=5, round=0)
+    log.debug("invisible", x=1)
+    log.error("boom", err="nope")
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 2
+    assert lines[0].startswith("I[") and " consensus: " in lines[0]
+    assert lines[0].endswith("entering new round height=5 round=0")
+    assert lines[1].startswith("E[") and lines[1].endswith("boom err=nope")
+
+
+def test_lazy_values_not_computed_below_level():
+    buf = _fresh("info")
+    calls = []
+
+    def expensive():
+        calls.append(1)
+        return "h" * 8
+
+    log = tmlog.logger("consensus")
+    log.debug("block", hash=tmlog.Lazy(expensive))
+    assert calls == []          # debug disabled: never computed
+    log.info("block", hash=tmlog.Lazy(expensive))
+    assert calls == [1]
+    assert "hash=hhhhhhhh" in buf.getvalue()
+
+
+def test_module_level_overrides():
+    buf = _fresh("error", modules="p2p:debug")
+    tmlog.logger("consensus").info("hidden")
+    tmlog.logger("p2p").debug("visible", peer="ab")
+    out = buf.getvalue()
+    assert "hidden" not in out
+    assert "visible peer=ab" in out
+
+
+def test_bound_context_and_bytes_render():
+    buf = _fresh("info")
+    log = tmlog.logger("node").with_(moniker="n0")
+    log.info("saved block", hash=b"\xab\xcd")
+    assert "moniker=n0" in buf.getvalue()
+    assert "hash=abcd" in buf.getvalue()
+
+
+def test_logging_never_raises():
+    buf = _fresh("info")
+
+    def broken():
+        raise RuntimeError("nope")
+
+    tmlog.logger("x").info("ok", v=tmlog.Lazy(broken))
+    assert "lazy error" in buf.getvalue()

@@ -98,7 +98,7 @@ def test_maj23_answered_with_vote_set_bits_and_live_net():
 
 
 def test_has_vote_message_roundtrip_codec():
-    from tendermint_tpu.libs.safe_codec import dumps, loads
+    from tendermint_tpu.consensus.messages import decode_msg, encode_msg
     m = HasVoteMessage(7, 1, int(SignedMsgType.PRECOMMIT), 3)
-    m2 = loads(dumps(m))
+    m2 = decode_msg(encode_msg(m))
     assert (m2.height, m2.round, m2.type, m2.index) == (7, 1, 2, 3)
