@@ -133,7 +133,7 @@ def main():
     # budget runs out waiting for a good-weather window.
     from concurrent.futures import ThreadPoolExecutor
 
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "360"))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "240"))
     t_budget = time.time() + budget_s
     all_outs = []
     e2e_rate = 0.0

@@ -118,6 +118,7 @@ class KVStoreApplication(abci.Application):
     # -- state sync snapshots (reference persistent_kvstore + snapshots/)
 
     snapshot_interval = 0  # heights between snapshots; 0 disables
+    snapshot_chunk_size = 65536  # bytes per chunk (format 1)
     _SNAPSHOT_KEEP = 3
 
     def _take_snapshot(self):
@@ -130,7 +131,9 @@ class KVStoreApplication(abci.Application):
             "validators": {k.hex(): p
                            for k, p in sorted(self.validators.items())},
         }, sort_keys=True).encode()
-        snap = abci.Snapshot(height=self.height, format=1, chunks=1,
+        cs = max(1, int(self.snapshot_chunk_size))
+        nchunks = max(1, -(-len(body) // cs))
+        snap = abci.Snapshot(height=self.height, format=1, chunks=nchunks,
                              hash=hashlib.sha256(body).digest())
         self._snapshots = getattr(self, "_snapshots", [])
         self._snapshots.append((snap, body))
@@ -141,18 +144,23 @@ class KVStoreApplication(abci.Application):
 
     def offer_snapshot(self, snapshot: abci.Snapshot,
                        app_hash: bytes) -> abci.ResponseOfferSnapshot:
-        if snapshot.format != 1 or snapshot.chunks != 1:
+        if snapshot.format != 1 or snapshot.chunks < 1:
             return abci.ResponseOfferSnapshot(
                 result=abci.ResponseOfferSnapshot.REJECT_FORMAT)
-        self._restoring = (snapshot, app_hash)
+        # chunks accumulate until the last one arrives; the whole-body
+        # hash is verified at the end (the snapshot hash covers the
+        # concatenation, not individual chunks)
+        self._restoring = (snapshot, app_hash, {})
         return abci.ResponseOfferSnapshot(
             result=abci.ResponseOfferSnapshot.ACCEPT)
 
     def load_snapshot_chunk(self, height: int, format_: int,
                             index: int) -> bytes:
+        cs = max(1, int(self.snapshot_chunk_size))
         for s, body in getattr(self, "_snapshots", []):
-            if s.height == height and s.format == format_ and index == 0:
-                return body
+            if s.height == height and s.format == format_ \
+                    and 0 <= index < s.chunks:
+                return body[index * cs:(index + 1) * cs]
         return b""
 
     def apply_snapshot_chunk(self, index: int, chunk: bytes,
@@ -163,13 +171,22 @@ class KVStoreApplication(abci.Application):
         if restoring is None:
             return abci.ResponseApplySnapshotChunk(
                 result=abci.ResponseApplySnapshotChunk.ABORT)
-        snap, app_hash = restoring
-        if hashlib.sha256(chunk).digest() != snap.hash:
+        snap, app_hash, got = restoring
+        got[index] = chunk
+        if len(got) < snap.chunks:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ResponseApplySnapshotChunk.ACCEPT)
+        body = b"".join(got[i] for i in range(snap.chunks))
+        if hashlib.sha256(body).digest() != snap.hash:
+            # whole-body mismatch: some chunk was bad; refetch everything
+            # from someone else (reference kvstore rejects the sender)
+            self._restoring = (snap, app_hash, {})
             return abci.ResponseApplySnapshotChunk(
                 result=abci.ResponseApplySnapshotChunk.RETRY,
-                refetch_chunks=[index], reject_senders=[sender])
+                refetch_chunks=list(range(snap.chunks)),
+                reject_senders=[sender])
         try:
-            st = json.loads(chunk)
+            st = json.loads(body)
             size = int(st["size"])
             height = int(st["height"])
             data = {bytes.fromhex(k): bytes.fromhex(v)

@@ -506,13 +506,19 @@ def decode_response(data: bytes):
     if method == "list_snapshots":
         return method, [_dec_snapshot(s) for s in pd.get_messages(b, 1)]
     if method == "offer_snapshot":
+        # wire reserves 0 = UNKNOWN (internal enum is 0-based, = wire-1);
+        # an app returning the proto zero value never accepted anything —
+        # map it to ABORT, not ACCEPT
+        r = pd.get_uint(b, 1)
         return method, abci.ResponseOfferSnapshot(
-            result=max(0, pd.get_uint(b, 1) - 1))
+            result=r - 1 if r >= 1 else abci.ResponseOfferSnapshot.ABORT)
     if method == "load_snapshot_chunk":
         return method, pd.get_bytes(b, 1)
     if method == "apply_snapshot_chunk":
+        r = pd.get_uint(b, 1)  # 0 = UNKNOWN on the wire -> ABORT
         return method, abci.ResponseApplySnapshotChunk(
-            result=max(0, pd.get_uint(b, 1) - 1),
+            result=(r - 1 if r >= 1
+                    else abci.ResponseApplySnapshotChunk.ABORT),
             refetch_chunks=pd.get_packed_uvarints(b, 2),
             reject_senders=[v.decode("utf-8", "replace")
                             for v in pd.get_messages(b, 3)])

@@ -3,7 +3,6 @@ on channel 0x60, chunk transfer on 0x61; the serving side answers from its
 app's snapshot store."""
 from __future__ import annotations
 
-import queue
 import threading
 from dataclasses import dataclass
 from typing import Optional
@@ -120,8 +119,14 @@ class StateSyncReactor(Reactor):
         self.app = app
         self.syncer: Optional[Syncer] = None
         if state_provider is not None:
-            self.syncer = Syncer(app, state_provider, self._fetch_chunk)
-        self._chunks: "queue.Queue" = queue.Queue()
+            self.syncer = Syncer(app, state_provider, self._fetch_chunk,
+                                 ban_peer=self._ban_peer)
+        # received chunks keyed by (height, format, index): the syncer
+        # runs several concurrent fetchers, so responses must route to
+        # the fetcher that asked — a shared FIFO would let one fetcher
+        # consume (and drop) another's chunk
+        self._chunks: dict = {}
+        self._chunks_cv = threading.Condition()
 
     def get_channels(self):
         return [
@@ -161,32 +166,47 @@ class StateSyncReactor(Reactor):
                     msg.height, msg.format, msg.index, chunk or b"",
                     missing=not chunk))
             elif isinstance(msg, ChunkResponse):
-                self._chunks.put((msg, peer.id))
+                with self._chunks_cv:
+                    self._chunks[(msg.height, msg.format, msg.index)] = \
+                        (msg, peer.id)
+                    self._chunks_cv.notify_all()
 
     # -- chunk fetch over p2p (the Syncer's fetcher) -----------------------
 
+    def _ban_peer(self, peer_id: str, reason: str):
+        sw = self.switch
+        if sw is None:
+            return
+        peer = sw.peers.get(peer_id)
+        if peer is not None:
+            sw.stop_peer_for_error(peer, reason)
+
     def _fetch_chunk(self, snapshot: abci.Snapshot, index: int,
                      peer_hint: str):
+        """One chunk request/response; called concurrently by the
+        syncer's fetcher pool, each call spreading across the available
+        peers (reference syncer.go:411 runs parallel fetchers)."""
         sw = self.switch
+        peers = list(sw.peers.values()) if sw else []
         peer = sw.peers.get(peer_hint) if sw else None
-        if peer is None and sw and sw.peers:
-            peer = next(iter(sw.peers.values()))
+        if peer is None and peers:
+            peer = peers[index % len(peers)]
         if peer is None:
             raise StateSyncError("no peers to fetch chunks from")
+        key = (snapshot.height, snapshot.format, index)
+        with self._chunks_cv:
+            self._chunks.pop(key, None)  # drop any stale response
         peer.try_send(CHUNK_CHANNEL, ChunkRequest(
             snapshot.height, snapshot.format, index))
         import time as _t
         deadline = _t.monotonic() + CHUNK_TIMEOUT_S
-        while True:
-            remaining = deadline - _t.monotonic()
-            if remaining <= 0:
-                raise StateSyncError(f"chunk {index} timed out")
-            try:
-                msg, sender = self._chunks.get(timeout=remaining)
-            except queue.Empty:
-                raise StateSyncError(f"chunk {index} timed out")
-            if (msg.height, msg.format, msg.index) == (
-                    snapshot.height, snapshot.format, index):
-                if msg.missing:
-                    raise StateSyncError(f"peer lacks chunk {index}")
-                return msg.chunk, sender
+        with self._chunks_cv:
+            while key not in self._chunks:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    raise StateSyncError(f"chunk {index} timed out")
+                self._chunks_cv.wait(remaining)
+            msg, sender = self._chunks.pop(key)
+        if msg.missing:
+            raise StateSyncError(f"peer lacks chunk {index}")
+        return msg.chunk, sender

@@ -4,13 +4,21 @@ restored app hash against a light-client-verified header, and bootstrap
 consensus state at the snapshot height."""
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.state.state import State
 
 from .stateprovider import StateProvider
+
+CHUNK_FETCHERS = 4      # reference config.go ChunkFetchers default
+CHUNK_RETRIES = 3       # per-chunk fetch attempts before giving up
+# sanity cap on a peer-declared chunk count: 2^16 chunks x 64KB-ish
+# chunks bounds any snapshot we would ever restore; without it a
+# Byzantine SnapshotsResponse (chunks=2^60) would OOM the fetch queue
+MAX_SNAPSHOT_CHUNKS = 1 << 16
 
 
 class StateSyncError(Exception):
@@ -32,10 +40,13 @@ class Syncer:
     app directly."""
 
     def __init__(self, app, state_provider: StateProvider,
-                 chunk_fetcher: Callable):
+                 chunk_fetcher: Callable, ban_peer: Optional[Callable] = None,
+                 fetchers: int = CHUNK_FETCHERS):
         self.app = app
         self.state_provider = state_provider
         self.chunk_fetcher = chunk_fetcher
+        self.ban_peer = ban_peer            # ban_peer(peer_id, reason)
+        self.fetchers = max(1, fetchers)
         self._snapshots: List[Tuple[abci.Snapshot, str]] = []
         self._rejected: set = set()
         self._lock = threading.Lock()
@@ -43,6 +54,8 @@ class Syncer:
     # -- discovery ---------------------------------------------------------
 
     def add_snapshot(self, snapshot: abci.Snapshot, peer_id: str) -> bool:
+        if not 0 < snapshot.chunks <= MAX_SNAPSHOT_CHUNKS:
+            return False
         key = (snapshot.height, snapshot.format, snapshot.hash)
         with self._lock:
             if key in self._rejected:
@@ -106,22 +119,7 @@ class Syncer:
             resp = self.app.offer_snapshot(snapshot, app_hash)
             if resp.result != abci.ResponseOfferSnapshot.ACCEPT:
                 raise SnapshotRejected(f"offer result {resp.result}")
-            # fetch + apply chunks in order (reference syncer.go:395)
-            index = 0
-            attempts = 0
-            while index < snapshot.chunks:
-                chunk, sender = self.chunk_fetcher(snapshot, index, peer_id)
-                r = self.app.apply_snapshot_chunk(index, chunk, sender)
-                if r.result == abci.ResponseApplySnapshotChunk.ACCEPT:
-                    index += 1
-                    attempts = 0
-                    continue
-                if r.result == abci.ResponseApplySnapshotChunk.RETRY:
-                    attempts += 1
-                    if attempts > 3:
-                        raise SnapshotRejected("chunk retry limit")
-                    continue
-                raise SnapshotRejected(f"apply result {r.result}")
+            self._fetch_and_apply(snapshot, peer_id)
             # verify the restored app (reference syncer.go:544 verifyApp)
             info = self.app.info(abci.RequestInfo())
         except SnapshotRejected:
@@ -142,3 +140,110 @@ class Syncer:
         if info.last_block_app_hash != app_hash:
             raise SnapshotRejected("restored app hash mismatch")
         return state, commit
+
+    # -- concurrent chunk fetch (reference syncer.go:411 fetchChunks) ------
+
+    def _fetch_and_apply(self, snapshot: abci.Snapshot, peer_id: str):
+        """N fetcher threads fill a chunk buffer; chunks apply strictly
+        in order from the calling thread.  Per-chunk retry across
+        fetchers; app-requested refetch_chunks are re-enqueued and
+        reject_senders banned (reference syncer.go:465-476)."""
+        nchunks = snapshot.chunks
+        if nchunks <= 0 or nchunks > MAX_SNAPSHOT_CHUNKS:
+            raise SnapshotRejected(f"implausible chunk count {nchunks}")
+        pending = collections.deque(range(nchunks))
+        fetched: dict = {}
+        failures: dict = {}
+        inflight: set = set()
+        cv = threading.Condition()
+        done = threading.Event()
+        fetch_err: List[Exception] = []
+
+        def fetcher():
+            while not done.is_set():
+                with cv:
+                    while not pending and not done.is_set():
+                        cv.wait(0.2)
+                    if done.is_set():
+                        return
+                    idx = pending.popleft()
+                    inflight.add(idx)
+                try:
+                    chunk, sender = self.chunk_fetcher(snapshot, idx,
+                                                       peer_id)
+                except Exception as e:  # noqa: BLE001 - transport error
+                    with cv:
+                        inflight.discard(idx)
+                        failures[idx] = failures.get(idx, 0) + 1
+                        if failures[idx] > CHUNK_RETRIES:
+                            fetch_err.append(e)
+                            done.set()
+                        else:
+                            pending.append(idx)
+                        cv.notify_all()
+                    continue
+                with cv:
+                    inflight.discard(idx)
+                    fetched[idx] = (chunk, sender)
+                    cv.notify_all()
+
+        threads = [threading.Thread(target=fetcher, daemon=True,
+                                    name=f"chunk-fetcher-{i}")
+                   for i in range(min(self.fetchers, nchunks))]
+        for t in threads:
+            t.start()
+        try:
+            index = 0
+            # total RETRY verdicts for this restore — deliberately never
+            # reset: with accumulate-style apps every refetch-all cycle
+            # ends in one RETRY, and intermediate buffering ACCEPTs must
+            # not launder the count into an infinite loop
+            retries = 0
+            while index < nchunks:
+                with cv:
+                    while index not in fetched and not done.is_set():
+                        cv.wait(0.2)
+                    if index not in fetched:
+                        raise StateSyncError(
+                            f"chunk {index} fetch failed: "
+                            f"{fetch_err[0] if fetch_err else 'aborted'}")
+                    chunk, sender = fetched.pop(index)
+                r = self.app.apply_snapshot_chunk(index, chunk, sender)
+                for pid in getattr(r, "reject_senders", ()) or ():
+                    if self.ban_peer is not None and pid:
+                        self.ban_peer(pid, "statesync chunk rejected")
+                refetch = [i for i in (getattr(r, "refetch_chunks", ())
+                                       or ()) if 0 <= i < nchunks]
+                if r.result == abci.ResponseApplySnapshotChunk.ACCEPT:
+                    nxt = index + 1
+                elif r.result == abci.ResponseApplySnapshotChunk.RETRY:
+                    retries += 1
+                    if retries > CHUNK_RETRIES:
+                        raise SnapshotRejected("chunk retry limit")
+                    if not refetch:
+                        refetch = [index]
+                    nxt = index
+                else:
+                    raise SnapshotRejected(f"apply result {r.result}")
+                if refetch:
+                    # the app discarded these (possibly already-applied)
+                    # chunks: refetch them and rewind the apply cursor
+                    # (reference syncer.go:465 enqueues them again).  An
+                    # index already in flight is NOT re-enqueued — its
+                    # fresh response is about to land in `fetched`, and a
+                    # duplicate concurrent fetch of the same key would
+                    # race on the reactor's response routing
+                    with cv:
+                        for i in refetch:
+                            fetched.pop(i, None)
+                            if i not in pending and i not in inflight:
+                                pending.append(i)
+                        cv.notify_all()
+                    nxt = min(nxt, min(refetch))
+                index = nxt
+        finally:
+            done.set()
+            with cv:
+                cv.notify_all()
+            for t in threads:
+                t.join(timeout=1.0)
