@@ -24,7 +24,10 @@ ROUNDS = 6
 # dispatch schemes tried per pass: monolithic (1) and 4-way sub-batch
 # transfer/compute pipelining (ops/ed25519.verify_packed_pipelined)
 SCHEMES = (1, 4)
-PLATEAU = 0.85  # stop retrying once e2e reaches 85% of the resident rate
+# stop retrying once e2e reaches this fraction of the resident-kernel
+# rate; measured best pipelined passes sit at ~0.85-0.95 of resident, so
+# stopping at 0.85 was leaving throughput on the table
+PLATEAU = 0.93
 
 
 def _make_batch(n):
@@ -104,9 +107,9 @@ def main():
         t0 = time.perf_counter()
         routs = [pe.verify_packed_pallas(resident_in,
                                          tile=edops.PALLAS_TILE)
-                 for _ in range(ROUNDS)]
+                 for _ in range(2 * ROUNDS)]  # amortize the final-sync RTT
         routs[-1].block_until_ready()
-        resident_rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+        resident_rate = 2 * ROUNDS * BATCH / (time.perf_counter() - t0)
     else:
         # no TPU: there is no tunnel weather to wait out — the budget/retry
         # loop below degrades to the minimum number of passes

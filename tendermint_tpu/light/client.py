@@ -15,7 +15,7 @@ from tendermint_tpu.types.basic import Timestamp
 from tendermint_tpu.types.light_block import LightBlock
 
 from . import verifier
-from .detector import Divergence, detect_divergence
+from .detector import Divergence, detect_divergence, examine_divergence
 from .provider import (BadLightBlockError, HeightTooHigh, LightBlockNotFound,
                        Provider, ProviderError)
 from .store import LightStore
@@ -25,6 +25,7 @@ _SKIP_NUM, _SKIP_DEN = 1, 2
 
 DEFAULT_TRUSTING_PERIOD_S = 14 * 24 * 3600.0  # reference light/client.go
 DEFAULT_MAX_CLOCK_DRIFT_S = 10.0
+MAX_WITNESS_STRIKES = 3  # consecutive failures before a witness is dropped
 
 
 class LightClientError(Exception):
@@ -58,6 +59,9 @@ class Client:
         self.witnesses = list(witnesses)
         self.store = store
         self.sequential = sequential
+        self._witness_strikes: dict = {}  # id(provider) -> count
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("light")
         self._initialize(trust_options)
 
     # -- initialization (reference client.go:362-401) ----------------------
@@ -125,11 +129,15 @@ class Client:
             trace = self._verify_sequential(anchor, lb, now)
         else:
             trace = self._verify_skipping(anchor, lb, now)
-        for b in trace:
-            self.store.save(b)
+        # detect BEFORE persisting: on a divergence nothing from the
+        # disputed trace may enter the trusted store (a primary-side
+        # attack would otherwise be served as trusted forever after the
+        # dissenting witness is removed)
         div = detect_divergence(self, trace, now)
         if div is not None:
-            raise div
+            self._handle_divergence(anchor, trace, div)
+        for b in trace:
+            self.store.save(b)
 
     # -- verification strategies ------------------------------------------
 
@@ -194,10 +202,107 @@ class Client:
             verifier.verify_backwards(lb.signed_header, cur.signed_header)
             cur = lb
 
+    # -- divergence handling (reference detector.go:90-180) ----------------
+
+    def _handle_divergence(self, anchor: Optional[LightBlock],
+                           trace: List[LightBlock], div: Divergence):
+        """Attribute the attack, submit evidence both ways, drop the
+        diverging witness, and raise the Divergence.  The client cannot
+        know which side is honest, so each side's evidence goes to the
+        other plus every remaining provider (reference detector.go
+        sendEvidence to primary and witnesses)."""
+        chain = ([anchor] if anchor is not None else []) + list(trace)
+        witness = div.witness
+        try:
+            common, ev_w, ev_p = examine_divergence(self, chain, div)
+        except Exception as e:  # noqa: BLE001 - never mask the divergence
+            self.log.error("divergence examination failed", err=str(e))
+            self._remove_witness(witness)
+            raise div
+        self.log.error(
+            "light client attack detected",
+            height=div.primary_block.height,
+            common_height=ev_w.common_height,
+            byzantine=len(ev_w.byzantine_validators))
+        # evidence against the witness's chain -> primary + other
+        # witnesses; evidence against the primary's chain -> the witness
+        targets_w = [self.primary] + [w for w in self.witnesses
+                                      if w is not witness]
+        for prov, ev in ([(p, ev_w) for p in targets_w]
+                         + [(witness, ev_p)]):
+            try:
+                prov.report_evidence(ev)
+            except ProviderError as e:
+                self.log.error("evidence submission failed", err=str(e))
+        self._remove_witness(witness)
+        raise div
+
+    # -- provider management (reference client.go findNewPrimary) ----------
+
+    def note_witness_failure(self, witness: Provider, reason):
+        """Strike an unresponsive witness; drop it after
+        MAX_WITNESS_STRIKES consecutive failures (a bad block drops it
+        immediately)."""
+        if isinstance(reason, BadLightBlockError):
+            self._remove_witness(witness)
+            return
+        k = id(witness)
+        self._witness_strikes[k] = self._witness_strikes.get(k, 0) + 1
+        if self._witness_strikes[k] >= MAX_WITNESS_STRIKES:
+            self._remove_witness(witness)
+
+    def note_witness_ok(self, witness: Provider):
+        self._witness_strikes.pop(id(witness), None)
+
+    def _remove_witness(self, witness: Provider):
+        self._witness_strikes.pop(id(witness), None)
+        try:
+            self.witnesses.remove(witness)
+            self.log.info("removed witness",
+                          remaining=len(self.witnesses))
+        except ValueError:
+            pass
+
+    def _replace_primary(self, err) -> None:
+        """Promote the first responsive witness to primary (reference
+        client.go:613+ findNewPrimary); the failed primary is dropped
+        entirely.  Witnesses failing the probe BENIGNLY (momentarily
+        behind, timeout) keep their place in the pool — only a bad block
+        discards one, consistent with the strike policy."""
+        for cand in list(self.witnesses):
+            try:
+                ok = cand.light_block(0) is not None
+            except BadLightBlockError:
+                self._remove_witness(cand)
+                continue
+            except ProviderError:
+                continue  # transient: keep as witness
+            if ok:
+                self._remove_witness(cand)
+                self.log.info("replaced primary after failure",
+                              err=str(err),
+                              witnesses_left=len(self.witnesses))
+                self.primary = cand
+                return
+        raise LightClientError(
+            f"primary failed ({err}) and no witness can take over")
+
     # -- providers ---------------------------------------------------------
 
     def _from_primary(self, height: int) -> LightBlock:
-        lb = self.primary.light_block(height)
-        if lb is None:
-            raise LightBlockNotFound(f"no light block at {height}")
-        return lb
+        """Fetch from the primary; on failure rotate a witness in and
+        retry once per remaining provider (reference client.go
+        lightBlockFromPrimary + findNewPrimary)."""
+        while True:
+            try:
+                lb = self.primary.light_block(height)
+            except (LightBlockNotFound, HeightTooHigh):
+                # benign: the primary simply doesn't have it (yet);
+                # switching primaries would not conjure the block
+                raise
+            except ProviderError as e:
+                self._replace_primary(e)
+                continue
+            if lb is None:
+                raise LightBlockNotFound(f"no light block at {height}")
+            return lb

@@ -16,52 +16,138 @@ from tendermint_tpu.types.light_block import LightBlock
 from .provider import ProviderError
 
 
+class NoCommonBlock(Exception):
+    """The witness disputes the entire verified chain — no height exists
+    at which verifiable attack evidence can be anchored."""
+
+
 class Divergence(Exception):
     """A witness disagrees with the primary about a verified header."""
 
     def __init__(self, primary_block: LightBlock, witness_block: LightBlock,
-                 witness_index: int):
+                 witness):
         super().__init__(
-            f"witness {witness_index} has conflicting header at height "
+            f"witness has conflicting header at height "
             f"{primary_block.height}: primary {primary_block.hash().hex()} "
             f"vs witness {witness_block.hash().hex()}")
         self.primary_block = primary_block
         self.witness_block = witness_block
-        self.witness_index = witness_index
+        # the provider OBJECT: the witness list mutates during the scan
+        # (strike removals), so an index would go stale or shift onto an
+        # honest witness
+        self.witness = witness
 
     def make_evidence(self, common_height: int):
-        """Build LightClientAttackEvidence against the witness's view
-        (reference detector.go:120-150 examineConflictingHeaderAgainstTrace).
-        The conflicting block is the one that diverges from our verified
-        chain."""
+        """Minimal unattributed evidence at a caller-supplied common
+        height; full attribution (byzantine set, both directions) comes
+        from examine_divergence."""
         from tendermint_tpu.evidence import LightClientAttackEvidence
         wb = self.witness_block
         return LightClientAttackEvidence(
-            conflicting_block=wb,
-            common_height=common_height,
+            conflicting_block=wb, common_height=common_height,
             byzantine_validators=[],
             total_voting_power=wb.validators.total_voting_power(),
-            timestamp=wb.time,
-        )
+            timestamp=wb.time)
 
 
 def detect_divergence(client, trace: List[LightBlock],
                       now: Timestamp) -> Optional[Divergence]:
     """Compare the newly verified header against every witness
     (reference detector.go:48).  Returns the first Divergence found (the
-    caller raises it), None when all witnesses agree.  Unresponsive
-    witnesses are skipped (the reference removes them after repeated
-    failures)."""
+    caller raises it after examining it), None when all witnesses agree.
+    Unresponsive witnesses accrue strikes and are removed by the client
+    after repeated failures (reference removes them on error)."""
     if not trace:
         return None
     target = trace[-1]
     for i, w in enumerate(list(client.witnesses)):
         try:
             wb = w.light_block(target.height)
-        except ProviderError:
+        except ProviderError as e:
+            client.note_witness_failure(w, e)
             continue
         if wb is None:
+            client.note_witness_failure(w, "no block")
             continue
+        client.note_witness_ok(w)
         if wb.hash() != target.hash():
-            return Divergence(target, wb, i)
+            return Divergence(target, wb, w)
     return None
+
+
+def _signers(commit) -> set:
+    return {cs.validator_address for cs in commit.signatures
+            if cs.for_block()}
+
+
+def _attack_evidence(common: LightBlock, conflicting: LightBlock,
+                     trusted: LightBlock):
+    """LightClientAttackEvidence with the byzantine set attributed per
+    reference types/evidence.go GetByzantineValidators:
+
+      * lunatic attack (the conflicting header does not derive the
+        trusted header's non-vote fields — ConflictingHeaderIsInvalid,
+        all five fields): the byzantine validators are the COMMON-height
+        validators who signed the conflicting commit — they signed a
+        header that cannot descend from the common block;
+      * equivocation (same derived header, same commit round): the
+        validators that signed BOTH conflicting commits;
+      * amnesia (same derived header, different rounds): attribution is
+        impossible from the light client's view — empty byzantine set.
+    """
+    from tendermint_tpu.evidence import LightClientAttackEvidence
+
+    th = trusted.signed_header.header
+    csigners = _signers(conflicting.signed_header.commit)
+    ev = LightClientAttackEvidence(
+        conflicting_block=conflicting,
+        common_height=common.height,
+        byzantine_validators=[],
+        total_voting_power=common.validators.total_voting_power(),
+        timestamp=common.time,
+    )
+    ccommit = conflicting.signed_header.commit
+    tcommit = trusted.signed_header.commit
+    if ev.conflicting_header_is_invalid(th):
+        ev.byzantine_validators = [v for v in common.validators.validators
+                                   if v.address in csigners]
+    elif ccommit.round == tcommit.round:
+        tsigners = _signers(tcommit)
+        ev.byzantine_validators = [
+            v for v in conflicting.validators.validators
+            if v.address in csigners and v.address in tsigners]
+    return ev
+
+
+def examine_divergence(client, chain: List[LightBlock], div: Divergence):
+    """Reference detector.go:120-180 examineConflictingHeaderAgainstTrace:
+    locate the latest verified block the witness still agrees with (the
+    common block), then build attributed evidence BOTH ways — against the
+    witness's chain (conflicting block = witness header) and against the
+    primary's (conflicting block = primary header).  The light client
+    cannot know which side is honest; it reports each side to the other
+    (reference detector.go:90-112).
+
+    Returns (common_block, ev_against_witness, ev_against_primary).
+    Raises NoCommonBlock when the witness disputes every verified block
+    including the anchor — evidence anchored at a disputed height would
+    be rejected by any full node (reference detector.go errors there).
+    """
+    w = div.witness
+    common = None
+    for b in reversed([b for b in chain if b.height
+                       < div.primary_block.height]):
+        try:
+            wb = w.light_block(b.height)
+        except ProviderError:
+            continue
+        if wb is not None and wb.hash() == b.hash():
+            common = b
+            break
+    if common is None:
+        raise NoCommonBlock(
+            f"witness disputes every verified block up to "
+            f"{div.primary_block.height}")
+    ev_w = _attack_evidence(common, div.witness_block, div.primary_block)
+    ev_p = _attack_evidence(common, div.primary_block, div.witness_block)
+    return common, ev_w, ev_p

@@ -36,6 +36,12 @@ class Provider:
         """height=0 means latest.  Raises ProviderError subclasses."""
         raise NotImplementedError
 
+    def report_evidence(self, ev) -> None:
+        """Submit evidence of misbehavior to the full node behind this
+        provider (reference light/provider/provider.go ReportEvidence).
+        Raises ProviderError on failure."""
+        raise ProviderError("provider cannot accept evidence")
+
 
 class DictProvider(Provider):
     """In-memory provider over a prebuilt {height: LightBlock} map — the
@@ -45,6 +51,10 @@ class DictProvider(Provider):
                  blocks: Optional[Dict[int, LightBlock]] = None):
         self._chain_id = chain_id
         self.blocks: Dict[int, LightBlock] = dict(blocks or {})
+        self.evidence: List = []  # report_evidence sink (test assertions)
+
+    def report_evidence(self, ev) -> None:
+        self.evidence.append(ev)
 
     def chain_id(self) -> str:
         return self._chain_id
@@ -69,10 +79,20 @@ class NodeBackedProvider(Provider):
     """Serves light blocks straight from a full node's block + state stores
     (reference light/provider/http does this over RPC; in-process here)."""
 
-    def __init__(self, chain_id: str, block_store, state_store):
+    def __init__(self, chain_id: str, block_store, state_store,
+                 evidence_pool=None):
         self._chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
+        self.evidence_pool = evidence_pool
+
+    def report_evidence(self, ev) -> None:
+        if self.evidence_pool is None:
+            raise ProviderError("no evidence pool attached")
+        try:
+            self.evidence_pool.add_evidence(ev)
+        except Exception as e:  # noqa: BLE001
+            raise ProviderError(f"evidence rejected: {e}") from e
 
     def chain_id(self) -> str:
         return self._chain_id
@@ -139,3 +159,16 @@ class HTTPProvider(Provider):
             raise BadLightBlockError(
                 f"asked height {height}, got {sh.height}")
         return lb
+
+    def report_evidence(self, ev) -> None:
+        import base64
+
+        from tendermint_tpu.rpc.client import RPCClientError
+        from tendermint_tpu.types.evidence import evidence_proto
+
+        try:
+            self.client.call(
+                "broadcast_evidence",
+                evidence=base64.b64encode(evidence_proto(ev)).decode())
+        except RPCClientError as e:
+            raise ProviderError(f"evidence submission failed: {e}") from e
