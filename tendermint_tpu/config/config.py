@@ -34,6 +34,7 @@ class MempoolConfig:
 class RPCConfig:
     laddr: str = "127.0.0.1:26657"
     enabled: bool = True
+    unsafe: bool = False  # expose dial_seeds/dial_peers (ref --rpc.unsafe)
 
 
 @dataclass
@@ -157,6 +158,7 @@ max_tx_bytes = {self.mempool.max_tx_bytes}
 [rpc]
 laddr = "{self._q(self.rpc.laddr)}"
 enabled = {str(self.rpc.enabled).lower()}
+unsafe = {str(self.rpc.unsafe).lower()}
 
 [block_sync]
 enable = {str(self.block_sync.enable).lower()}
@@ -217,7 +219,8 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             max_tx_bytes=m.get("max_tx_bytes", 1048576))
         r = d.get("rpc", {})
         cfg.rpc = RPCConfig(laddr=r.get("laddr", cfg.rpc.laddr),
-                            enabled=r.get("enabled", True))
+                            enabled=r.get("enabled", True),
+                            unsafe=r.get("unsafe", False))
         bs = d.get("block_sync", {})
         cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
         ti = d.get("tx_index", {})

@@ -32,6 +32,11 @@ class EvidencePool:
         # votes reported by consensus before the evidence could be formed
         # (reference pool.go:459 processConsensusBuffer)
         self._consensus_buffer: List[Tuple[Vote, Vote]] = []
+        # fired (outside the lock) when NEW evidence becomes pending — the
+        # reactor subscribes to push it to peers immediately instead of
+        # waiting for its rebroadcast tick (reference evidence/reactor.go
+        # broadcastEvidenceRoutine wakes on the clist)
+        self.on_new_evidence: List = []
 
     # -- ingress -----------------------------------------------------------
 
@@ -43,6 +48,11 @@ class EvidencePool:
             ev.validate_basic()
             self._verify(ev)
             self.db.set(_key(_PENDING, ev), safe_codec.dumps(ev))
+        for cb in list(self.on_new_evidence):
+            try:
+                cb(ev)
+            except Exception:  # noqa: BLE001 - notify must not poison add
+                pass
 
     def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
         """Consensus reports a double sign (reference pool.go:179); turned

@@ -48,6 +48,16 @@ class EvidenceReactor(Reactor):
         self.pool = pool
         self._stop = threading.Event()
         self._sent: dict = {}  # peer_id -> set of evidence hashes sent
+        # new pending evidence pushes to every peer immediately; the
+        # timed rebroadcast remains the retry for dropped sends
+        pool.on_new_evidence.append(lambda ev: self._push_all())
+
+    def _push_all(self):
+        sw = self.switch
+        if sw is None or self._stop.is_set():
+            return
+        for peer in list(sw.peers.values()):
+            self._send_pending(peer)
 
     def start(self):
         threading.Thread(target=self._broadcast_routine, daemon=True).start()

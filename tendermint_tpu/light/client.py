@@ -60,6 +60,12 @@ class Client:
         self.store = store
         self.sequential = sequential
         self._witness_strikes: dict = {}  # id(provider) -> count
+        # fail-safe flag: a client CONFIGURED with witnesses must never
+        # silently continue without any (reference errNoWitnesses) — a
+        # drained pool means divergence detection is gone and a malicious
+        # primary would be unchallenged.  Clients deliberately built with
+        # zero witnesses (statesync bootstrap) are exempt.
+        self._had_witnesses = bool(self.witnesses)
         from tendermint_tpu.libs import log as tmlog
         self.log = tmlog.logger("light")
         self._initialize(trust_options)
@@ -129,6 +135,11 @@ class Client:
             trace = self._verify_sequential(anchor, lb, now)
         else:
             trace = self._verify_skipping(anchor, lb, now)
+        if self._had_witnesses and not self.witnesses:
+            raise LightClientError(
+                "no witnesses left to cross-check the primary "
+                "(reference errNoWitnesses): refusing to trust "
+                "unchallenged headers")
         # detect BEFORE persisting: on a divergence nothing from the
         # disputed trace may enter the trusted store (a primary-side
         # attack would otherwise be served as trusted forever after the

@@ -32,6 +32,11 @@ def _parse_tx(arg) -> bytes:
     raise RPCError(-32602, "tx must be base64 string")
 
 
+# request-size cap (reference rpc/jsonrpc/server/http_server.go
+# maxBodyBytes = 1000000)
+MAX_BODY_BYTES = 1_000_000
+
+
 def _int_arg(v, default=None):
     if v is None:
         return default
@@ -71,7 +76,14 @@ class RPCServer:
             "block_search": self.block_search,
             "light_block": self.light_block,
             "block_proto": self.block_proto,
+            "dump_consensus_state": self.dump_consensus_state,
+            "genesis_chunked": self.genesis_chunked,
         }
+        if getattr(getattr(node, "config", None), "rpc", None) is not None \
+                and getattr(node.config.rpc, "unsafe", False):
+            # reference rpc/core/routes.go AddUnsafeRoutes (--rpc.unsafe)
+            self.routes["dial_seeds"] = self.dial_seeds
+            self.routes["dial_peers"] = self.dial_peers
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -92,6 +104,13 @@ class RPCServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
+                # request-size cap (reference rpc/jsonrpc/server
+                # http_server.go maxBodyBytes = 1MB)
+                if n > MAX_BODY_BYTES:
+                    self._reply(server._err(
+                        None, -32600,
+                        f"request body too large (> {MAX_BODY_BYTES})"))
+                    return
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
                     if not isinstance(req, dict):
@@ -493,6 +512,113 @@ class RPCServer:
         return {"round_state": {
             "height": rs.height, "round": rs.round, "step": int(rs.step),
         }}
+
+    def dump_consensus_state(self):
+        """Full round state + per-peer round states (reference
+        rpc/core/consensus.go DumpConsensusState)."""
+        cs = self.node.consensus
+        with cs._mtx:
+            rs = cs.rs
+            votes = rs.votes
+            out_rs = {
+                "height": rs.height, "round": rs.round,
+                "step": int(rs.step),
+                "proposal": rs.proposal is not None,
+                "proposal_block_hash": (
+                    rs.proposal_block.hash().hex().upper()
+                    if rs.proposal_block is not None else ""),
+                "locked_round": rs.locked_round,
+                "locked_block_hash": (
+                    rs.locked_block.hash().hex().upper()
+                    if rs.locked_block is not None else ""),
+                "valid_round": rs.valid_round,
+                "commit_round": rs.commit_round,
+                "validators": {
+                    "total_voting_power":
+                        rs.validators.total_voting_power()
+                        if rs.validators else 0,
+                    "count": rs.validators.size() if rs.validators else 0,
+                },
+            }
+            if votes is not None:
+                out_rs["votes"] = [{
+                    "round": r,
+                    "prevotes": str(votes.prevotes(r).bit_array()),
+                    "precommits": str(votes.precommits(r).bit_array()),
+                } for r in range(rs.round + 1)]
+        peers = []
+        reactor = getattr(self.node, "consensus_reactor", None)
+        if reactor is not None:
+            with reactor._lock:
+                for pid, ps in reactor._peer_state.items():
+                    peers.append({
+                        "node_address": pid,
+                        "peer_state": {
+                            "height": ps.step.height,
+                            "round": ps.step.round,
+                            "step": ps.step.step,
+                            "prevotes": (str(ps.prevotes)
+                                         if ps.prevotes else ""),
+                            "precommits": (str(ps.precommits)
+                                           if ps.precommits else ""),
+                        }})
+        return {"round_state": out_rs, "peers": peers}
+
+    GENESIS_CHUNK_SIZE = 16 * 1024 * 1024  # reference rpc/core/net.go
+    _genesis_bytes = None  # serialized once (the doc is immutable)
+
+    def genesis_chunked(self, chunk=None):
+        """Reference rpc/core/net.go GenesisChunked: base64 16MB chunks
+        for genesis docs too large for one response (serialized once —
+        the route exists for LARGE docs, so per-request re-serialization
+        would be O(size) per chunk)."""
+        if self._genesis_bytes is None:
+            self._genesis_bytes = self.node.genesis.to_json().encode()
+        data = self._genesis_bytes
+        nchunks = max(1, -(-len(data) // self.GENESIS_CHUNK_SIZE))
+        i = _int_arg(chunk, 0) or 0
+        if not 0 <= i < nchunks:
+            raise RPCError(
+                -32603,
+                f"there are {nchunks} chunks, you asked for {i}")
+        part = data[i * self.GENESIS_CHUNK_SIZE:
+                    (i + 1) * self.GENESIS_CHUNK_SIZE]
+        return {"chunk": i, "total": nchunks, "data": _b64(part)}
+
+    def dial_seeds(self, seeds=None):
+        """UNSAFE (rpc.unsafe config): crawl the given seeds
+        (reference rpc/core/net.go UnsafeDialSeeds)."""
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        pex = getattr(self.node, "pex_reactor", None)
+        if pex is None:
+            raise RPCError(-32603, "pex reactor is not running")
+        pex.seeds.extend(s for s in seeds if s not in pex.seeds)
+
+        def dial():
+            for s in seeds:
+                peer = self.node.switch.dial_peer(s)
+                if peer is not None:
+                    pex._request_addrs(peer)
+        # async: each dead address costs a ~10s connect timeout, which
+        # would hold the HTTP request open (reference DialSeeds is async)
+        threading.Thread(target=dial, daemon=True,
+                         name="rpc-dial-seeds").start()
+        return {"log": f"dialing seeds: {seeds}"}
+
+    def dial_peers(self, peers=None, persistent=None, unconditional=None,
+                   private=None):
+        """UNSAFE (rpc.unsafe config): dial the given peers (reference
+        rpc/core/net.go UnsafeDialPeers)."""
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+
+        def dial():
+            for p in peers:
+                self.node.switch.dial_peer(p, persistent=bool(persistent))
+        threading.Thread(target=dial, daemon=True,
+                         name="rpc-dial-peers").start()
+        return {"log": f"dialing peers: {peers}"}
 
     def unconfirmed_txs(self, limit=None):
         n = _int_arg(limit, 30) or 30

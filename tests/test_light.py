@@ -263,15 +263,22 @@ def test_primary_replacement_on_failure():
             from tendermint_tpu.light.provider import ProviderError
             raise ProviderError("connection refused")
 
-    witness = DictProvider(gdoc.chain_id, lbs)
+    w1 = DictProvider(gdoc.chain_id, lbs)
+    w2 = DictProvider(gdoc.chain_id, lbs)
     c = Client(gdoc.chain_id, TrustOptions(1, lbs[1].hash(), PERIOD),
-               DictProvider(gdoc.chain_id, lbs), [witness],
+               DictProvider(gdoc.chain_id, lbs), [w1, w2],
                LightStore(MemDB()))
     c.primary = DeadProvider(gdoc.chain_id, {})
     lb = c.verify_light_block_at_height(10, NOW)
     assert lb.height == 10
-    assert c.primary is witness          # promoted
-    assert c.witnesses == []             # consumed
+    assert c.primary is w1               # promoted
+    assert c.witnesses == [w2]           # one cross-checker remains
+
+    # draining the pool entirely is a fail-safe error, not silent
+    # unchallenged trust (reference errNoWitnesses)
+    c.witnesses.clear()
+    with pytest.raises(LightClientError, match="no witnesses"):
+        c.verify_light_block_at_height(11, NOW)
 
 
 def test_unresponsive_witness_removed_after_strikes():
@@ -282,8 +289,10 @@ def test_unresponsive_witness_removed_after_strikes():
             from tendermint_tpu.light.provider import ProviderError
             raise ProviderError("timeout")
 
+    good = DictProvider(gdoc.chain_id, lbs)
     w = FlakyWitness(gdoc.chain_id, {})
-    c = _make_client(lbs, gdoc.chain_id, witnesses=[w])
+    c = _make_client(lbs, gdoc.chain_id, witnesses=[w, good])
     for h in (4, 7, 10):
         c.verify_light_block_at_height(h, NOW)
     assert w not in c.witnesses
+    assert good in c.witnesses

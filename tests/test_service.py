@@ -1,0 +1,64 @@
+"""BaseService lifecycle (reference libs/service/service.go)."""
+import time
+
+import pytest
+
+from tendermint_tpu.libs.service import (AlreadyStartedError,
+                                         AlreadyStoppedError, BaseService,
+                                         ServiceError)
+
+
+class Counter(BaseService):
+    def __init__(self):
+        super().__init__("counter")
+        self.ticks = 0
+        self.stopped_hook = False
+
+    def on_start(self):
+        self.spawn(self._run)
+
+    def on_stop(self):
+        self.stopped_hook = True
+
+    def _run(self):
+        while not self.quitting.wait(0.01):
+            self.ticks += 1
+
+
+def test_lifecycle_and_errors():
+    s = Counter()
+    assert not s.is_running()
+    s.start()
+    assert s.is_running()
+    with pytest.raises(AlreadyStartedError):
+        s.start()
+    time.sleep(0.05)
+    s.stop()
+    assert s.stopped_hook and not s.is_running()
+    ticks = s.ticks
+    time.sleep(0.05)
+    assert s.ticks == ticks  # routine exited with the service
+    with pytest.raises(AlreadyStoppedError):
+        s.start()
+    s.stop()  # idempotent
+
+    s.reset()
+    s.start()
+    assert s.is_running()
+    s.stop()
+
+
+def test_reset_while_running_refused():
+    s = Counter()
+    s.start()
+    with pytest.raises(ServiceError):
+        s.reset()
+    s.stop()
+
+
+def test_wait_unblocks_on_stop():
+    s = Counter()
+    s.start()
+    assert not s.wait(0.02)
+    s.stop()
+    assert s.wait(1.0)

@@ -146,3 +146,77 @@ def test_ws_subscribe_new_block_and_tx(tmp_path):
         ws.close()
     finally:
         node.stop()
+
+
+@pytest.mark.slow
+def test_production_ws_client_and_new_rpc_routes(tmp_path):
+    """The shipped WSClient (rpc/client.py) subscribes / receives /
+    multiplexes calls over one socket, and the round-3 RPC routes
+    (dump_consensus_state, genesis_chunked, unsafe dial gating) answer
+    with the reference shapes."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.consensus.config import test_config as fast_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import HTTPClient, RPCClientError, WSClient
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = str(tmp_path / "node")
+    cfg = Config(home=home)
+    cfg.consensus = fast_config()
+    cfg.p2p.laddr = "127.0.0.1:0"
+    cfg.p2p.pex = False
+    cfg.rpc.laddr = "127.0.0.1:0"
+    cfg.ensure_dirs()
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    NodeKey.load_or_generate(cfg.node_key_file())
+    pub = pv.get_pub_key()
+    gdoc = GenesisDoc(chain_id="wsc-chain",
+                      genesis_time=Timestamp(1700000000, 0),
+                      validators=[GenesisValidator(
+                          address=pub.address(), pub_key_type=pub.type_name,
+                          pub_key_bytes=pub.bytes(), power=10)])
+    with open(cfg.genesis_file(), "w") as f:
+        f.write(gdoc.to_json())
+
+    node = Node(cfg, KVStoreApplication())
+    node.start()
+    ws = None
+    try:
+        addr = f"{node.rpc_server.host}:{node.rpc_server.port}"
+        ws = WSClient(addr)
+        sub = ws.subscribe("tm.event='NewBlock'")
+        ev = sub.get(timeout=30)
+        assert ev["data"]["type"] == "tendermint/event/NewBlock"
+        h1 = ev["data"]["value"]["height"]
+        # a plain RPC call multiplexes over the same connection
+        st = ws.call("status")
+        assert int(st["sync_info"]["latest_block_height"]) >= h1
+        ev2 = sub.get(timeout=30)
+        assert ev2["data"]["value"]["height"] > h1
+        ws.unsubscribe("tm.event='NewBlock'")
+
+        http = HTTPClient(addr)
+        dump = http.call("dump_consensus_state")
+        assert dump["round_state"]["height"] >= 1
+        assert "votes" in dump["round_state"]
+        assert isinstance(dump["peers"], list)
+
+        g = http.call("genesis_chunked", chunk=0)
+        assert g["total"] == 1 and g["chunk"] == 0
+        import base64 as b64
+        assert b"wsc-chain" in b64.b64decode(g["data"])
+        with pytest.raises(RPCClientError, match="chunks"):
+            http.call("genesis_chunked", chunk=5)
+
+        # unsafe routes are gated off by default
+        with pytest.raises(RPCClientError, match="not found|unknown"):
+            http.call("dial_peers", peers=["x@127.0.0.1:1"])
+    finally:
+        if ws is not None:
+            ws.close()
+        node.stop()
