@@ -183,19 +183,26 @@ def config5_mixed(n=4096):
         else:
             k = sr.PrivKey(seed)
         items.append((k.pub_key(), msg, k.sign(msg)))
+    # warm ONLY the TPU kernel bucket (a separate all-ed25519 batch of
+    # the same lane-bucket size the mixed batch's ed25519 share lands
+    # in): timing the same items twice would hand the host schemes
+    # SigCache hits and measure the cache, not verification
+    n_ed = len([None for i in range(n) if i % 3 == 0])
+    warm = BatchVerifier()
+    for i in range(n_ed):
+        k = ed.PrivKey((0x9000 + i).to_bytes(32, "big"))
+        m = b"warm %d" % i
+        warm.add(k.pub_key(), m, k.sign(m))
+    assert warm.verify()[0]
+
     bv = BatchVerifier()
     for pub, m, s in items:
         bv.add(pub, m, s)
-    ok, bits = bv.verify()
-    assert ok
     t0 = time.perf_counter()
-    bv2 = BatchVerifier()
-    for pub, m, s in items:
-        bv2.add(pub, m, s)
-    ok, _ = bv2.verify()
+    ok, _ = bv.verify()
     dt = time.perf_counter() - t0
     assert ok
-    return {"config": f"5: mixed 3-scheme batch ({n})",
+    return {"config": f"5: mixed 3-scheme batch ({n}, cold cache)",
             "wall_s": round(dt, 2), "sigs_per_s": round(n / dt)}
 
 

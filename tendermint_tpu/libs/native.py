@@ -19,6 +19,7 @@ import numpy as np
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "staging.c")
+_SRC_EC = os.path.join(_NATIVE_DIR, "ecverify.c")
 
 _lock = threading.Lock()
 _lib = None
@@ -30,6 +31,8 @@ def _build() -> str | None:
     try:
         with open(_SRC, "rb") as f:
             src = f.read()
+        with open(_SRC_EC, "rb") as f:
+            src += f.read()
     except OSError:
         return None
     import platform
@@ -54,7 +57,7 @@ def _build() -> str | None:
         for cc in ("cc", "gcc", "clang"):
             try:
                 r = subprocess.run(
-                    [cc, *flags, "-o", tmp, _SRC],
+                    [cc, *flags, "-o", tmp, _SRC, _SRC_EC],
                     capture_output=True, timeout=120)
             except (OSError, subprocess.TimeoutExpired):
                 continue
@@ -96,6 +99,12 @@ def get_lib():
                 lib.tm_vote_sign_bytes.argtypes = [
                     i64p, i64p, u8p, u8p, u64, u8p, u64, u8p, u64,
                     u8p, u64p, u64]
+                lib.tm_secp_verify.argtypes = [u8p, u8p, u64p, u8p,
+                                               u8p, u64]
+                lib.tm_sr25519_verify.argtypes = [u8p, u8p, u64p, u8p,
+                                                  u8p, u64]
+                lib.tm_secp_verify.restype = None
+                lib.tm_sr25519_verify.restype = None
                 for fn in (lib.tm_sha512_prefixed, lib.tm_sha512_batch,
                            lib.tm_sha512_plain, lib.tm_scalar_canonical,
                            lib.tm_mod_l, lib.tm_challenge_prefixed,
@@ -251,6 +260,38 @@ def vote_sign_bytes(seconds: np.ndarray, nanos: np.ndarray,
         _u8p(sf), ctypes.c_uint64(len(suffix)),
         _u8p(buf), _u64p(offsets), ctypes.c_uint64(n))
     return buf, offsets
+
+
+def _ec_verify(fn_name: str, keysize: int, pubs, msgs, sigs):
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(pubs)
+    pub_arr = np.frombuffer(b"".join(bytes(p) for p in pubs),
+                            dtype=np.uint8)
+    if pub_arr.size != n * keysize:
+        return None  # malformed key length: caller's per-item path decides
+    sig_arr = np.frombuffer(b"".join(bytes(s) for s in sigs),
+                            dtype=np.uint8)
+    if sig_arr.size != n * 64:
+        return None
+    buf, offsets = _ragged(msgs, n)
+    out = np.empty(n, dtype=np.uint8)
+    getattr(lib, fn_name)(_u8p(pub_arr), _u8p(buf), _u64p(offsets),
+                          _u8p(sig_arr), _u8p(out), ctypes.c_uint64(n))
+    return out.astype(bool)
+
+
+def secp_verify(pubs, msgs, sigs) -> np.ndarray | None:
+    """Batch BIP-340 verify (33B compressed pubs, raw msgs, 64B sigs);
+    None when the C library is missing or inputs are irregular."""
+    return _ec_verify("tm_secp_verify", 33, pubs, msgs, sigs)
+
+
+def sr25519_verify(pubs, msgs, sigs) -> np.ndarray | None:
+    """Batch schnorrkel verify (32B ristretto pubs, raw msgs, 64B sigs —
+    merlin transcript, ristretto double-scalar all in C)."""
+    return _ec_verify("tm_sr25519_verify", 32, pubs, msgs, sigs)
 
 
 def scalar_canonical(s_bytes: np.ndarray) -> np.ndarray | None:
