@@ -15,7 +15,8 @@ from tendermint_tpu.types.basic import Timestamp
 from tendermint_tpu.types.light_block import LightBlock
 
 from . import verifier
-from .detector import Divergence, detect_divergence, examine_divergence
+from .detector import (Divergence, LightClientError, detect_divergence,
+                       examine_divergence)
 from .provider import (BadLightBlockError, HeightTooHigh, LightBlockNotFound,
                        Provider, ProviderError)
 from .store import LightStore
@@ -26,10 +27,6 @@ _SKIP_NUM, _SKIP_DEN = 1, 2
 DEFAULT_TRUSTING_PERIOD_S = 14 * 24 * 3600.0  # reference light/client.go
 DEFAULT_MAX_CLOCK_DRIFT_S = 10.0
 MAX_WITNESS_STRIKES = 3  # consecutive failures before a witness is dropped
-
-
-class LightClientError(Exception):
-    pass
 
 
 class TrustOptions:
@@ -135,18 +132,24 @@ class Client:
             trace = self._verify_sequential(anchor, lb, now)
         else:
             trace = self._verify_skipping(anchor, lb, now)
-        if self._had_witnesses and not self.witnesses:
-            raise LightClientError(
-                "no witnesses left to cross-check the primary "
-                "(reference errNoWitnesses): refusing to trust "
-                "unchallenged headers")
         # detect BEFORE persisting: on a divergence nothing from the
         # disputed trace may enter the trusted store (a primary-side
         # attack would otherwise be served as trusted forever after the
-        # dissenting witness is removed)
-        div = detect_divergence(self, trace, now)
-        if div is not None:
-            self._handle_divergence(anchor, trace, div)
+        # dissenting witness is removed).  A witness whose conflicting
+        # chain fails verification is dropped (reference errBadWitness)
+        # and detection re-runs over the remaining pool — one garbage
+        # witness must not abort an otherwise-valid verify.
+        matched: set = set()   # witnesses already polled + agreeing
+        while True:
+            if self._had_witnesses and not self.witnesses:
+                raise LightClientError(
+                    "no witnesses left to cross-check the primary "
+                    "(reference errNoWitnesses): refusing to trust "
+                    "unchallenged headers")
+            div = detect_divergence(self, trace, now, matched)
+            if div is None:
+                break
+            self._handle_divergence(anchor, trace, div, now)
         for b in trace:
             self.store.save(b)
 
@@ -166,9 +169,14 @@ class Client:
             trace.append(lb)
         return trace
 
-    def _verify_skipping(self, trusted: LightBlock, target: LightBlock,
-                         now: Timestamp) -> List[LightBlock]:
-        """Reference client.go:706-775: bisection with a block cache."""
+    def _bisect(self, trusted: LightBlock, target: LightBlock,
+                now: Timestamp, fetch_pivot) -> List[LightBlock]:
+        """Core skipping-verification state machine (reference
+        client.go:706-775): bisection with a block cache.  Shared by the
+        primary path (_verify_skipping) and the witness-conflict path
+        (_verify_witness_chain); fetch_pivot(height) supplies bisection
+        pivots from the respective source.  Returns the verified trace
+        (excluding `trusted`)."""
         cache = [target]
         depth = 0
         verified = trusted
@@ -186,11 +194,7 @@ class Client:
                     pivot = (verified.height
                              + (cache[depth].height - verified.height)
                              * _SKIP_NUM // _SKIP_DEN)
-                    try:
-                        cache.append(self._from_primary(pivot))
-                    except (LightBlockNotFound, HeightTooHigh) as e:
-                        raise LightClientError(
-                            f"bisection pivot {pivot} unavailable: {e}")
+                    cache.append(fetch_pivot(pivot))
                 depth += 1
             except verifier.LightError as e:
                 raise LightClientError(
@@ -205,6 +209,17 @@ class Client:
                 depth = 0
                 trace.append(verified)
 
+    def _verify_skipping(self, trusted: LightBlock, target: LightBlock,
+                         now: Timestamp) -> List[LightBlock]:
+        """Skipping verification against the primary."""
+        def fetch(pivot: int) -> LightBlock:
+            try:
+                return self._from_primary(pivot)
+            except (LightBlockNotFound, HeightTooHigh) as e:
+                raise LightClientError(
+                    f"bisection pivot {pivot} unavailable: {e}")
+        return self._bisect(trusted, target, now, fetch)
+
     def _backwards(self, trusted: LightBlock, target: LightBlock):
         """Reference client.go:933-988: follow LastBlockID links down."""
         cur = trusted
@@ -216,20 +231,32 @@ class Client:
     # -- divergence handling (reference detector.go:90-180) ----------------
 
     def _handle_divergence(self, anchor: Optional[LightBlock],
-                           trace: List[LightBlock], div: Divergence):
-        """Attribute the attack, submit evidence both ways, drop the
-        diverging witness, and raise the Divergence.  The client cannot
-        know which side is honest, so each side's evidence goes to the
-        other plus every remaining provider (reference detector.go
-        sendEvidence to primary and witnesses)."""
+                           trace: List[LightBlock], div: Divergence,
+                           now: Timestamp):
+        """Verify the witness's conflicting chain from the common block
+        (reference detector.go examineConflictingHeaderAgainstTrace);
+        only a VERIFIED conflict is an attack.  On verification failure
+        the witness is bad (garbage or buggy) — drop it and return so
+        detection continues over the remaining pool, instead of firing
+        unfounded evidence at the primary (reference errBadWitness).
+        On a verified conflict: attribute the attack, submit evidence
+        both ways, drop the diverging witness, and raise the Divergence
+        — the client cannot know which side is honest, so each side's
+        evidence goes to the other plus every remaining provider
+        (reference detector.go sendEvidence to primary and witnesses)."""
         chain = ([anchor] if anchor is not None else []) + list(trace)
         witness = div.witness
         try:
             common, ev_w, ev_p = examine_divergence(self, chain, div)
-        except Exception as e:  # noqa: BLE001 - never mask the divergence
-            self.log.error("divergence examination failed", err=str(e))
+            self._verify_witness_chain(common, div.witness_block,
+                                       witness, now)
+        except Exception as e:  # noqa: BLE001 - unverifiable conflict
+            self.log.error(
+                "witness's conflicting header could not be verified; "
+                "dropping witness", err=str(e),
+                height=div.primary_block.height)
             self._remove_witness(witness)
-            raise div
+            return
         self.log.error(
             "light client attack detected",
             height=div.primary_block.height,
@@ -247,6 +274,23 @@ class Client:
                 self.log.error("evidence submission failed", err=str(e))
         self._remove_witness(witness)
         raise div
+
+    def _verify_witness_chain(self, trusted: LightBlock,
+                              target: LightBlock, witness: Provider,
+                              now: Timestamp) -> None:
+        """Skipping-verify the witness's conflicting header from the
+        common block, fetching bisection pivots FROM THE WITNESS
+        (reference detector.go:120-180: the witness trace must verify
+        before its conflict counts as an attack).  Raises on any
+        verification or fetch failure — the caller treats that as a bad
+        witness."""
+        def fetch(pivot: int) -> LightBlock:
+            wb = witness.light_block(pivot)  # ProviderError -> bad witness
+            if wb is None:
+                raise LightClientError(
+                    f"witness lacks its own bisection pivot {pivot}")
+            return wb
+        self._bisect(trusted, target, now, fetch)
 
     # -- provider management (reference client.go findNewPrimary) ----------
 

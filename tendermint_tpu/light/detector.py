@@ -16,9 +16,23 @@ from tendermint_tpu.types.light_block import LightBlock
 from .provider import ProviderError
 
 
+class LightClientError(Exception):
+    """Base class for light-client failures (client.py re-exports this;
+    defined here so detector errors can subclass it without an import
+    cycle)."""
+
+
 class NoCommonBlock(Exception):
     """The witness disputes the entire verified chain — no height exists
     at which verifiable attack evidence can be anchored."""
+
+
+class CrossReferenceError(LightClientError):
+    """No witness returned a header that could actually be compared
+    against the primary's (reference detector.go:99-104
+    ErrFailedHeaderCrossReferencing).  Trusting the primary with zero
+    successful cross-checks would let a malicious primary ride out a
+    window where every witness is eclipsed or unresponsive."""
 
 
 class Divergence(Exception):
@@ -51,16 +65,30 @@ class Divergence(Exception):
 
 
 def detect_divergence(client, trace: List[LightBlock],
-                      now: Timestamp) -> Optional[Divergence]:
+                      now: Timestamp,
+                      already_matched: Optional[set] = None
+                      ) -> Optional[Divergence]:
     """Compare the newly verified header against every witness
     (reference detector.go:48).  Returns the first Divergence found (the
     caller raises it after examining it), None when all witnesses agree.
     Unresponsive witnesses accrue strikes and are removed by the client
-    after repeated failures (reference removes them on error)."""
-    if not trace:
+    after repeated failures (reference removes them on error).
+
+    Raises CrossReferenceError when witnesses were configured but not a
+    single one produced a comparable header (reference detector.go:99-104:
+    headersMatched must be true or the whole verify fails) — the caller
+    must NOT persist the trace in that case."""
+    if not trace or not client.witnesses:
         return None
     target = trace[-1]
-    for i, w in enumerate(list(client.witnesses)):
+    compared = False
+    for w in list(client.witnesses):
+        if already_matched is not None and id(w) in already_matched:
+            # this witness already agreed during this verify; don't
+            # re-poll it after a bad witness was dropped and detection
+            # re-runs (each poll is a network round trip)
+            compared = True
+            continue
         try:
             wb = w.light_block(target.height)
         except ProviderError as e:
@@ -72,6 +100,14 @@ def detect_divergence(client, trace: List[LightBlock],
         client.note_witness_ok(w)
         if wb.hash() != target.hash():
             return Divergence(target, wb, w)
+        compared = True
+        if already_matched is not None:
+            already_matched.add(id(w))
+    if not compared:
+        raise CrossReferenceError(
+            f"no witness could cross-reference header at height "
+            f"{target.height}: all {len(client.witnesses)} witnesses "
+            f"errored or lacked the block")
     return None
 
 

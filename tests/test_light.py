@@ -119,21 +119,59 @@ def test_client_update_and_backwards():
     assert lb.height == 4
 
 
-def test_client_detects_witness_divergence():
-    gdoc, lbs = _light_chain(12)
-    # witness serves a fork: same chain but a corrupted header at 12
+def _garbage_fork(lbs, height=12):
+    """A corrupted (NOT re-signed) fork: the mutated header's commit no
+    longer matches, so the conflicting chain cannot verify — per the
+    reference this is a bad witness (errBadWitness), not an attack."""
     import copy
     forked = dict(lbs)
-    evil = copy.deepcopy(lbs[12])
+    evil = copy.deepcopy(lbs[height])
     evil.signed_header.header.app_hash = b"\xBA\xD0" * 16
-    forked[12] = evil
-    witness = DictProvider(gdoc.chain_id, forked)
-    c = _make_client(lbs, gdoc.chain_id, witnesses=[witness])
-    with pytest.raises(Divergence) as ei:
+    forked[height] = evil
+    return forked
+
+
+def test_client_drops_unverifiable_witness_conflict():
+    # reference detector.go: the witness's conflicting chain is verified
+    # from the common block BEFORE evidence fires; a garbage witness is
+    # dropped and verification continues with the rest of the pool
+    gdoc, lbs = _light_chain(12)
+    garbage = DictProvider(gdoc.chain_id, _garbage_fork(lbs))
+    honest = DictProvider(gdoc.chain_id, lbs)
+    c = _make_client(lbs, gdoc.chain_id, witnesses=[garbage, honest])
+    lb = c.verify_light_block_at_height(12, NOW)
+    assert lb.height == 12 and c.store.get(12) is not None
+    assert garbage not in c.witnesses and honest in c.witnesses
+
+
+def test_client_refuses_when_only_witness_is_garbage():
+    # dropping the garbage witness drains the pool: the client must
+    # refuse rather than trust the primary unchallenged, and nothing
+    # from the disputed trace may be persisted
+    gdoc, lbs = _light_chain(12)
+    garbage = DictProvider(gdoc.chain_id, _garbage_fork(lbs))
+    c = _make_client(lbs, gdoc.chain_id, witnesses=[garbage])
+    with pytest.raises(LightClientError):
         c.verify_light_block_at_height(12, NOW)
-    ev = ei.value.make_evidence(common_height=11)
-    assert ev.conflicting_block.height == 12
-    assert ev.total_voting_power > 0
+    assert c.store.get(12) is None
+
+
+def test_client_requires_one_successful_cross_reference():
+    # reference detector.go:99-104 ErrFailedHeaderCrossReferencing: if
+    # every witness errors/lacks the block, the header is NOT trusted
+    from tendermint_tpu.light.detector import CrossReferenceError
+    from tendermint_tpu.light.provider import ProviderError
+
+    class DeadProvider(DictProvider):
+        def light_block(self, height):
+            raise ProviderError("unreachable")
+
+    gdoc, lbs = _light_chain(12)
+    dead = DeadProvider(gdoc.chain_id, {})
+    c = _make_client(lbs, gdoc.chain_id, witnesses=[dead])
+    with pytest.raises((CrossReferenceError, LightClientError)):
+        c.verify_light_block_at_height(12, NOW)
+    assert c.store.get(12) is None
 
 
 def test_client_rejects_wrong_trust_anchor():
