@@ -114,8 +114,13 @@ wire.register_codec(CHUNK_CHANNEL, encode_msg, decode_msg)
 
 
 class StateSyncReactor(Reactor):
+    """BaseService lifecycle via Reactor; started/stopped by the Switch
+    (reference statesync/reactor.go: a p2p.BaseReactor)."""
+
     def __init__(self, app, state_provider=None):
         super().__init__("STATESYNC")
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("statesync")
         self.app = app
         self.syncer: Optional[Syncer] = None
         if state_provider is not None:
@@ -155,6 +160,8 @@ class StateSyncReactor(Reactor):
                     peer.try_send(SNAPSHOT_CHANNEL, SnapshotsResponse(
                         s.height, s.format, s.chunks, s.hash, s.metadata))
             elif isinstance(msg, SnapshotsResponse) and self.syncer:
+                self.log.debug("discovered snapshot", peer=peer.id,
+                               height=msg.height, format=msg.format)
                 self.syncer.add_snapshot(
                     abci.Snapshot(msg.height, msg.format, msg.chunks,
                                   msg.hash, msg.metadata), peer.id)
@@ -177,6 +184,7 @@ class StateSyncReactor(Reactor):
         sw = self.switch
         if sw is None:
             return
+        self.log.info("banning peer", peer=peer_id, reason=reason)
         peer = sw.peers.get(peer_id)
         if peer is not None:
             sw.stop_peer_for_error(peer, reason)

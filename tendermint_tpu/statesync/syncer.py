@@ -47,6 +47,8 @@ class Syncer:
         self.chunk_fetcher = chunk_fetcher
         self.ban_peer = ban_peer            # ban_peer(peer_id, reason)
         self.fetchers = max(1, fetchers)
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("statesync")
         self._snapshots: List[Tuple[abci.Snapshot, str]] = []
         self._rejected: set = set()
         self._lock = threading.Lock()
@@ -85,12 +87,23 @@ class Syncer:
         reasons = []
         for snapshot, peer_id in self._best_snapshots():
             try:
-                return self._sync_one(snapshot, peer_id)
+                self.log.info("offering snapshot to app",
+                              height=snapshot.height,
+                              format=snapshot.format,
+                              chunks=snapshot.chunks, peer=peer_id)
+                result = self._sync_one(snapshot, peer_id)
+                self.log.info("snapshot restored",
+                              height=snapshot.height)
+                return result
             except SnapshotUnverifiable as e:
                 # may verify on a later attempt; do not blacklist
+                self.log.debug("snapshot not yet verifiable",
+                               height=snapshot.height, err=str(e))
                 reasons.append(f"h{snapshot.height}: {e}")
                 continue
             except SnapshotRejected as e:
+                self.log.error("snapshot rejected",
+                               height=snapshot.height, err=str(e))
                 reasons.append(f"h{snapshot.height}: REJECTED {e}")
                 with self._lock:
                     self._rejected.add(
@@ -176,6 +189,8 @@ class Syncer:
                         inflight.discard(idx)
                         failures[idx] = failures.get(idx, 0) + 1
                         if failures[idx] > CHUNK_RETRIES:
+                            self.log.error("chunk fetch failed, giving up",
+                                           chunk=idx, err=str(e))
                             fetch_err.append(e)
                             done.set()
                         else:
@@ -194,11 +209,15 @@ class Syncer:
             t.start()
         try:
             index = 0
-            # total RETRY verdicts for this restore — deliberately never
-            # reset: with accumulate-style apps every refetch-all cycle
-            # ends in one RETRY, and intermediate buffering ACCEPTs must
-            # not launder the count into an infinite loop
+            # RETRY budget resets whenever the apply cursor passes a new
+            # high-water mark: a large restore may legitimately RETRY a
+            # handful of times spread across many chunks (the reference's
+            # chunks.Retry has no global cap, syncer.go:397), but an app
+            # spinning at the SAME frontier still trips the cap — and the
+            # high-water mark only ever rises, so reset cycles are bounded
+            # by nchunks and cannot launder the count into an infinite loop
             retries = 0
+            high_water = -1
             while index < nchunks:
                 with cv:
                     while index not in fetched and not done.is_set():
@@ -211,11 +230,16 @@ class Syncer:
                 r = self.app.apply_snapshot_chunk(index, chunk, sender)
                 for pid in getattr(r, "reject_senders", ()) or ():
                     if self.ban_peer is not None and pid:
+                        self.log.info("banning peer for rejected chunk",
+                                      peer=pid, chunk=index)
                         self.ban_peer(pid, "statesync chunk rejected")
                 refetch = [i for i in (getattr(r, "refetch_chunks", ())
                                        or ()) if 0 <= i < nchunks]
                 if r.result == abci.ResponseApplySnapshotChunk.ACCEPT:
                     nxt = index + 1
+                    if index > high_water:
+                        high_water = index
+                        retries = 0
                 elif r.result == abci.ResponseApplySnapshotChunk.RETRY:
                     retries += 1
                     if retries > CHUNK_RETRIES:
