@@ -105,6 +105,12 @@ def get_lib():
                                                   u8p, u64]
                 lib.tm_secp_verify.restype = None
                 lib.tm_sr25519_verify.restype = None
+                lib.tm_secp_verify_batch.argtypes = [u8p, u8p, u64p, u8p,
+                                                     u8p, u8p, u64]
+                lib.tm_sr25519_verify_batch.argtypes = [u8p, u8p, u64p,
+                                                        u8p, u8p, u8p, u64]
+                lib.tm_secp_verify_batch.restype = None
+                lib.tm_sr25519_verify_batch.restype = None
                 for fn in (lib.tm_sha512_prefixed, lib.tm_sha512_batch,
                            lib.tm_sha512_plain, lib.tm_scalar_canonical,
                            lib.tm_mod_l, lib.tm_challenge_prefixed,
@@ -277,21 +283,26 @@ def _ec_verify(fn_name: str, keysize: int, pubs, msgs, sigs):
         return None
     buf, offsets = _ragged(msgs, n)
     out = np.empty(n, dtype=np.uint8)
+    # random-linear-combination batch verify (Pippenger MSM + bisection
+    # on failure; per-sig verdicts exactly match single verification).
+    # The seed must be unpredictable to whoever chose the signatures.
+    seed = np.frombuffer(os.urandom(32), dtype=np.uint8)
     getattr(lib, fn_name)(_u8p(pub_arr), _u8p(buf), _u64p(offsets),
-                          _u8p(sig_arr), _u8p(out), ctypes.c_uint64(n))
+                          _u8p(sig_arr), _u8p(seed), _u8p(out),
+                          ctypes.c_uint64(n))
     return out.astype(bool)
 
 
 def secp_verify(pubs, msgs, sigs) -> np.ndarray | None:
     """Batch BIP-340 verify (33B compressed pubs, raw msgs, 64B sigs);
     None when the C library is missing or inputs are irregular."""
-    return _ec_verify("tm_secp_verify", 33, pubs, msgs, sigs)
+    return _ec_verify("tm_secp_verify_batch", 33, pubs, msgs, sigs)
 
 
 def sr25519_verify(pubs, msgs, sigs) -> np.ndarray | None:
     """Batch schnorrkel verify (32B ristretto pubs, raw msgs, 64B sigs —
-    merlin transcript, ristretto double-scalar all in C)."""
-    return _ec_verify("tm_sr25519_verify", 32, pubs, msgs, sigs)
+    merlin transcript, ristretto MSM all in C)."""
+    return _ec_verify("tm_sr25519_verify_batch", 32, pubs, msgs, sigs)
 
 
 def scalar_canonical(s_bytes: np.ndarray) -> np.ndarray | None:

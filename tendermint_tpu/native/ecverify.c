@@ -16,6 +16,7 @@
  */
 
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 
 #define EXPORT __attribute__((visibility("default")))
@@ -159,17 +160,7 @@ static void fe_from_be(fe256 *r, const u8 *b) {
     }
 }
 
-static void fe_mul(fe256 *r, const fe256 *a, const fe256 *b) {
-    u64 d[8] = {0};
-    for (int i = 0; i < 4; i++) {
-        u128 carry = 0;
-        for (int j = 0; j < 4; j++) {
-            u128 t = (u128)a->v[i] * b->v[j] + d[i + j] + carry;
-            d[i + j] = (u64)t;
-            carry = t >> 64;
-        }
-        d[i + 4] += (u64)carry;
-    }
+static void fe_fold512(fe256 *r, const u64 *d) {
     /* fold d[4..7] * 2^256 === d[4..7] * K */
     u64 t[5];
     u128 c = 0;
@@ -197,6 +188,50 @@ static void fe_mul(fe256 *r, const fe256 *a, const fe256 *b) {
         }
     }
     fe_normalize(r);
+}
+
+static void fe_mul(fe256 *r, const fe256 *a, const fe256 *b) {
+    u64 d[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)a->v[i] * b->v[j] + d[i + j] + carry;
+            d[i + j] = (u64)t;
+            carry = t >> 64;
+        }
+        d[i + 4] += (u64)carry;
+    }
+    fe_fold512(r, d);
+}
+
+/* dedicated squaring: cross terms computed once and doubled (10 word
+ * multiplies instead of 16) */
+static void fe_sqr(fe256 *r, const fe256 *a) {
+    u64 d[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = i + 1; j < 4; j++) {
+            u128 t = (u128)a->v[i] * a->v[j] + d[i + j] + (u64)carry;
+            d[i + j] = (u64)t;
+            carry = t >> 64;
+        }
+        if (i < 3) d[i + 4] += (u64)carry;
+    }
+    u64 top = 0;
+    for (int k = 0; k < 8; k++) {      /* double the cross terms */
+        u64 nv = (d[k] << 1) | top;
+        top = d[k] >> 63;
+        d[k] = nv;
+    }
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {      /* add the squares on the diagonal */
+        u128 sq = (u128)a->v[i] * a->v[i];
+        c += (u128)d[2 * i] + (u64)sq;
+        d[2 * i] = (u64)c; c >>= 64;
+        c += (u128)d[2 * i + 1] + (u64)(sq >> 64);
+        d[2 * i + 1] = (u64)c; c >>= 64;
+    }
+    fe_fold512(r, d);
 }
 
 static void fe_add(fe256 *r, const fe256 *a, const fe256 *b) {
@@ -240,11 +275,20 @@ static int fe_eq(const fe256 *a, const fe256 *b) {
     return fe_is_zero(&d);
 }
 
+/* 4-bit fixed-window powering: 255 squarings + <=64 multiplies versus
+ * ~500 multiplies for bit-at-a-time (the secp exponents are nearly
+ * all-ones, so the conditional multiply almost always fired) */
 static void fe_pow(fe256 *r, const fe256 *a, const u64 *e) {
-    fe256 acc = {{1, 0, 0, 0}}, base = *a;
-    for (int i = 0; i < 256; i++) {
-        if ((e[i / 64] >> (i % 64)) & 1) fe_mul(&acc, &acc, &base);
-        fe_mul(&base, &base, &base);
+    fe256 tbl[16];
+    tbl[0] = (fe256){{1, 0, 0, 0}};
+    tbl[1] = *a;
+    for (int i = 2; i < 16; i++) fe_mul(&tbl[i], &tbl[i - 1], a);
+    fe256 acc = tbl[(e[3] >> 60) & 15];
+    for (int w = 62; w >= 0; w--) {
+        fe_sqr(&acc, &acc); fe_sqr(&acc, &acc);
+        fe_sqr(&acc, &acc); fe_sqr(&acc, &acc);
+        int d = (int)((e[w / 16] >> (4 * (w % 16))) & 15);
+        if (d) fe_mul(&acc, &acc, &tbl[d]);
     }
     *r = acc;
 }
@@ -267,17 +311,17 @@ typedef struct { fe256 x, y, z; int inf; } jpt;
 static void jdbl(jpt *r, const jpt *a) {
     if (a->inf || fe_is_zero(&a->y)) { r->inf = 1; return; }
     fe256 ys, s, m, x3, y3, z3, t;
-    fe_mul(&ys, &a->y, &a->y);
+    fe_sqr(&ys, &a->y);
     fe_mul(&s, &a->x, &ys);
     fe_add(&s, &s, &s); fe_add(&s, &s, &s);           /* 4*x*y^2 */
-    fe_mul(&m, &a->x, &a->x);
+    fe_sqr(&m, &a->x);
     fe_add(&t, &m, &m); fe_add(&m, &t, &m);           /* 3*x^2 */
-    fe_mul(&x3, &m, &m);
+    fe_sqr(&x3, &m);
     fe_add(&t, &s, &s);
     fe_sub(&x3, &x3, &t);                             /* m^2 - 2s */
     fe_sub(&t, &s, &x3);
     fe_mul(&y3, &m, &t);
-    fe_mul(&t, &ys, &ys);
+    fe_sqr(&t, &ys);
     fe_add(&t, &t, &t); fe_add(&t, &t, &t); fe_add(&t, &t, &t); /* 8*y^4 */
     fe_sub(&y3, &y3, &t);
     fe_mul(&z3, &a->y, &a->z);
@@ -289,8 +333,8 @@ static void jadd(jpt *r, const jpt *a, const jpt *b) {
     if (a->inf) { *r = *b; return; }
     if (b->inf) { *r = *a; return; }
     fe256 z1z1, z2z2, u1, u2, s1, s2, t;
-    fe_mul(&z1z1, &a->z, &a->z);
-    fe_mul(&z2z2, &b->z, &b->z);
+    fe_sqr(&z1z1, &a->z);
+    fe_sqr(&z2z2, &b->z);
     fe_mul(&u1, &a->x, &z2z2);
     fe_mul(&u2, &b->x, &z1z1);
     fe_mul(&t, &b->z, &z2z2);
@@ -304,11 +348,11 @@ static void jadd(jpt *r, const jpt *a, const jpt *b) {
     }
     fe256 h, hh, hhh, rr, v, x3, y3, z3;
     fe_sub(&h, &u2, &u1);
-    fe_mul(&hh, &h, &h);
+    fe_sqr(&hh, &h);
     fe_mul(&hhh, &h, &hh);
     fe_sub(&rr, &s2, &s1);
     fe_mul(&v, &u1, &hh);
-    fe_mul(&x3, &rr, &rr);
+    fe_sqr(&x3, &rr);
     fe_sub(&x3, &x3, &hhh);
     fe_add(&t, &v, &v);
     fe_sub(&x3, &x3, &t);
@@ -404,12 +448,12 @@ static int secp_verify_one(const u8 *pub33, const u8 *msg, u64 mlen,
     if (ge256(xb, SECP_P)) return 0;
     fe_from_be(&x, pub33 + 1);
     /* y^2 = x^3 + 7; sqrt must exist (decompress validity + lift_x) */
-    fe_mul(&y2, &x, &x);
+    fe_sqr(&y2, &x);
     fe_mul(&y2, &y2, &x);
     fe256 seven = {{7, 0, 0, 0}};
     fe_add(&y2, &y2, &seven);
     fe_pow(&y, &y2, SECP_SQRT_E);
-    fe_mul(&t, &y, &y);
+    fe_sqr(&t, &y);
     if (!fe_eq(&t, &y2)) return 0;
     /* even-y lift */
     fe_normalize(&y);
@@ -451,7 +495,7 @@ static int secp_verify_one(const u8 *pub33, const u8 *msg, u64 mlen,
     /* affine: zi = z^-2, check even y and x == r */
     fe256 zi, zi2, zi3, ax, ay;
     fe_pow(&zi, &R.z, SECP_INV_E);
-    fe_mul(&zi2, &zi, &zi);
+    fe_sqr(&zi2, &zi);
     fe_mul(&zi3, &zi2, &zi);
     fe_mul(&ax, &R.x, &zi2);
     fe_mul(&ay, &R.y, &zi3);
@@ -523,6 +567,28 @@ static void f25519_mul(f25519 *r, const f25519 *a, const f25519 *b) {
     f25519_carry(r);
 }
 
+/* dedicated squaring: 15 word multiplies instead of 25 */
+static void f25519_sqr(f25519 *r, const f25519 *a) {
+    u128 t[5] = {0};
+    for (int i = 0; i < 5; i++) {
+        for (int j = i; j < 5; j++) {
+            u128 p = (u128)a->v[i] * a->v[j];
+            if (i != j) p += p;
+            int k = i + j;
+            if (k >= 5) { k -= 5; p *= 19; }
+            t[k] += p;
+        }
+    }
+    u64 c = 0;
+    for (int i = 0; i < 5; i++) {
+        t[i] += c;
+        r->v[i] = (u64)(t[i] & M51);
+        c = (u64)(t[i] >> 51);
+    }
+    r->v[0] += c * 19;
+    f25519_carry(r);
+}
+
 static void f25519_add(f25519 *r, const f25519 *a, const f25519 *b) {
     for (int i = 0; i < 5; i++) r->v[i] = a->v[i] + b->v[i];
     f25519_carry(r);
@@ -579,18 +645,18 @@ static void f25519_neg(f25519 *r, const f25519 *a) {
 
 static void f25519_pow2k(f25519 *r, const f25519 *a, int k) {
     *r = *a;
-    while (k--) f25519_mul(r, r, r);
+    while (k--) f25519_sqr(r, r);
 }
 
 /* x^(2^252 - 3): shared exponent chain (pow_p58 for sqrt_ratio) */
 static void f25519_pow_p58(f25519 *r, const f25519 *x) {
     f25519 x2, x9, x11, x22, x_5_0, x_10_0, x_20_0, x_40_0, x_50_0,
         x_100_0, x_200_0, x_250_0, t;
-    f25519_mul(&x2, x, x);                       /* 2 */
+    f25519_sqr(&x2, x);                          /* 2 */
     f25519_pow2k(&t, &x2, 2);                    /* 8 */
     f25519_mul(&x9, &t, x);                      /* 9 */
     f25519_mul(&x11, &x9, &x2);                  /* 11 */
-    f25519_mul(&x22, &x11, &x11);                /* 22 */
+    f25519_sqr(&x22, &x11);                      /* 22 */
     f25519_mul(&x_5_0, &x22, &x9);               /* 2^5 - 1 */
     f25519_pow2k(&t, &x_5_0, 5);
     f25519_mul(&x_10_0, &t, &x_5_0);
@@ -637,9 +703,22 @@ static void ept_identity(ept *r) {
     r->z.v[0] = 1;
 }
 
+/* the Edwards d coefficient as radix-2^51 limbs, a compile-time
+ * constant (no lazy init: ctypes releases the GIL, so first calls can
+ * race and a plain done-flag store may be reordered before the limb
+ * writes).  Limbs verified against -121665/121666 mod p and
+ * ED_D_BYTES in tests/test_native_ec.py. */
+static const f25519 ED_D_LIMBS = {{
+    0x34DCA135978A3ULL, 0x1A8283B156EBDULL, 0x5E7A26001C029ULL,
+    0x739C663A03CBBULL, 0x52036CEE2B6FFULL}};
+
+static const f25519 *ed_d(void) {
+    return &ED_D_LIMBS;
+}
+
 static void ept_add(ept *r, const ept *p, const ept *q) {
-    f25519 a, b, c, d, e, f, g, h, t1, t2, dcoef;
-    f25519_from_le(&dcoef, ED_D_BYTES);
+    f25519 a, b, c, d, e, f, g, h, t1, t2;
+    const f25519 dcoef = *ed_d();
     f25519_sub(&t1, &p->y, &p->x);
     f25519_sub(&t2, &q->y, &q->x);
     f25519_mul(&a, &t1, &t2);
@@ -663,13 +742,13 @@ static void ept_add(ept *r, const ept *p, const ept *q) {
 
 static void ept_dbl(ept *r, const ept *p) {
     f25519 a, b, c, h, e, g, f, t;
-    f25519_mul(&a, &p->x, &p->x);
-    f25519_mul(&b, &p->y, &p->y);
-    f25519_mul(&c, &p->z, &p->z);
+    f25519_sqr(&a, &p->x);
+    f25519_sqr(&b, &p->y);
+    f25519_sqr(&c, &p->z);
     f25519_add(&c, &c, &c);
     f25519_add(&h, &a, &b);
     f25519_add(&t, &p->x, &p->y);
-    f25519_mul(&t, &t, &t);
+    f25519_sqr(&t, &t);
     f25519_sub(&e, &h, &t);
     f25519_sub(&g, &a, &b);
     f25519_add(&f, &c, &g);
@@ -711,9 +790,9 @@ static void ept_mul2(ept *r, const u8 *k1, const ept *B, const u8 *k2,
 static int invsqrt(f25519 *r, const f25519 *v) {
     f25519 v3, v7, p, t, check, sqrt_m1;
     f25519_from_le(&sqrt_m1, SQRT_M1_BYTES);
-    f25519_mul(&v3, v, v);
+    f25519_sqr(&v3, v);
     f25519_mul(&v3, &v3, v);         /* v^3 */
-    f25519_mul(&v7, &v3, &v3);
+    f25519_sqr(&v7, &v3);
     f25519_mul(&v7, &v7, v);         /* v^7 */
     f25519_pow_p58(&p, &v7);         /* (v^7)^((p-5)/8) */
     f25519_mul(&t, &v3, &p);         /* r = v^3 * (v^7)^((p-5)/8) */
@@ -755,10 +834,10 @@ static int ristretto_decode(ept *r, const u8 *b) {
     f25519_from_le(&d, ED_D_BYTES);
     memset(&one, 0, sizeof(one));
     one.v[0] = 1;
-    f25519_mul(&ss, &s, &s);
+    f25519_sqr(&ss, &s);
     f25519_sub(&u1, &one, &ss);
     f25519_add(&u2, &one, &ss);
-    f25519_mul(&u2s, &u2, &u2);
+    f25519_sqr(&u2s, &u2);
     f25519_mul(&v, &d, &u1);
     f25519_mul(&v, &v, &u1);
     f25519_neg(&v, &v);
@@ -996,4 +1075,526 @@ EXPORT void tm_sr25519_verify(const u8 *pubs32, const u8 *msgbuf,
         out[i] = (u8)sr25519_verify_one(
             pubs32 + 32 * i, msgbuf + offsets[i],
             offsets[i + 1] - offsets[i], sigs + 64 * i);
+}
+
+/* ===================================================================== */
+/* Batch verification: random linear combination + Pippenger MSM         */
+/*                                                                       */
+/* Per BIP-340's batch-verification spec and schnorrkel/dalek's          */
+/* verify_batch: with z_i random 128-bit scalars (z_0 = 1),              */
+/*                                                                       */
+/*   secp:  (sum z_i s_i) G - sum z_i R_i - sum (z_i e_i) P_i == inf    */
+/*   sr25519: (sum z_i s_i) B - sum z_i R_i - sum (z_i c_i) A_i in E[4] */
+/*                                                                       */
+/* implies every signature valid except with probability ~2^-128.  On    */
+/* failure the set is bisected, so per-signature verdicts are EXACTLY    */
+/* the single-verify verdicts (size-1 batches degenerate to the plain    */
+/* equation; z != 0 mod group order since 0 < z < 2^128 < order).        */
+/* The z_i derive from a caller-supplied 32-byte seed (os.urandom in     */
+/* libs/native.py) via SHA-256(seed || le64(i)): an adversary commits    */
+/* to the batch before the seed exists.                                  */
+/*                                                                       */
+/* The MSM is Pippenger's bucket method; all batch entry points are      */
+/* affine (z=1), so bucket accumulation uses mixed addition.  128-bit    */
+/* z_i scalars cost nothing extra: their high windows have digit 0.      */
+/* ===================================================================== */
+
+/* ---------------------------------------------- 256/512-bit helpers */
+
+/* r[6] = z[2] * s[4] (full product) */
+static void mul_128x256(u64 r[6], const u64 z[2], const u64 s[4]) {
+    memset(r, 0, 6 * sizeof(u64));
+    for (int i = 0; i < 2; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)z[i] * s[j] + r[i + j] + (u64)c;
+            r[i + j] = (u64)t;
+            c = t >> 64;
+        }
+        r[i + 4] += (u64)c;
+    }
+}
+
+/* acc[8] += p[6] (batch sums stay < 2^396 for n <= 2^12, no overflow) */
+static void acc512_add(u64 acc[8], const u64 p[6]) {
+    u128 c = 0;
+    for (int i = 0; i < 8; i++) {
+        c += (u128)acc[i] + (i < 6 ? p[i] : 0);
+        acc[i] = (u64)c;
+        c >>= 64;
+    }
+}
+
+/* 2^256 mod n = 2^256 - n (n is the secp256k1 group order) */
+static const u64 SECP_RN[3] = {0x402DA1732FC9BEBFULL, 0x4551231950B75FC4ULL,
+                               0x0000000000000001ULL};
+
+/* reduce a 512-bit value mod the secp group order n by repeated folding
+ * of the high half through 2^256 === RN (mod n) */
+static void mod_n_512(u64 out[4], const u64 t_in[8]) {
+    u64 t[8];
+    memcpy(t, t_in, sizeof(t));
+    for (;;) {
+        int high = 0;
+        for (int i = 4; i < 8; i++) high |= (t[i] != 0);
+        if (!high) break;
+        u64 lo[8] = {t[0], t[1], t[2], t[3], 0, 0, 0, 0};
+        u64 hi[4] = {t[4], t[5], t[6], t[7]};
+        memset(t, 0, sizeof(t));
+        memcpy(t, lo, 4 * sizeof(u64));
+        u128 c;
+        for (int i = 0; i < 4; i++) {      /* t += hi * RN */
+            c = 0;
+            for (int j = 0; j < 3; j++) {
+                u128 v = (u128)hi[i] * SECP_RN[j] + t[i + j] + (u64)c;
+                t[i + j] = (u64)v;
+                c = v >> 64;
+            }
+            for (int k = i + 3; k < 8 && c; k++) {
+                c += t[k];
+                t[k] = (u64)c;
+                c >>= 64;
+            }
+        }
+    }
+    memcpy(out, t, 4 * sizeof(u64));
+    while (ge256(out, SECP_N)) sub256(out, SECP_N);
+}
+
+/* z_i = SHA-256(seed || le64(i))[0:16] as two LE limbs; z_0 = 1 */
+static void derive_z(u64 z[4], const u8 *seed, u64 i) {
+    z[2] = z[3] = 0;
+    if (i == 0) { z[0] = 1; z[1] = 0; return; }
+    u8 le[8], d[32];
+    for (int j = 0; j < 8; j++) le[j] = (u8)(i >> (8 * j));
+    sha256_ctx c;
+    sha256_init(&c);
+    sha256_update(&c, seed, 32);
+    sha256_update(&c, le, 8);
+    sha256_final(&c, d);
+    z[0] = z[1] = 0;
+    for (int j = 7; j >= 0; j--) z[0] = (z[0] << 8) | d[j];
+    for (int j = 15; j >= 8; j--) z[1] = (z[1] << 8) | d[j];
+    if (!(z[0] | z[1])) z[0] = 1;   /* z must be nonzero mod the order */
+}
+
+/* c-bit digit of LE-limb scalar at window w */
+static inline int sc_digit(const u64 sc[4], int w, int c) {
+    int bit = w * c;
+    int limb = bit >> 6, off = bit & 63;
+    u64 d = sc[limb] >> off;
+    if (off + c > 64 && limb < 3) d |= sc[limb + 1] << (64 - off);
+    return (int)(d & ((1u << c) - 1));
+}
+
+static int msm_window_bits(u64 m) {
+    return m < 8 ? 3 : m < 32 ? 5 : m < 128 ? 7 : m < 512 ? 8
+                 : m < 2048 ? 9 : 11;
+}
+
+/* --------------------------------------------------- secp256k1 batch */
+
+/* mixed add: b is affine (z == 1, not infinity); 8M + 3S vs 12M + 4S */
+static void jadd_mixed(jpt *r, const jpt *a, const jpt *b) {
+    if (a->inf) { *r = *b; return; }
+    fe256 z1z1, u2, s2, t;
+    fe_sqr(&z1z1, &a->z);
+    fe_mul(&u2, &b->x, &z1z1);
+    fe_mul(&t, &a->z, &z1z1);
+    fe_mul(&s2, &b->y, &t);
+    if (fe_eq(&a->x, &u2)) {
+        if (!fe_eq(&a->y, &s2)) { r->inf = 1; return; }
+        jdbl(r, a);
+        return;
+    }
+    fe256 h, hh, hhh, rr, v, x3, y3, z3;
+    fe_sub(&h, &u2, &a->x);
+    fe_sqr(&hh, &h);
+    fe_mul(&hhh, &h, &hh);
+    fe_sub(&rr, &s2, &a->y);
+    fe_mul(&v, &a->x, &hh);
+    fe_sqr(&x3, &rr);
+    fe_sub(&x3, &x3, &hhh);
+    fe_add(&t, &v, &v);
+    fe_sub(&x3, &x3, &t);
+    fe_sub(&t, &v, &x3);
+    fe_mul(&y3, &rr, &t);
+    fe_mul(&t, &a->y, &hhh);
+    fe_sub(&y3, &y3, &t);
+    fe_mul(&z3, &a->z, &h);
+    r->x = x3; r->y = y3; r->z = z3; r->inf = 0;
+}
+
+/* Pippenger multi-scalar multiplication; pts are affine (z=1) */
+static void secp_msm(jpt *out, const jpt *pts, const u64 (*scs)[4],
+                     u64 m) {
+    int c = msm_window_bits(m);
+    int nw = (256 + c - 1) / c;
+    int nb = 1 << c;
+    jpt *buckets = malloc((u64)nb * sizeof(jpt));
+    jpt acc;
+    acc.inf = 1;
+    for (int w = nw - 1; w >= 0; w--) {
+        if (!acc.inf)
+            for (int k = 0; k < c; k++) jdbl(&acc, &acc);
+        for (int b = 1; b < nb; b++) buckets[b].inf = 1;
+        for (u64 i = 0; i < m; i++) {
+            int d = sc_digit(scs[i], w, c);
+            if (d) jadd_mixed(&buckets[d], &buckets[d], &pts[i]);
+        }
+        jpt sum, tot;
+        sum.inf = 1; tot.inf = 1;
+        for (int b = nb - 1; b >= 1; b--) {
+            jadd(&sum, &sum, &buckets[b]);
+            jadd(&tot, &tot, &sum);
+        }
+        jadd(&acc, &acc, &tot);
+    }
+    free(buckets);
+    *out = acc;
+}
+
+typedef struct {
+    jpt nR, nP;           /* even-y lifts of r and pubkey x, NEGATED */
+    u64 e[4], s[4], z[4]; /* challenge mod n, s, random weight */
+} secp_sig;
+
+/* decode prechecks: identical to secp_verify_one's (pub prefix + on
+ * curve, r < p with even-y lift, s < n); e = tagged challenge mod n */
+static int secp_decode_one(secp_sig *o, const u8 *pub33, const u8 *msg,
+                           u64 mlen, const u8 *sig) {
+    if (pub33[0] != 2 && pub33[0] != 3) return 0;
+    u64 xb[4];
+    u256_from_be(xb, pub33 + 1);
+    if (ge256(xb, SECP_P)) return 0;
+    fe256 x, y2, y, t;
+    fe_from_be(&x, pub33 + 1);
+    fe_sqr(&y2, &x);
+    fe_mul(&y2, &y2, &x);
+    fe256 seven = {{7, 0, 0, 0}};
+    fe_add(&y2, &y2, &seven);
+    fe_pow(&y, &y2, SECP_SQRT_E);
+    fe_sqr(&t, &y);
+    if (!fe_eq(&t, &y2)) return 0;
+    fe_normalize(&y);
+    if (y.v[0] & 1) {           /* even-y lift, then negate for the MSM */
+        /* odd y: lift is p - y, negation back to y — keep as is */
+    } else {
+        u64 py[4];
+        memcpy(py, SECP_P, sizeof(py));
+        sub256(py, y.v);
+        memcpy(y.v, py, sizeof(py));
+    }
+    o->nP.x = x; o->nP.y = y;
+    o->nP.z.v[0] = 1; o->nP.z.v[1] = o->nP.z.v[2] = o->nP.z.v[3] = 0;
+    o->nP.inf = 0;
+    /* r < p: even-y lift of the sig's R_x, negated */
+    u64 rb[4], sb[4];
+    u256_from_be(rb, sig);
+    u256_from_be(sb, sig + 32);
+    if (ge256(rb, SECP_P)) return 0;
+    if (ge256(sb, SECP_N)) return 0;
+    fe256 rx, ry2, ry;
+    fe_from_be(&rx, sig);
+    fe_sqr(&ry2, &rx);
+    fe_mul(&ry2, &ry2, &rx);
+    fe_add(&ry2, &ry2, &seven);
+    fe_pow(&ry, &ry2, SECP_SQRT_E);
+    fe_sqr(&t, &ry);
+    if (!fe_eq(&t, &ry2)) return 0;   /* r not an x-coordinate */
+    fe_normalize(&ry);
+    if (!(ry.v[0] & 1)) {             /* even lift -> negate to odd */
+        u64 py[4];
+        memcpy(py, SECP_P, sizeof(py));
+        sub256(py, ry.v);
+        memcpy(ry.v, py, sizeof(py));
+    }
+    o->nR.x = rx; o->nR.y = ry;
+    o->nR.z = o->nP.z; o->nR.inf = 0;
+    memcpy(o->s, sb, sizeof(sb));
+    /* challenge e = tagged_hash(r || px || sha256(msg)) mod n */
+    u8 m32[32], e32[32];
+    sha256_ctx hc;
+    sha256_init(&hc);
+    sha256_update(&hc, msg, mlen);
+    sha256_final(&hc, m32);
+    bip340_challenge(e32, sig, pub33 + 1, m32);
+    u64 eb[4];
+    u256_from_be(eb, e32);
+    scalar_mod_n(eb);
+    memcpy(o->e, eb, sizeof(eb));
+    return 1;
+}
+
+/* batch equation over sigs[idx[0..m)]; scratch arrays hold >= 2m+1 */
+static int secp_batch_check(const secp_sig *ss, const u64 *idx, u64 m,
+                            jpt *pts, u64 (*scs)[4]) {
+    u64 acc[8] = {0}, prod[6];
+    for (u64 i = 0; i < m; i++) {
+        mul_128x256(prod, ss[idx[i]].z, ss[idx[i]].s);
+        acc512_add(acc, prod);
+    }
+    u64 S[4];
+    mod_n_512(S, acc);
+    u64 cnt = 0;
+    pts[cnt].x.v[0] = 0;   /* G */
+    memcpy(pts[cnt].x.v, SECP_GX, 32);
+    memcpy(pts[cnt].y.v, SECP_GY, 32);
+    pts[cnt].z.v[0] = 1;
+    pts[cnt].z.v[1] = pts[cnt].z.v[2] = pts[cnt].z.v[3] = 0;
+    pts[cnt].inf = 0;
+    memcpy(scs[cnt], S, 32);
+    cnt++;
+    for (u64 i = 0; i < m; i++) {
+        const secp_sig *g = &ss[idx[i]];
+        pts[cnt] = g->nR;
+        memcpy(scs[cnt], g->z, 32);
+        cnt++;
+        u64 t8[8] = {0}, ze[4];
+        mul_128x256(t8, g->z, g->e);
+        mod_n_512(ze, t8);
+        pts[cnt] = g->nP;
+        memcpy(scs[cnt], ze, 32);
+        cnt++;
+    }
+    jpt T;
+    secp_msm(&T, pts, (const u64(*)[4])scs, cnt);
+    return T.inf;
+}
+
+static void secp_bisect(const secp_sig *ss, const u64 *idx, u64 m,
+                        u8 *out, jpt *pts, u64 (*scs)[4]) {
+    if (m == 0) return;
+    if (secp_batch_check(ss, idx, m, pts, scs)) {
+        for (u64 i = 0; i < m; i++) out[idx[i]] = 1;
+        return;
+    }
+    if (m == 1) { out[idx[0]] = 0; return; }
+    secp_bisect(ss, idx, m / 2, out, pts, scs);
+    secp_bisect(ss, idx + m / 2, m - m / 2, out, pts, scs);
+}
+
+EXPORT void tm_secp_verify_batch(const u8 *pubs33, const u8 *msgbuf,
+                                 const u64 *offsets, const u8 *sigs,
+                                 const u8 *seed32, u8 *out, u64 n) {
+    secp_sig *ss = malloc(n * sizeof(secp_sig));
+    u64 *idx = malloc(n * sizeof(u64));
+    u64 m = 0;
+    for (u64 i = 0; i < n; i++) {
+        out[i] = 0;
+        if (secp_decode_one(&ss[i], pubs33 + 33 * i, msgbuf + offsets[i],
+                            offsets[i + 1] - offsets[i], sigs + 64 * i)) {
+            derive_z(ss[i].z, seed32, m);
+            idx[m++] = i;
+        }
+    }
+    if (m) {
+        jpt *pts = malloc((2 * m + 1) * sizeof(jpt));
+        u64 (*scs)[4] = malloc((2 * m + 1) * sizeof(*scs));
+        secp_bisect(ss, idx, m, out, pts, scs);
+        free(pts);
+        free(scs);
+    }
+    free(ss);
+    free(idx);
+}
+
+/* ---------------------------------------------------- sr25519 batch */
+
+/* precomputed affine "niels" form for mixed Edwards addition (7M) */
+typedef struct { f25519 yplusx, yminusx, t2d; } nept;
+
+static void nept_from_ept(nept *r, const ept *p) {
+    /* p must be affine (z == 1) */
+    f25519_add(&r->yplusx, &p->y, &p->x);
+    f25519_sub(&r->yminusx, &p->y, &p->x);
+    f25519_mul(&r->t2d, &p->t, ed_d());
+    f25519_add(&r->t2d, &r->t2d, &r->t2d);
+}
+
+static void ept_add_niels(ept *r, const ept *p, const nept *q) {
+    f25519 a, b, c, d, e, f, g, h, t;
+    f25519_sub(&t, &p->y, &p->x);
+    f25519_mul(&a, &t, &q->yminusx);
+    f25519_add(&t, &p->y, &p->x);
+    f25519_mul(&b, &t, &q->yplusx);
+    f25519_mul(&c, &p->t, &q->t2d);
+    f25519_add(&d, &p->z, &p->z);
+    f25519_sub(&e, &b, &a);
+    f25519_sub(&f, &d, &c);
+    f25519_add(&g, &d, &c);
+    f25519_add(&h, &b, &a);
+    f25519_mul(&r->x, &e, &f);
+    f25519_mul(&r->y, &g, &h);
+    f25519_mul(&r->z, &f, &g);
+    f25519_mul(&r->t, &e, &h);
+}
+
+static void ept_msm(ept *out, const nept *pts, const u64 (*scs)[4],
+                    u64 m) {
+    int c = msm_window_bits(m);
+    int nw = (256 + c - 1) / c;
+    int nb = 1 << c;
+    ept *buckets = malloc((u64)nb * sizeof(ept));
+    u8 *used = malloc((u64)nb);
+    ept acc;
+    ept_identity(&acc);
+    for (int w = nw - 1; w >= 0; w--) {
+        for (int k = 0; k < c; k++) ept_dbl(&acc, &acc);
+        memset(used, 0, (u64)nb);
+        for (u64 i = 0; i < m; i++) {
+            int d = sc_digit(scs[i], w, c);
+            if (!d) continue;
+            if (!used[d]) { ept_identity(&buckets[d]); used[d] = 1; }
+            ept_add_niels(&buckets[d], &buckets[d], &pts[i]);
+        }
+        ept sum, tot;
+        ept_identity(&sum);
+        ept_identity(&tot);
+        for (int b = nb - 1; b >= 1; b--) {
+            if (used[b]) ept_add(&sum, &sum, &buckets[b]);
+            ept_add(&tot, &tot, &sum);
+        }
+        ept_add(&acc, &acc, &tot);
+    }
+    free(buckets);
+    free(used);
+    *out = acc;
+}
+
+typedef struct {
+    nept nR, nA;          /* decoded R and pubkey, NEGATED, niels form */
+    u64 c[4], s[4], z[4]; /* challenge mod l, s, random weight */
+} sr_sig;
+
+static void le_load4(u64 v[4], const u8 *b) {
+    for (int i = 0; i < 4; i++) {
+        v[i] = 0;
+        for (int j = 7; j >= 0; j--) v[i] = (v[i] << 8) | b[8 * i + j];
+    }
+}
+
+/* 384-bit product z*c -> mod l via staging.c's wide reduction */
+static void mod_l_prod(u64 out[4], const u64 z[2], const u64 c[4]) {
+    u64 prod[6];
+    mul_128x256(prod, z, c);
+    u8 wide[64], r32[32];
+    memset(wide, 0, sizeof(wide));
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++) wide[8 * i + j] = (u8)(prod[i] >> (8 * j));
+    tm_mod_l(wide, r32, 1);
+    le_load4(out, r32);
+}
+
+static void ept_negate(ept *p) {
+    f25519_neg(&p->x, &p->x);
+    f25519_neg(&p->t, &p->t);
+}
+
+static int sr_decode_one(sr_sig *o, const u8 *pub32, const u8 *msg,
+                         u64 mlen, const u8 *sig) {
+    if (!(sig[63] & 0x80)) return 0;
+    ept A, R;
+    if (!ristretto_decode(&A, pub32)) return 0;
+    if (!ristretto_decode(&R, sig)) return 0;
+    u8 s_bytes[32];
+    memcpy(s_bytes, sig + 32, 32);
+    s_bytes[31] &= 0x7F;
+    if (!scalar_lt_l(s_bytes)) return 0;
+    le_load4(o->s, s_bytes);
+    u8 wide[64], k32[32];
+    sr25519_challenge(wide, pub32, sig, msg, mlen);
+    tm_mod_l(wide, k32, 1);
+    le_load4(o->c, k32);
+    ept_negate(&A);
+    ept_negate(&R);
+    nept_from_ept(&o->nA, &A);
+    nept_from_ept(&o->nR, &R);
+    return 1;
+}
+
+/* T in E[4] <=> x(T) == 0 or y(T) == 0 (the ristretto identity class;
+ * decoded representatives may carry 4-torsion, and z_i-weighted sums of
+ * E[4] elements stay in E[4], so this is the exact batch analogue of
+ * ristretto_eq(R', R)) */
+static int ept_in_e4(const ept *t) {
+    f25519 zero = {{0}};
+    return f25519_eq(&t->x, &zero) || f25519_eq(&t->y, &zero);
+}
+
+static int sr_batch_check(const sr_sig *ss, const u64 *idx, u64 m,
+                          nept *pts, u64 (*scs)[4]) {
+    u64 acc[8] = {0}, prod[6];
+    for (u64 i = 0; i < m; i++) {
+        mul_128x256(prod, ss[idx[i]].z, ss[idx[i]].s);
+        acc512_add(acc, prod);
+    }
+    u8 wide[64], r32[32];
+    memset(wide, 0, sizeof(wide));
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++) wide[8 * i + j] = (u8)(acc[i] >> (8 * j));
+    tm_mod_l(wide, r32, 1);
+    u64 S[4];
+    le_load4(S, r32);
+    u64 cnt = 0;
+    ept B;
+    f25519_from_le(&B.x, BX_BYTES);
+    f25519_from_le(&B.y, BY_BYTES);
+    memset(&B.z, 0, sizeof(B.z));
+    B.z.v[0] = 1;
+    f25519_mul(&B.t, &B.x, &B.y);
+    nept_from_ept(&pts[cnt], &B);
+    memcpy(scs[cnt], S, 32);
+    cnt++;
+    for (u64 i = 0; i < m; i++) {
+        const sr_sig *g = &ss[idx[i]];
+        pts[cnt] = g->nR;
+        memcpy(scs[cnt], g->z, 32);
+        cnt++;
+        u64 zc[4];
+        mod_l_prod(zc, g->z, g->c);
+        pts[cnt] = g->nA;
+        memcpy(scs[cnt], zc, 32);
+        cnt++;
+    }
+    ept T;
+    ept_msm(&T, pts, (const u64(*)[4])scs, cnt);
+    return ept_in_e4(&T);
+}
+
+static void sr_bisect(const sr_sig *ss, const u64 *idx, u64 m, u8 *out,
+                      nept *pts, u64 (*scs)[4]) {
+    if (m == 0) return;
+    if (sr_batch_check(ss, idx, m, pts, scs)) {
+        for (u64 i = 0; i < m; i++) out[idx[i]] = 1;
+        return;
+    }
+    if (m == 1) { out[idx[0]] = 0; return; }
+    sr_bisect(ss, idx, m / 2, out, pts, scs);
+    sr_bisect(ss, idx + m / 2, m - m / 2, out, pts, scs);
+}
+
+EXPORT void tm_sr25519_verify_batch(const u8 *pubs32, const u8 *msgbuf,
+                                    const u64 *offsets, const u8 *sigs,
+                                    const u8 *seed32, u8 *out, u64 n) {
+    sr_sig *ss = malloc(n * sizeof(sr_sig));
+    u64 *idx = malloc(n * sizeof(u64));
+    u64 m = 0;
+    for (u64 i = 0; i < n; i++) {
+        out[i] = 0;
+        if (sr_decode_one(&ss[i], pubs32 + 32 * i, msgbuf + offsets[i],
+                          offsets[i + 1] - offsets[i], sigs + 64 * i)) {
+            derive_z(ss[i].z, seed32, m);
+            idx[m++] = i;
+        }
+    }
+    if (m) {
+        nept *pts = malloc((2 * m + 1) * sizeof(nept));
+        u64 (*scs)[4] = malloc((2 * m + 1) * sizeof(*scs));
+        sr_bisect(ss, idx, m, out, pts, scs);
+        free(pts);
+        free(scs);
+    }
+    free(ss);
+    free(idx);
 }

@@ -43,6 +43,94 @@ def test_differential_vs_python(scheme, fn):
     assert list(got) == want
 
 
+def _secp_adversarial_cases():
+    """Structured invalid encodings: any C-vs-Python divergence here is
+    consensus-relevant (ADVICE r3 #4).  BIP-340: sig = R_x(32,BE)||s(32,BE),
+    pubkey = 33-byte compressed."""
+    k = secp.PrivKey.gen_from_secret(b"\x77" * 32)
+    pub = k.pub_key().bytes()
+    m = b"structured secp"
+    good = k.sign(m)
+    r_good, s_good = good[:32], good[32:]
+
+    def be(x):
+        return x.to_bytes(32, "big")
+
+    # an x-coordinate with no curve point: x^3+7 a quadratic non-residue
+    x = 5
+    while pow((pow(x, 3, secp.P) + 7) % secp.P,
+              (secp.P - 1) // 2, secp.P) == 1:
+        x += 1
+    off_curve_x = be(x)
+
+    cases = [
+        (pub, m, r_good + be(secp.N)),           # s == group order
+        (pub, m, r_good + be(secp.N + 1)),       # s > group order
+        (pub, m, be(secp.P) + s_good),           # r == field prime
+        (pub, m, be(secp.P + 1) + s_good),       # r > field prime
+        (pub, m, off_curve_x + s_good),          # R_x off curve
+        (pub, m, b"\x00" * 64),                  # all-zero signature
+        (pub, m, r_good + be(0)),                # s == 0
+        (b"\x02" + be(secp.P), m, good),         # pubkey x >= p
+        (b"\x02" + off_curve_x, m, good),        # pubkey off curve
+        (b"\x04" + pub[1:], m, good),            # bad parity byte
+        (pub, m, good),                          # control: valid
+    ]
+    return cases
+
+
+def _sr_adversarial_cases():
+    """sr25519/schnorrkel: sig = R(32 ristretto)||s(32,LE, bit255 set as
+    the schnorrkel marker), pubkey = 32-byte ristretto point."""
+    k = sr.PrivKey(b"\x66" * 32)
+    pub = k.pub_key().bytes()
+    m = b"structured sr"
+    good = k.sign(m)
+    r_good, s_good = good[:32], good[32:]
+    L = 2**252 + 27742317777372353535851937790883648493
+
+    def le_marked(x, marker=True):
+        b = bytearray(x.to_bytes(32, "little"))
+        if marker:
+            b[31] |= 0x80
+        return bytes(b)
+
+    cases = [
+        (pub, m, r_good + bytes(s_good[:31]) + bytes([s_good[31] & 0x7F])),
+        # ^ marker bit cleared (schnorrkel rejects pre-marker encodings)
+        (pub, m, r_good + le_marked(L)),         # s == group order
+        (pub, m, r_good + le_marked(L + 5)),     # s > group order
+        (pub, m, b"\x00" * 32 + s_good),         # R = identity (low order)
+        (pub, m, b"\xFF" * 32 + s_good),         # R non-canonical encoding
+        (pub, m, b"\x00" * 64),                  # all-zero signature
+        (pub, m, r_good + le_marked(0)),         # s == 0 (with marker)
+        (b"\x00" * 32, m, good),                 # identity pubkey
+        (b"\xFF" * 32, m, good),                 # non-canonical pubkey
+        (pub, m, good),                          # control: valid
+    ]
+    return cases
+
+
+@pytest.mark.parametrize("cases_fn,fn", [
+    (_secp_adversarial_cases, native.secp_verify),
+    (_sr_adversarial_cases, native.sr25519_verify)])
+def test_differential_structured_adversarial(cases_fn, fn):
+    cases = cases_fn()
+    pubs = [c[0] for c in cases]
+    msgs = [c[1] for c in cases]
+    sigs = [c[2] for c in cases]
+    # Python lane verdicts (via the PubKey wrapper when the blob parses,
+    # else the raw verify function must reject)
+    mod = secp if fn is native.secp_verify else sr
+    want = [mod.PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)]
+    assert want[-1] is True          # the control case
+    assert not any(want[:-1])        # every structured case invalid
+    got = fn(pubs, msgs, sigs)
+    assert got is not None
+    assert [bool(b) for b in got] == want
+
+
 def test_batch_verifier_routes_host_schemes_through_native():
     from tendermint_tpu.crypto.batch import BatchVerifier, verified_sigs
 
