@@ -61,6 +61,19 @@ class KVStoreApplication(abci.Application):
 
     def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
         self.val_updates = []
+        # record misbehavior into app state (reference e2e app does the
+        # same so tests can assert evidence reached the app); the write
+        # is derived from the committed block, so it is deterministic
+        # across nodes and safe to fold into app_hash
+        for m in req.byzantine_validators:
+            addr = getattr(m, "validator_address", b"") or b""
+            # the type is part of the key: a duplicate-vote and a
+            # light-attack record against the same (height, validator)
+            # must not overwrite each other
+            key = b"misbehavior/%d/%d/%s" % (getattr(m, "height", 0),
+                                             getattr(m, "type", 0),
+                                             addr.hex().encode())
+            self.data[key] = b"%d" % getattr(m, "type", 0)
         return abci.ResponseBeginBlock()
 
     def deliver_tx(self, tx: bytes) -> abci.ResponseDeliverTx:

@@ -207,6 +207,10 @@ class RequestProcessProposal:
     # carries hash/height/time/... instead of a Header)
     hash: bytes = b""
     height: int = 0
+    time_seconds: int = 0
+    time_nanos: int = 0
+    next_validators_hash: bytes = b""
+    proposer_address: bytes = b""
 
 
 @dataclass

@@ -20,6 +20,9 @@ class NodeManifest:
     start_at: int = 0              # launch once the net reaches this height
     perturb: List[str] = field(default_factory=list)  # kill|pause|restart
     power: int = 10                # validator voting power
+    # per-node config overrides (0 = keep the net-wide/config default)
+    mempool_size: int = 0          # [mempool] size for this node
+    timeout_commit: float = 0.0    # [consensus] timeout_commit override
 
 
 @dataclass
@@ -37,6 +40,11 @@ class Manifest:
     timeout_propose: float = 0.4
     timeout_commit: float = 0.3
     wait_height: int = 8           # the `wait` stage's minimum height
+    # inject this many evidence items into the RUNNING net (alternating
+    # duplicate-vote / light-client-attack) and assert they commit and
+    # reach the app as Misbehavior (reference test/e2e/pkg/manifest.go
+    # Evidence + runner/evidence.go InjectEvidence)
+    evidence: int = 0
 
     def validators(self) -> List[NodeManifest]:
         return [n for n in self.nodes if n.mode == "validator"]
@@ -81,6 +89,8 @@ def manifest_from_dict(d: Dict) -> Manifest:
             setattr(m, key, float(d[key]))
     if "wait_height" in d:
         m.wait_height = int(d["wait_height"])
+    if "evidence" in d:
+        m.evidence = int(d["evidence"])
     for name, nd in (d.get("node") or {}).items():
         m.nodes.append(NodeManifest(
             name=name,
@@ -90,7 +100,9 @@ def manifest_from_dict(d: Dict) -> Manifest:
             state_sync=bool(nd.get("state_sync", False)),
             start_at=int(nd.get("start_at", 0)),
             perturb=list(nd.get("perturb", [])),
-            power=int(nd.get("power", 10))))
+            power=int(nd.get("power", 10)),
+            mempool_size=int(nd.get("mempool_size", 0)),
+            timeout_commit=float(nd.get("timeout_commit", 0.0))))
     ld = d.get("load") or {}
     m.load = LoadManifest(rate=float(ld.get("rate", 2.0)),
                           total=int(ld.get("total", 20)))

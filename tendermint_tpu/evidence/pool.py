@@ -27,6 +27,8 @@ class EvidencePool:
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("evidence")
         self._mtx = threading.Lock()
         self.state = state_store.load() if state_store is not None else None
         # votes reported by consensus before the evidence could be formed
@@ -48,6 +50,8 @@ class EvidencePool:
             ev.validate_basic()
             self._verify(ev)
             self.db.set(_key(_PENDING, ev), safe_codec.dumps(ev))
+        self.log.info("verified new evidence of byzantine behavior",
+                      evidence=type(ev).__name__, height=ev.height())
         for cb in list(self.on_new_evidence):
             try:
                 cb(ev)

@@ -43,10 +43,13 @@ wire.register_codec(EVIDENCE_CHANNEL, encode_msg, decode_msg)
 
 
 class EvidenceReactor(Reactor):
+    """BaseService lifecycle via Reactor (reference evidence/reactor.go)."""
+
     def __init__(self, pool: EvidencePool):
         super().__init__("EVIDENCE")
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("evidence")
         self.pool = pool
-        self._stop = threading.Event()
         self._sent: dict = {}  # peer_id -> set of evidence hashes sent
         # new pending evidence pushes to every peer immediately; the
         # timed rebroadcast remains the retry for dropped sends
@@ -54,26 +57,27 @@ class EvidenceReactor(Reactor):
 
     def _push_all(self):
         sw = self.switch
-        if sw is None or self._stop.is_set():
+        if sw is None or self.quitting.is_set():
             return
         for peer in list(sw.peers.values()):
             self._send_pending(peer)
 
-    def start(self):
-        threading.Thread(target=self._broadcast_routine, daemon=True).start()
-
-    def stop(self):
-        self._stop.set()
+    def on_start(self):
+        """Reference evidence/reactor.go OnStart; started by the Switch."""
+        self.spawn(self._broadcast_routine, name="evidence-bcast")
 
     def get_channels(self):
         return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6,
                                   send_queue_capacity=100)]
 
     def add_peer(self, peer: Peer):
+        self.log.debug("peer added", peer=peer.id)
         self._sent[peer.id] = set()
         self._send_pending(peer)
 
     def remove_peer(self, peer: Peer, reason):
+        self.log.debug("peer removed", peer=peer.id,
+                       reason=str(reason) if reason else "")
         self._sent.pop(peer.id, None)
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
@@ -85,28 +89,51 @@ class EvidenceReactor(Reactor):
             except EvidenceError as e:
                 # provably invalid evidence: punish the peer (reference
                 # reactor.go); the remaining items die with the peer
+                self.log.error("invalid evidence from peer",
+                               peer=peer.id, err=str(e))
                 sw = self.switch
                 if sw is not None:
                     sw.stop_peer_for_error(peer, f"bad evidence: {e}")
                 return
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 # undecodable/unverifiable item (e.g. missing state):
                 # drop IT, keep processing the rest of the batch
+                self.log.error("dropping unprocessable evidence item",
+                               peer=peer.id, err=str(e))
                 continue
 
     def _send_pending(self, peer: Peer):
+        """Reference evidence/reactor.go:165-184 prepareEvidenceMessage:
+        an item goes out only once the peer's consensus height (gossiped
+        by the consensus reactor into peer.data["height"], the analogue
+        of the reference's PeerStateKey) has reached the evidence height
+        — a syncing peer cannot verify future-height evidence and would
+        have to buffer or wrongly reject it.  A peer already past the
+        age window is skipped for that item (the pool prunes expired
+        evidence itself).  Held-back items stay unmarked and retry on
+        the next broadcast tick."""
         sent = self._sent.get(peer.id, set())
-        fresh = [(ev.hash(), evidence_proto(ev))
-                 for ev in self.pool.pending_evidence()
-                 if ev.hash() not in sent]
+        peer_h = peer.data.get("height")
+        state = self.pool.state
+        max_age = (state.consensus_params.evidence.max_age_num_blocks
+                   if state is not None else None)
+        fresh = []
+        for ev in self.pool.pending_evidence():
+            if ev.hash() in sent:
+                continue
+            if peer_h is None or peer_h < ev.height():
+                continue  # peer behind: wait for it to catch up
+            if max_age is not None and peer_h - ev.height() > max_age:
+                continue  # peer far past the window
+            fresh.append((ev.hash(), evidence_proto(ev)))
         if fresh and peer.try_send(
                 EVIDENCE_CHANNEL, EvidenceGossip([p for _, p in fresh])):
             sent.update(h for h, _ in fresh)
 
     def _broadcast_routine(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             sw = self.switch
             if sw is not None:
                 for peer in list(sw.peers.values()):
                     self._send_pending(peer)
-            self._stop.wait(BROADCAST_INTERVAL_S)
+            self.quitting.wait(BROADCAST_INTERVAL_S)

@@ -223,7 +223,8 @@ class BlockExecutor:
             hash=block.hash() or b"",
             header_proto=block.header.proto(),
             last_commit_votes=last_commit_votes,
-            byzantine_validators=list(block.evidence)))
+            byzantine_validators=[
+                m for ev in block.evidence for m in ev.abci()]))
         dtxs = [self.app.deliver_tx(tx) for tx in block.data.txs]
         reb = self.app.end_block(block.header.height)
         return ABCIResponses(deliver_txs=dtxs, end_block=reb,

@@ -32,6 +32,11 @@ class Evidence:
     def height(self) -> int:
         raise NotImplementedError
 
+    def abci(self) -> list:
+        """This evidence as abci.Misbehavior records for BeginBlock
+        (reference types/evidence.go ABCI())."""
+        raise NotImplementedError
+
     def time(self) -> Timestamp:
         raise NotImplementedError
 
@@ -83,6 +88,16 @@ class DuplicateVoteEvidence(Evidence):
 
     def time(self) -> Timestamp:
         return self.timestamp
+
+    def abci(self) -> list:
+        from tendermint_tpu.abci.types import Misbehavior
+        return [Misbehavior(
+            type=1, validator_address=self.vote_a.validator_address,
+            validator_power=self.validator_power,
+            height=self.height(),
+            time_seconds=self.timestamp.seconds,
+            time_nanos=self.timestamp.nanos,
+            total_voting_power=self.total_voting_power)]
 
     def body_proto(self) -> bytes:
         return (pe.message_field_always(1, self.vote_a.proto())
@@ -137,6 +152,17 @@ class LightClientAttackEvidence(Evidence):
 
     def time(self) -> Timestamp:
         return self.timestamp
+
+    def abci(self) -> list:
+        from tendermint_tpu.abci.types import Misbehavior
+        return [Misbehavior(
+            type=2, validator_address=v.address,
+            validator_power=v.voting_power,
+            height=self.height(),
+            time_seconds=self.timestamp.seconds,
+            time_nanos=self.timestamp.nanos,
+            total_voting_power=self.total_voting_power)
+            for v in self.byzantine_validators]
 
     def body_proto(self) -> bytes:
         return (pe.message_field_always(1, self.conflicting_block.proto())

@@ -17,6 +17,7 @@ def test_manifest_testnet_with_perturbations(tmp_path):
         "timeout_propose": 0.4,
         "timeout_commit": 0.25,
         "wait_height": 8,
+        "evidence": 2,
         "node": {
             "validator0": {"perturb": ["kill"],
                            "app": "kvstore@snapshots=4"},

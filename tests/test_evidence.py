@@ -202,3 +202,52 @@ def test_light_attack_evidence_validate_basic():
     bad.common_height = 9
     with pytest.raises(EvidenceError):
         bad.validate_basic()
+
+
+def test_reactor_gates_evidence_on_peer_height():
+    """Reference evidence/reactor.go:165-184: evidence is held back from
+    a peer whose consensus height is below the evidence height, sent
+    once it catches up, and skipped for a peer far past the age window
+    (VERDICT r3 #6)."""
+    from tendermint_tpu.evidence.reactor import EvidenceReactor
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+            self.data = {}
+            self.got = []
+
+        def try_send(self, ch, msg):
+            self.got.append(msg)
+            return True
+
+    gdoc, privs = make_genesis(4)
+    blocks, commits, _ = build_chain(gdoc, privs, 8)
+    ex, state_store, block_store, state = _synced_node(gdoc, blocks, commits)
+    pool = EvidencePool(MemDB(), state_store, block_store)
+    bt = block_store.load_block_meta(5).header.time
+    v1, v2 = _dup_votes(privs[1])
+    vals = state_store.load_validators(5)
+    pool.add_evidence(DuplicateVoteEvidence.from_votes(v1, v2, bt, vals))
+
+    reactor = EvidenceReactor(pool)
+    peer = FakePeer("behind")
+    reactor.add_peer(peer)
+    assert not peer.got            # no height known yet: held back
+    peer.data["height"] = 3        # still below the ev height (5)
+    reactor._send_pending(peer)
+    assert not peer.got
+    peer.data["height"] = 6        # caught up past the evidence height
+    reactor._send_pending(peer)
+    assert len(peer.got) == 1 and len(peer.got[0].evidence_protos) == 1
+    # already-sent items are not resent
+    reactor._send_pending(peer)
+    assert len(peer.got) == 1
+
+    # a peer far past the age window never receives the item
+    far = FakePeer("far-ahead")
+    far.data["height"] = (5 + 1
+                          + state.consensus_params.evidence
+                          .max_age_num_blocks)
+    reactor._send_pending(far)
+    assert not far.got
