@@ -105,6 +105,8 @@ wire.register_codec(BLOCKSYNC_CHANNEL, encode_msg, decode_msg)
 
 
 class BlocksyncReactor(Reactor):
+    """BaseService lifecycle via Reactor (reference blocksync/reactor.go)."""
+
     def __init__(self, executor, store, state, fast_sync: bool = True,
                  window: int = 32,
                  on_caught_up: Optional[Callable] = None):
@@ -123,8 +125,8 @@ class BlocksyncReactor(Reactor):
         self.blocks_synced = 0
         self.pool = BlockPool(state.last_block_height + 1,
                               self._send_request, self._peer_error)
-        self._stop = threading.Event()
         self._switched = False
+        self._active = False
         # self-reported sync rate, EMA logged every 100 blocks
         # (reference blocksync/reactor.go:416-421)
         self._rate_t0 = time.monotonic()
@@ -133,23 +135,37 @@ class BlocksyncReactor(Reactor):
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self):
+    def on_start(self):
+        """Started by the Switch with the other reactors; the sync
+        routines only run when fast-syncing (reference reactor.go:103
+        OnStart gates on fastSync)."""
         if self.fast_sync:
-            self.pool.start()
-            threading.Thread(target=self._sync_routine, daemon=True).start()
-            threading.Thread(target=self._status_routine, daemon=True).start()
+            self.activate()
+
+    def activate(self):
+        """Begin the sync routines — at start when fast_sync, or later
+        when statesync hands off (node.go:993 startStateSync ->
+        SwitchToBlockSync).  Idempotent: the handoff path calls it on a
+        reactor the Switch already started with fast_sync unset."""
+        if self._active:
+            return
+        self._active = True
+        self.fast_sync = True
+        self.pool.start()
+        self.spawn(self._sync_routine, name="blocksync-sync")
+        self.spawn(self._status_routine, name="blocksync-status")
 
     def switch_to_blocksync(self, state):
         """Adopt a statesync-bootstrapped state and sync the tail from it
         (reference blocksync/reactor.go:110 SwitchToBlockSync: resets the
-        pool to state.LastBlockHeight+1).  Must be called before start()."""
+        pool to state.LastBlockHeight+1).  Must be called before
+        activate()."""
         self.state = state
         self.fast_sync = True
         self.pool = BlockPool(state.last_block_height + 1,
                               self._send_request, self._peer_error)
 
-    def stop(self):
-        self._stop.set()
+    def on_stop(self):
         self.pool.stop()
 
     def get_channels(self):
@@ -207,14 +223,14 @@ class BlocksyncReactor(Reactor):
     # -- sync loop (reference reactor.go:255 poolRoutine) ------------------
 
     def _status_routine(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             if self.switch is not None:
                 self.switch.broadcast(BLOCKSYNC_CHANNEL, StatusRequest())
-            self._stop.wait(STATUS_UPDATE_INTERVAL_S)
+            self.quitting.wait(STATUS_UPDATE_INTERVAL_S)
 
     def _sync_routine(self):
         last_switch_check = 0.0
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             now = time.monotonic()
             if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
                 last_switch_check = now
@@ -230,7 +246,7 @@ class BlocksyncReactor(Reactor):
                 # the sync thread must survive anything a peer can trigger
                 progressed = False
             if not progressed:
-                self._stop.wait(TRY_SYNC_INTERVAL_S)
+                self.quitting.wait(TRY_SYNC_INTERVAL_S)
 
     def try_sync(self) -> bool:
         """One window: verify+apply all ready blocks (minus the last, whose

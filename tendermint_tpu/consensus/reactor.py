@@ -69,6 +69,8 @@ class _PeerState:
 
 
 class ConsensusReactor(Reactor):
+    """BaseService lifecycle via Reactor (reference consensus/reactor.go)."""
+
     def __init__(self, cs: ConsensusState):
         super().__init__("CONSENSUS")
         self.cs = cs
@@ -76,25 +78,28 @@ class ConsensusReactor(Reactor):
         self._catchup_sent: Dict[str, tuple] = {}  # peer -> (height, time)
         self._data_resend: Dict[str, tuple] = {}  # peer -> ((h, r), time)
         self._lock = threading.Lock()
-        self._stop = threading.Event()
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("consensus")
 
         cs.broadcast_vote.append(self._on_new_vote)
         cs.broadcast_proposal.append(self._on_new_proposal)
         cs.broadcast_block_part.append(self._on_new_part)
         if cs.event_bus is not None:
             self._sub = cs.event_bus.subscribe("NewRoundStep")
-            threading.Thread(target=self._step_broadcaster,
-                             daemon=True).start()
             # every vote the state machine ADDS (own or peer) is announced
             # so peers can subtract it from their gossip (reference
             # broadcastHasVoteMessage, consensus/state.go:2124)
             self._vote_sub = cs.event_bus.subscribe("Vote")
-            threading.Thread(target=self._has_vote_broadcaster,
-                             daemon=True).start()
-        threading.Thread(target=self._catchup_routine, daemon=True).start()
 
-    def stop(self):
-        self._stop.set()
+    def on_start(self):
+        """Reference consensus/reactor.go:77 OnStart: the gossip
+        routines; the Switch starts us with the other reactors."""
+        if self.cs.event_bus is not None:
+            self.spawn(self._step_broadcaster, name="cons-step-bcast")
+            self.spawn(self._has_vote_broadcaster, name="cons-hasvote")
+        self.spawn(self._catchup_routine, name="cons-catchup")
+
+    def on_stop(self):
         bus = self.cs.event_bus
         if bus is not None:
             for attr in ("_sub", "_vote_sub"):
@@ -124,7 +129,7 @@ class ConsensusReactor(Reactor):
             self.switch.broadcast(VOTE_CHANNEL, VoteGossip(vote))
 
     def _has_vote_broadcaster(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 ev = self._vote_sub.queue.get(timeout=0.2)
             except Exception:  # queue.Empty
@@ -146,7 +151,7 @@ class ConsensusReactor(Reactor):
                                   BlockPartGossip(height, round_, part))
 
     def _step_broadcaster(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 self._sub.queue.get(timeout=0.2)
             except Exception:  # queue.Empty
@@ -157,9 +162,12 @@ class ConsensusReactor(Reactor):
     # -- peer lifecycle ----------------------------------------------------
 
     def add_peer(self, peer: Peer):
+        self.log.debug("peer added", peer=peer.id)
         peer.send(STATE_CHANNEL, self._round_step_msg())
 
     def remove_peer(self, peer: Peer, reason):
+        self.log.debug("peer removed", peer=peer.id,
+                       reason=str(reason) if reason else "")
         with self._lock:
             self._peer_state.pop(peer.id, None)
             self._catchup_sent.pop(peer.id, None)
@@ -179,6 +187,10 @@ class ConsensusReactor(Reactor):
                         self._peer_state[peer.id] = _PeerState(msg)
                     else:
                         ps.apply_step(msg)
+                # published for other reactors (the evidence reactor's
+                # peer-height gate) — the analogue of the reference's
+                # peer.Set(types.PeerStateKey, ...) consensus height
+                peer.data["height"] = msg.height
             elif isinstance(msg, HasVoteMessage):
                 size = self._vote_set_size(msg.height)
                 with self._lock:
@@ -311,7 +323,7 @@ class ConsensusReactor(Reactor):
     def _catchup_routine(self):
         rng = random.Random()
         last_maj23 = 0.0
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             time.sleep(0.1)
             if self.switch is None:
                 continue

@@ -27,6 +27,7 @@ from typing import Callable, List, Optional
 
 from tendermint_tpu.crypto.batch import BatchVerifier
 from tendermint_tpu.libs.fail import fail_point
+from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State as SMState
 from tendermint_tpu.types.basic import (
@@ -49,11 +50,12 @@ from .ticker import TimeoutTicker
 from .wal import WAL, EndHeightMessage, WALCorruptionError
 
 
-class ConsensusState:
+class ConsensusState(BaseService):
     def __init__(self, config: ConsensusConfig, state: SMState,
                  block_exec: BlockExecutor, block_store, mempool=None,
                  evidence_pool=None, priv_validator=None, wal_path=None,
                  event_bus=None, name: str = "", metrics_registry=None):
+        super().__init__(name or "consensus")
         from tendermint_tpu.libs.metrics import ConsensusMetrics
         self.config = config
         self.metrics = ConsensusMetrics(metrics_registry)
@@ -67,7 +69,7 @@ class ConsensusState:
         self.priv_pub_key = (priv_validator.get_pub_key()
                              if priv_validator else None)
         self.event_bus = event_bus
-        self.name = name
+        self.name = name or "consensus"
         from tendermint_tpu.libs import log as tmlog
         self.log = tmlog.logger("consensus").with_(node=name) if name \
             else tmlog.logger("consensus")
@@ -79,7 +81,6 @@ class ConsensusState:
         self._internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
         self._ticker = TimeoutTicker(self._on_ticker_timeout)
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
         self._mtx = threading.RLock()
 
         self.wal = WAL(wal_path) if wal_path else None
@@ -138,7 +139,7 @@ class ConsensusState:
         if self.wal is not None:
             self.wal.write_sync(EndHeightMessage(state.last_block_height))
 
-    def start(self):
+    def on_start(self):
         if self.wal is not None:
             try:
                 self._catchup_replay()
@@ -153,15 +154,11 @@ class ConsensusState:
                 # block, so there is nothing left to replay)
                 self.log.info("catchup replay error, proceeding to "
                               "start state anyway", err=str(e))
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._receive_routine,
-                                        name=f"consensus-{self.name}",
-                                        daemon=True)
-        self._thread.start()
+        self._thread = self.spawn(self._receive_routine,
+                                  name=f"consensus-{self.name}")
         self._schedule_round0()
 
-    def stop(self):
-        self._stop.set()
+    def on_stop(self):
         self._ticker.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -203,7 +200,7 @@ class ConsensusState:
     BATCH_MIN_VOTES = 8
 
     def _receive_routine(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 batch = []  # [(msg, peer_id)] in arrival order
                 # prioritize internal messages (own votes/proposals)
@@ -231,7 +228,7 @@ class ConsensusState:
                 traceback.print_exc()
                 # reference panics with "CONSENSUS FAILURE!!!"
                 # (consensus/state.go:735): safety over availability.
-                self._stop.set()
+                self.quitting.set()
                 return
 
     def _preverify_votes(self, batch):

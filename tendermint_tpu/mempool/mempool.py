@@ -56,14 +56,19 @@ class TxCache:
 class Mempool:
     def __init__(self, app: abci.Application, max_tx_bytes: int = 1048576,
                  size_limit: int = 5000, keep_invalid_txs_in_cache=False,
-                 registry=None):
+                 registry=None, max_txs_bytes: int = 1 << 30,
+                 cache_size: int = DEFAULT_CACHE_SIZE):
         self.app = app
         self.max_tx_bytes = max_tx_bytes
         self.size_limit = size_limit
+        self.max_txs_bytes = max_txs_bytes
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("mempool")
         from tendermint_tpu.libs.metrics import MempoolMetrics
         self.metrics = MempoolMetrics(registry)
-        self.cache = TxCache()
+        self.cache = TxCache(cache_size)
+        self._total_bytes = 0
         self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
         self._lock = threading.RLock()
         self._height = 0
@@ -90,8 +95,12 @@ class Mempool:
             return abci.ResponseCheckTx(code=1, log="tx already in cache")
         admitted = False
         with self._lock:
-            if len(self._txs) >= self.size_limit:
+            if len(self._txs) >= self.size_limit or \
+                    self._total_bytes + len(tx) > self.max_txs_bytes:
                 self.cache.remove(tx)
+                self.log.debug("mempool full, rejecting tx",
+                               size=len(self._txs),
+                               bytes=self._total_bytes)
                 return abci.ResponseCheckTx(code=1, log="mempool is full")
             res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
             if res.is_ok():
@@ -99,6 +108,7 @@ class Mempool:
                 if key not in self._txs:
                     self._txs[key] = MempoolTx(tx, self._height,
                                                res.gas_wanted)
+                    self._total_bytes += len(tx)
                 admitted = True
             elif not self.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
@@ -155,7 +165,9 @@ class Mempool:
         self._height = height
         for tx in committed_txs:
             self.cache.push(tx)  # committed: never re-admit
-            self._txs.pop(tx_hash(tx), None)
+            mt = self._txs.pop(tx_hash(tx), None)
+            if mt is not None:
+                self._total_bytes -= len(mt.tx)
         self._recheck()
 
     def _recheck(self):
@@ -168,6 +180,7 @@ class Mempool:
                 dead.append(key)
         for key in dead:
             mt = self._txs.pop(key)
+            self._total_bytes -= len(mt.tx)
             if not self.keep_invalid_txs_in_cache:
                 self.cache.remove(mt.tx)
         self.metrics.size.set(len(self._txs))
@@ -175,4 +188,5 @@ class Mempool:
     def flush(self):
         with self._lock:
             self._txs.clear()
+            self._total_bytes = 0
             self.cache.reset()

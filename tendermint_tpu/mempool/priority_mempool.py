@@ -42,7 +42,8 @@ class PriorityMempool:
 
     def __init__(self, app: abci.Application, max_tx_bytes: int = 1048576,
                  size_limit: int = 5000, max_total_bytes: int = 64 << 20,
-                 keep_invalid_txs_in_cache: bool = False, registry=None):
+                 keep_invalid_txs_in_cache: bool = False, registry=None,
+                 cache_size: int = 10000):
         from tendermint_tpu.libs.metrics import MempoolMetrics
         self.metrics = MempoolMetrics(registry)
         self.app = app
@@ -50,7 +51,7 @@ class PriorityMempool:
         self.size_limit = size_limit
         self.max_total_bytes = max_total_bytes
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
-        self.cache = TxCache()
+        self.cache = TxCache(cache_size)
         self._txs: Dict[bytes, _WrappedTx] = {}
         self._by_sender: Dict[str, bytes] = {}
         self._bytes = 0

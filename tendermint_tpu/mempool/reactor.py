@@ -42,26 +42,33 @@ wire.register_codec(MEMPOOL_CHANNEL, encode_msg, decode_msg)
 
 
 class MempoolReactor(Reactor):
+    """BaseService lifecycle via Reactor (reference mempool/reactor.go)."""
+
     def __init__(self, mempool: Mempool):
         super().__init__("MEMPOOL")
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("mempool")
         self.mempool = mempool
         self._peer_sent: Dict[str, set] = {}  # peer -> sent tx hashes
         self._lock = threading.Lock()
-        self._stop = threading.Event()
-        threading.Thread(target=self._broadcast_routine, daemon=True).start()
 
-    def stop(self):
-        self._stop.set()
+    def on_start(self):
+        """Reference mempool/reactor.go OnStart (broadcast routine);
+        started by the owning Switch."""
+        self.spawn(self._broadcast_routine, name="mempool-bcast")
 
     def get_channels(self):
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
                                   send_queue_capacity=100)]
 
     def add_peer(self, peer: Peer):
+        self.log.debug("peer added", peer=peer.id)
         with self._lock:
             self._peer_sent[peer.id] = set()
 
     def remove_peer(self, peer: Peer, reason):
+        self.log.debug("peer removed", peer=peer.id,
+                       reason=str(reason) if reason else "")
         with self._lock:
             self._peer_sent.pop(peer.id, None)
 
@@ -75,7 +82,7 @@ class MempoolReactor(Reactor):
         """Per-peer broadcast of not-yet-sent txs (the clist walk in the
         reference, mempool/v0/reactor.go:189; here tracked by tx hash)."""
         from tendermint_tpu.types.block import tx_hash
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             time.sleep(0.05)
             if self.switch is None:
                 continue

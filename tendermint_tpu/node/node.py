@@ -15,6 +15,7 @@ from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
 from tendermint_tpu.libs.kvdb import MemDB, SQLiteDB
+from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
 from tendermint_tpu.p2p.key import NodeKey
@@ -156,14 +157,17 @@ def _replay_tail_block(app, state: State, state_store: StateStore,
     return new_state
 
 
-class Node:
-    """A full node (reference node/node.go:704 NewNode + :938 OnStart)."""
+class Node(BaseService):
+    """A full node (reference node/node.go:704 NewNode + :938 OnStart;
+    a BaseService like the reference's node)."""
 
     def __init__(self, config: Config, app, genesis: Optional[GenesisDoc]
                  = None, in_memory: bool = False):
+        super().__init__("node")
         from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.proxy import AppConns, ClientCreator
         self.config = config
+        config.validate_basic()
         self.log = tmlog.logger("node").with_(moniker=config.moniker)
         # four logical app connections (reference proxy/multi_app_conn.go);
         # a plain in-process Application shares one instance across all
@@ -243,11 +247,19 @@ class Node:
             self.mempool = PriorityMempool(
                 self.app_conns.mempool,
                 max_tx_bytes=cfg.mempool.max_tx_bytes,
-                size_limit=cfg.mempool.size)
+                size_limit=cfg.mempool.size,
+                max_total_bytes=cfg.mempool.max_txs_bytes,
+                keep_invalid_txs_in_cache=cfg.mempool
+                .keep_invalid_txs_in_cache,
+                cache_size=cfg.mempool.cache_size)
         else:
             self.mempool = Mempool(self.app_conns.mempool,
                                    max_tx_bytes=cfg.mempool.max_tx_bytes,
-                                   size_limit=cfg.mempool.size)
+                                   size_limit=cfg.mempool.size,
+                                   max_txs_bytes=cfg.mempool.max_txs_bytes,
+                                   keep_invalid_txs_in_cache=cfg.mempool
+                                   .keep_invalid_txs_in_cache,
+                                   cache_size=cfg.mempool.cache_size)
         self.evidence_pool = EvidencePool(ev_db, self.state_store,
                                           self.block_store)
 
@@ -267,13 +279,22 @@ class Node:
         # -- p2p switch + reactors (node.go:908-936) -------------------
         self.switch = Switch(self.node_key, cfg.p2p.laddr,
                              network=self.genesis.chain_id,
-                             moniker=cfg.moniker)
+                             moniker=cfg.moniker, p2p_config=cfg.p2p)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.mempool_reactor = MempoolReactor(self.mempool)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
-        # fastSync := config.FastSyncMode && !onlyValidatorIsUs
-        # (reference node/node.go:712-722)
-        fast_sync = cfg.block_sync.enable and not self._only_validator_is_us()
+        # fastSync := config.FastSyncMode && !onlyValidatorIsUs, and held
+        # back entirely while statesync restores — the reactor is built
+        # dormant and activated by the statesync handoff (reference
+        # node/node.go:712-722 + createBlockchainReactor's
+        # blockSync && !stateSync: syncing blocks from height 1 while a
+        # snapshot restore rewrites the state would corrupt both)
+        self._statesync_active = bool(
+            cfg.state_sync.enable and self.state.last_block_height == 0
+            and self.block_store.height() == 0)
+        fast_sync = (cfg.block_sync.enable
+                     and not self._only_validator_is_us()
+                     and not self._statesync_active)
         self.blocksync_reactor = BlocksyncReactor(
             self.executor, self.block_store, self.state,
             fast_sync=fast_sync, on_caught_up=self._on_caught_up)
@@ -286,9 +307,6 @@ class Node:
         # every node serves its app's snapshots; a fresh node with
         # state_sync enabled also restores from peers before blocksync
         from tendermint_tpu.statesync.reactor import StateSyncReactor
-        self._statesync_active = bool(
-            cfg.state_sync.enable and self.state.last_block_height == 0
-            and self.block_store.height() == 0)
         state_provider = None
         if self._statesync_active:
             servers = [a.strip() for a in
@@ -331,10 +349,10 @@ class Node:
         self.rpc_server = None
         if cfg.rpc.enabled:
             from tendermint_tpu.rpc.server import RPCServer
-            self.rpc_server = RPCServer(self, cfg.rpc.laddr)
+            self.rpc_server = RPCServer(
+                self, cfg.rpc.laddr,
+                max_body_bytes=cfg.rpc.max_body_bytes)
 
-        self._started = False
-        self._stopping = False
         self._consensus_started = threading.Event()
 
     def _pv_address(self) -> Optional[bytes]:
@@ -360,9 +378,16 @@ class Node:
     # -- lifecycle (node.go:938-1001) --------------------------------------
 
     def start(self, wait_for_sync: bool = False):
-        if self._started:
-            raise NodeError("node already started")
-        self._started = True
+        """BaseService.start (errors on double start / start after stop)
+        plus the reference's optional wait for consensus."""
+        BaseService.start(self)
+        if wait_for_sync:
+            self._consensus_started.wait()
+
+    def on_start(self):
+        """Reference node.go:938 OnStart order: indexer, switch (which
+        starts every reactor, switch.go:226), persistent-peer dials,
+        statesync/blocksync/consensus decision, RPC."""
         self.log.info("starting node",
                       node_id=self.node_key.node_id,
                       chain_id=self.genesis.chain_id,
@@ -372,23 +397,17 @@ class Node:
         for addr in filter(None,
                            self.config.p2p.persistent_peers.split(",")):
             self.switch.dial_peer(addr.strip(), persistent=True)
-        self.evidence_reactor.start()
-        if self.pex_reactor is not None:
-            self.pex_reactor.start()
         if self._statesync_active:
             # restore from a snapshot first; blocksync/consensus start
             # from the restored state once it lands (node.go:993
             # startStateSync -> bcReactor.SwitchToBlockSync)
-            threading.Thread(target=self._statesync_routine,
-                             name="statesync", daemon=True).start()
-        elif self.blocksync_reactor.fast_sync:
-            self.blocksync_reactor.start()
-        else:
+            self.spawn(self._statesync_routine, name="statesync")
+        elif not self.blocksync_reactor.fast_sync:
             self._on_caught_up(self.state)
+        # (fast_sync case: the switch already activated the reactor's
+        # sync routines via its on_start)
         if self.rpc_server is not None:
             self.rpc_server.start()
-        if wait_for_sync:
-            self._consensus_started.wait()
 
     def _statesync_routine(self):
         """Run the syncer, persist the restored state, then hand off to
@@ -401,7 +420,7 @@ class Node:
         deadline = _time.monotonic() + 300.0
         state = commit = None
         attempts = 0
-        while _time.monotonic() < deadline and not self._stopping:
+        while _time.monotonic() < deadline and not self.quitting.is_set():
             try:
                 state, commit = self.statesync_reactor.syncer.sync_any()
                 break
@@ -415,11 +434,11 @@ class Node:
                 self.statesync_reactor.request_snapshots()
                 _time.sleep(1.0)
         if state is None:
-            if not self._stopping:
+            if not self.quitting.is_set():
                 self.log.info(
                     "statesync found no usable snapshot; "
                     "falling back to blocksync")
-            self.blocksync_reactor.start()
+            self.blocksync_reactor.activate()
             return
         self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(state.last_block_height, commit)
@@ -427,7 +446,7 @@ class Node:
         self.blocksync_reactor.switch_to_blocksync(state)
         self.log.info("statesync restored state",
                       height=state.last_block_height)
-        self.blocksync_reactor.start()
+        self.blocksync_reactor.activate()
 
     def _on_caught_up(self, state):
         """SwitchToConsensus (reference blocksync/reactor.go:316)."""
@@ -439,30 +458,31 @@ class Node:
         self.consensus.start()
         self._consensus_started.set()
 
-    def stop(self):
-        self._stopping = True
+    def on_stop(self):
+        """Reference node.go:1003 OnStop: indexer, RPC, consensus, then
+        the switch (which stops every reactor), then the app conns."""
         self.log.info("stopping node",
                       height=self.block_store.height())
         self.indexer_service.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
-        self.blocksync_reactor.stop()
-        self.consensus_reactor.stop()
-        self.evidence_reactor.stop()
-        if self.pex_reactor is not None:
-            self.pex_reactor.stop()
         if self._consensus_started.is_set():
             self.consensus.stop()
         if hasattr(self.priv_validator, "close"):
             self.priv_validator.close()
-        self.switch.stop()
+        self.switch.stop()  # stops all reactors (switch.go:234 OnStop)
         self.app_conns.stop()  # last: consensus/mempool use these
 
     # -- info for RPC -------------------------------------------------------
 
     def status(self) -> dict:
+        """Reference rpc/core/status.go ResultStatus, amino-JSON dialect
+        (int64 heights as strings, RFC3339 times, tagged pub keys)."""
+        from tendermint_tpu.libs import amino_json as aj
         latest = self.block_store.height()
         meta = self.block_store.load_block_meta(latest) if latest else None
+        pv_pub = (self.priv_validator.get_pub_key()
+                  if self.priv_validator is not None else None)
         return {
             "node_info": {
                 "id": self.node_key.node_id,
@@ -471,13 +491,26 @@ class Node:
                 "moniker": self.config.moniker,
             },
             "sync_info": {
-                "latest_block_height": latest,
+                "latest_block_height": str(latest),
                 "latest_block_hash":
-                    meta.block_id.hash.hex() if meta else "",
-                "latest_app_hash": self.state.app_hash.hex(),
+                    meta.block_id.hash.hex().upper() if meta else "",
+                "latest_app_hash": self.state.app_hash.hex().upper(),
+                "latest_block_time":
+                    aj.ts_rfc3339(meta.header.time) if meta else "",
                 "catching_up": not self._consensus_started.is_set(),
             },
             "validator_info": {
-                "address": (self._pv_address() or b"").hex(),
+                "address": (self._pv_address() or b"").hex().upper(),
+                "pub_key": (aj.pub_key_json(pv_pub.type_name,
+                                            pv_pub.bytes())
+                            if pv_pub is not None else None),
+                "voting_power": str(self._voting_power()),
             },
         }
+
+    def _voting_power(self) -> int:
+        addr = self._pv_address()
+        if addr is None:
+            return 0
+        _, val = self.state.validators.get_by_address(addr)
+        return val.voting_power if val is not None else 0

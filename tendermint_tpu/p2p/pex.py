@@ -438,7 +438,8 @@ wire.register_codec(PEX_CHANNEL, encode_msg, decode_msg)
 
 
 class PexReactor(Reactor):
-    """Reference p2p/pex/pex_reactor.go."""
+    """Reference p2p/pex/pex_reactor.go (BaseService lifecycle via
+    Reactor; the Switch starts/stops it)."""
 
     def __init__(self, book: AddrBook, ensure_period_s: float = 30.0,
                  target_out_peers: int = 10, seeds: str = "",
@@ -450,12 +451,12 @@ class PexReactor(Reactor):
         self.ensure_period_s = ensure_period_s
         self.target_out_peers = target_out_peers
         self.seeds = [s.strip() for s in seeds.split(",") if s.strip()]
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("pex")
         self._last_request: Dict[str, float] = {}   # peer -> last req FROM it
         self._sent_request: Dict[str, float] = {}   # peer -> last req TO it
         self._requested: Dict[str, float] = {}      # open requests we sent
         self._mtx = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
 
     def get_channels(self):
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
@@ -467,13 +468,13 @@ class PexReactor(Reactor):
         if reason is not None:
             self.trust.get(peer.id).bad_events()
 
-    def start(self):
-        self._thread = threading.Thread(target=self._ensure_peers_routine,
-                                        daemon=True, name="pex-ensure")
-        self._thread.start()
+    def on_start(self):
+        """Reference pex_reactor.go:117 OnStart; started by the Switch."""
+        self.log.info("pex started", seeds=len(self.seeds),
+                      book_size=self.book.size())
+        self.spawn(self._ensure_peers_routine, name="pex-ensure")
 
-    def stop(self):
-        self._stop.set()
+    def on_stop(self):
         self.book.save()
 
     # -- peer lifecycle ------------------------------------------------------
@@ -524,6 +525,8 @@ class PexReactor(Reactor):
                 if not flood:
                     self._last_request[peer.id] = now
             if flood:
+                self.log.info("disconnecting pex-flooding peer",
+                              peer=peer.id)
                 self.book.mark_bad(peer.id)
                 if self.switch is not None:
                     self.switch.stop_peer_for_error(peer,
@@ -537,6 +540,8 @@ class PexReactor(Reactor):
                 if not unsolicited:
                     self._requested.pop(peer.id, None)
             if unsolicited:
+                self.log.info("disconnecting peer for unsolicited addrs",
+                              peer=peer.id)
                 if self.switch is not None:
                     self.switch.stop_peer_for_error(
                         peer, "unsolicited pex addrs")
@@ -556,20 +561,20 @@ class PexReactor(Reactor):
 
     def _ensure_peers_routine(self):
         # jittered first run so a fleet doesn't thunder
-        self._stop.wait(self.ensure_period_s * random.random() * 0.1)
+        self.quitting.wait(self.ensure_period_s * random.random() * 0.1)
         last_save = time.monotonic()
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 self._ensure_peers()
-            except Exception:  # noqa: BLE001 - keep the routine alive
-                pass
+            except Exception as e:  # noqa: BLE001 - keep the routine alive
+                self.log.error("ensure-peers iteration failed", err=str(e))
             if time.monotonic() - last_save > self.BOOK_DUMP_INTERVAL_S:
                 last_save = time.monotonic()
                 try:
                     self.book.save()
-                except OSError:
-                    pass
-            self._stop.wait(self.ensure_period_s)
+                except OSError as e:
+                    self.log.error("addr book save failed", err=str(e))
+            self.quitting.wait(self.ensure_period_s)
 
     def _ensure_peers(self):
         sw = self.switch
@@ -603,6 +608,7 @@ class PexReactor(Reactor):
                 self.trust.get(peer.id).good_events()
                 need -= 1
             else:
+                self.log.debug("dial failed", addr=ka.addr)
                 self.trust.get(ka.node_id).bad_events()
         with sw._lock:
             peers = list(sw.peers.values())

@@ -19,6 +19,7 @@ from . import wire
 from .connection import ChannelDescriptor, MConnection
 from .key import NodeKey
 from .secret_connection import SecretConnection
+from tendermint_tpu.libs.service import BaseService
 
 
 # protocol versions (reference version/version.go:18-24)
@@ -97,12 +98,15 @@ class NodeInfo:
         return None
 
 
-class Reactor:
-    """Base reactor (reference p2p/base_reactor.go).  Subclasses register
-    channels and react to peer lifecycle + messages."""
+class Reactor(BaseService):
+    """Base reactor (reference p2p/base_reactor.go BaseReactor: embeds
+    BaseService).  Subclasses register channels and react to peer
+    lifecycle + messages; long-lived routines go in on_start via spawn
+    and watch self.quitting.  The owning Switch starts/stops reactors
+    (reference p2p/switch.go:226-239 OnStart / OnStop)."""
 
     def __init__(self, name: str):
-        self.name = name
+        super().__init__(name)
         self.switch: Optional["Switch"] = None
 
     def get_channels(self) -> List[ChannelDescriptor]:
@@ -141,10 +145,18 @@ class Peer:
         self.mconn.stop()
 
 
-class Switch:
+class Switch(BaseService):
     def __init__(self, node_key: NodeKey, listen_addr: str, network: str,
                  moniker: str = "", version: str = "0.1.0",
-                 metrics_registry=None):
+                 metrics_registry=None, p2p_config=None):
+        super().__init__("switch")
+        # operator knobs (reference config/config.go P2PConfig); None
+        # keeps the defaults for direct construction in tests
+        self._send_rate = getattr(p2p_config, "send_rate", 5_120_000)
+        self._recv_rate = getattr(p2p_config, "recv_rate", 5_120_000)
+        self._dial_timeout = getattr(p2p_config, "dial_timeout_s", 10.0)
+        self._handshake_timeout = getattr(p2p_config,
+                                          "handshake_timeout_s", 10.0)
         from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.libs.metrics import P2PMetrics
         self.log = tmlog.logger("p2p").with_(moniker=moniker) if moniker \
@@ -161,9 +173,8 @@ class Switch:
         self.peers: Dict[str, Peer] = {}
         self._lock = threading.RLock()
         self._listener: Optional[socket.socket] = None
-        self._stop = threading.Event()
         self._reconnecting: set = set()
-        self.max_peers = 50
+        self.max_peers = getattr(p2p_config, 'max_num_peers', 50)
 
     # -- reactor registry (reference p2p/switch.go AddReactor) -------------
 
@@ -185,29 +196,35 @@ class Switch:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self):
+    def on_start(self):
+        """Reference p2p/switch.go:226 OnStart: start every registered
+        reactor, then listen.  A reactor already started by its owner
+        keeps running (start here would be an AlreadyStarted error)."""
+        for r in self.reactors.values():
+            if not r.is_running():
+                r.start()
         host, port = self.listen_addr.rsplit(":", 1)
         self._listener = socket.create_server((host, int(port)))
         self._listener.settimeout(0.5)
-        t = threading.Thread(target=self._accept_routine, daemon=True,
-                             name="switch-accept")
-        t.start()
+        self.spawn(self._accept_routine, name="switch-accept")
 
     def actual_listen_addr(self) -> str:
         host, port = self._listener.getsockname()[:2]
         return f"{host}:{port}"
 
-    def stop(self):
-        self._stop.set()
+    def on_stop(self):
+        """Reference p2p/switch.go:234 OnStop: stop peers, then reactors."""
         if self._listener is not None:
             self._listener.close()
         with self._lock:
             peers = list(self.peers.values())
         for p in peers:
             self.stop_peer_for_error(p, "switch stopping")
+        for r in self.reactors.values():
+            r.stop()
 
     def _accept_routine(self):
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 sock, _addr = self._listener.accept()
             except socket.timeout:
@@ -226,7 +243,8 @@ class Switch:
             expected_id, addr = addr.split("@", 1)
         host, port = addr.rsplit(":", 1)
         try:
-            sock = socket.create_connection((host, int(port)), timeout=10)
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=self._dial_timeout)
             peer = self._handshake(sock, outbound=True, persistent=persistent)
         except Exception as e:  # noqa: BLE001
             if persistent:
@@ -250,7 +268,7 @@ class Switch:
         def routine():
             backoff = 1.0
             try:
-                while not self._stop.is_set():
+                while not self.quitting.is_set():
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 60.0)
                     peer = None
@@ -280,7 +298,7 @@ class Switch:
 
     def _handshake(self, sock: socket.socket, outbound: bool,
                    persistent: bool = False) -> Optional[Peer]:
-        sock.settimeout(10)
+        sock.settimeout(self._handshake_timeout)
         sconn = SecretConnection(sock, self.node_key.priv_key)
         # NodeInfo exchange
         sconn.send_frame(self.node_info().to_bytes())
@@ -318,7 +336,9 @@ class Switch:
             if peer is not None:
                 self.stop_peer_for_error(peer, e)
 
-        mconn = MConnection(sconn, self._descriptors, on_receive, on_error)
+        mconn = MConnection(sconn, self._descriptors, on_receive, on_error,
+                            send_rate=self._send_rate,
+                            recv_rate=self._recv_rate)
         peer = Peer(their_info, mconn, outbound, persistent)
         peer_box[0] = peer
         with self._lock:
@@ -350,7 +370,7 @@ class Switch:
             except Exception:  # noqa: BLE001
                 self.log.error("remove_peer hook failed", peer=peer.id,
                                err=traceback.format_exc(limit=6))
-        if peer.persistent and not self._stop.is_set():
+        if peer.persistent and not self.quitting.is_set():
             addr = peer.data.get("dial_addr") or peer.node_info.listen_addr
             self._schedule_reconnect(addr, peer.id)
 

@@ -68,6 +68,8 @@ class FilePV:
         self.priv_key = priv_key
         self.key_path = key_path
         self.state_path = state_path
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("privval")
         self.last = _LastSignState()
         if state_path and os.path.exists(state_path):
             with open(state_path) as f:
@@ -141,6 +143,8 @@ class FilePV:
             if prev is not None:
                 vote.timestamp, vote.signature = prev
                 return vote
+            self.log.error("refusing to double-sign vote",
+                           height=vote.height, round=vote.round)
             raise DoubleSignError("conflicting vote data at same HRS")
         sig = self.priv_key.sign(sign_bytes)
         self.last = _LastSignState(vote.height, vote.round, step, sig,
@@ -177,6 +181,8 @@ class FilePV:
             if sign_bytes == self.last.sign_bytes:
                 proposal.signature = self.last.signature
                 return proposal
+            self.log.error("refusing to double-sign proposal",
+                           height=proposal.height, round=proposal.round)
             raise DoubleSignError("conflicting proposal data at same HRS")
         sig = self.priv_key.sign(sign_bytes)
         self.last = _LastSignState(proposal.height, proposal.round,

@@ -119,31 +119,32 @@ class TxIndexer:
                 "tx": base64.b64encode(rec.tx).decode()}
 
 
-class IndexerService:
-    """Reference state/txindex/indexer_service.go: subscribes to NewBlock
-    on the event bus and feeds both indexers."""
+from tendermint_tpu.libs.service import BaseService
+
+
+class IndexerService(BaseService):
+    """Reference state/txindex/indexer_service.go (a BaseService there
+    too): subscribes to NewBlock on the event bus and feeds both
+    indexers."""
 
     def __init__(self, tx_indexer: "TxIndexer", block_indexer: "BlockIndexer",
                  event_bus, sinks=None):
-        import threading
+        super().__init__("indexer")
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
         self.sinks = list(sinks or [])  # SQLEventSink etc (state/sinks.py)
         self._sub = event_bus.subscribe("NewBlock")
         self._bus = event_bus
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
 
-    def start(self):
-        self._thread.start()
+    def on_start(self):
+        self.spawn(self._run, name="indexer")
 
-    def stop(self):
-        self._stop.set()
+    def on_stop(self):
         self._bus.unsubscribe(self._sub)
 
     def _run(self):
         import queue
-        while not self._stop.is_set():
+        while not self.quitting.is_set():
             try:
                 ev = self._sub.queue.get(timeout=0.2)
             except queue.Empty:

@@ -94,8 +94,8 @@ def _mk_switch(i: int, reactor: PexReactor) -> Switch:
                 moniker=f"pex{i}")
     sw.add_reactor("PEX", reactor)
     reactor.book.add_our_id(sw.node_key.node_id)
-    sw.start()
-    reactor.start()
+    sw.start()       # starts the reactor too (switch.go:226 OnStart)
+    assert reactor.is_running()
     return sw
 
 
