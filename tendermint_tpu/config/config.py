@@ -20,6 +20,22 @@ class P2PConfig:
     max_num_peers: int = 50
     pex: bool = True            # run the PEX reactor / addr book
     seeds: str = ""             # comma-separated id@host:port to crawl
+    # per-connection byte-rate caps + dial/handshake deadlines
+    # (reference config/config.go:604-607 SendRate/RecvRate and
+    # :598 HandshakeTimeout/DialTimeout)
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    handshake_timeout_s: float = 20.0
+    dial_timeout_s: float = 3.0
+
+    def validate_basic(self):
+        """Reference config/config.go:668-688 P2PConfig.ValidateBasic."""
+        if self.max_num_peers <= 0:
+            raise ValueError("p2p.max_num_peers must be positive")
+        if self.send_rate <= 0 or self.recv_rate <= 0:
+            raise ValueError("p2p.send_rate/recv_rate must be positive")
+        if self.handshake_timeout_s <= 0 or self.dial_timeout_s <= 0:
+            raise ValueError("p2p timeouts must be positive")
 
 
 @dataclass
@@ -28,6 +44,24 @@ class MempoolConfig:
     size: int = 5000
     cache_size: int = 10000
     max_tx_bytes: int = 1048576
+    # total byte budget across all pending txs (reference
+    # config/config.go:731 MaxTxsBytes, default 1GB)
+    max_txs_bytes: int = 1 << 30
+    keep_invalid_txs_in_cache: bool = False
+
+    def validate_basic(self):
+        """Reference config/config.go:772-787 MempoolConfig.ValidateBasic."""
+        if self.version not in ("v0", "v1"):
+            raise ValueError(f"mempool.version must be v0|v1, "
+                             f"got {self.version!r}")
+        if self.size <= 0:
+            raise ValueError("mempool.size must be positive")
+        if self.cache_size <= 0:
+            raise ValueError("mempool.cache_size must be positive")
+        if self.max_tx_bytes <= 0:
+            raise ValueError("mempool.max_tx_bytes must be positive")
+        if self.max_txs_bytes <= 0:
+            raise ValueError("mempool.max_txs_bytes must be positive")
 
 
 @dataclass
@@ -35,6 +69,12 @@ class RPCConfig:
     laddr: str = "127.0.0.1:26657"
     enabled: bool = True
     unsafe: bool = False  # expose dial_seeds/dial_peers (ref --rpc.unsafe)
+    # request body cap (reference config/config.go:468 MaxBodyBytes)
+    max_body_bytes: int = 1_000_000
+
+    def validate_basic(self):
+        if self.max_body_bytes <= 0:
+            raise ValueError("rpc.max_body_bytes must be positive")
 
 
 @dataclass
@@ -89,6 +129,19 @@ class Config:
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     batch_verifier: BatchVerifierConfig = field(
         default_factory=BatchVerifierConfig)
+
+    def validate_basic(self):
+        """Reference config/config.go:107-133 Config.ValidateBasic:
+        every section validates, errors carry the section name."""
+        for name in ("p2p", "mempool", "rpc", "consensus"):
+            section = getattr(self, name)
+            vb = getattr(section, "validate_basic", None)
+            if vb is None:
+                continue
+            try:
+                vb()
+            except ValueError as e:
+                raise ValueError(f"error in [{name}] section: {e}")
 
     # -- paths -------------------------------------------------------------
 
@@ -148,17 +201,24 @@ persistent_peers = "{self._q(self.p2p.persistent_peers)}"
 max_num_peers = {self.p2p.max_num_peers}
 pex = {str(self.p2p.pex).lower()}
 seeds = "{self._q(self.p2p.seeds)}"
+send_rate = {self.p2p.send_rate}
+recv_rate = {self.p2p.recv_rate}
+handshake_timeout_s = {self.p2p.handshake_timeout_s}
+dial_timeout_s = {self.p2p.dial_timeout_s}
 
 [mempool]
 version = "{self._q(self.mempool.version)}"
 size = {self.mempool.size}
 cache_size = {self.mempool.cache_size}
 max_tx_bytes = {self.mempool.max_tx_bytes}
+max_txs_bytes = {self.mempool.max_txs_bytes}
+keep_invalid_txs_in_cache = {str(self.mempool.keep_invalid_txs_in_cache).lower()}
 
 [rpc]
 laddr = "{self._q(self.rpc.laddr)}"
 enabled = {str(self.rpc.enabled).lower()}
 unsafe = {str(self.rpc.unsafe).lower()}
+max_body_bytes = {self.rpc.max_body_bytes}
 
 [block_sync]
 enable = {str(self.block_sync.enable).lower()}
@@ -211,16 +271,25 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             persistent_peers=p.get("persistent_peers", ""),
             max_num_peers=p.get("max_num_peers", 50),
             pex=p.get("pex", True),
-            seeds=p.get("seeds", ""))
+            seeds=p.get("seeds", ""),
+            send_rate=int(p.get("send_rate", 5_120_000)),
+            recv_rate=int(p.get("recv_rate", 5_120_000)),
+            handshake_timeout_s=float(p.get("handshake_timeout_s", 20.0)),
+            dial_timeout_s=float(p.get("dial_timeout_s", 3.0)))
         m = d.get("mempool", {})
         cfg.mempool = MempoolConfig(
             version=m.get("version", "v0"),
             size=m.get("size", 5000), cache_size=m.get("cache_size", 10000),
-            max_tx_bytes=m.get("max_tx_bytes", 1048576))
+            max_tx_bytes=m.get("max_tx_bytes", 1048576),
+            max_txs_bytes=int(m.get("max_txs_bytes", 1 << 30)),
+            keep_invalid_txs_in_cache=bool(
+                m.get("keep_invalid_txs_in_cache", False)))
         r = d.get("rpc", {})
         cfg.rpc = RPCConfig(laddr=r.get("laddr", cfg.rpc.laddr),
                             enabled=r.get("enabled", True),
-                            unsafe=r.get("unsafe", False))
+                            unsafe=r.get("unsafe", False),
+                            max_body_bytes=int(
+                                r.get("max_body_bytes", 1_000_000)))
         bs = d.get("block_sync", {})
         cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
         ti = d.get("tx_index", {})

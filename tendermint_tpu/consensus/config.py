@@ -22,6 +22,19 @@ class ConsensusConfig:
     create_empty_blocks_interval: float = 0.0
     double_sign_check_height: int = 0
 
+    def validate_basic(self):
+        """Reference config/config.go:939-956 ConsensusConfig.ValidateBasic:
+        every timeout must be non-negative (deltas included)."""
+        for name in ("timeout_propose", "timeout_propose_delta",
+                     "timeout_prevote", "timeout_prevote_delta",
+                     "timeout_precommit", "timeout_precommit_delta",
+                     "timeout_commit", "create_empty_blocks_interval"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"consensus.{name} cannot be negative")
+        if self.double_sign_check_height < 0:
+            raise ValueError(
+                "consensus.double_sign_check_height cannot be negative")
+
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_
 

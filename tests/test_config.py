@@ -1,0 +1,84 @@
+"""Config surface parity: TOML round-trip of the operator knobs and
+ValidateBasic-style rejection of nonsense (reference config/config.go
+ValidateBasic per section, :939-956 for consensus; VERDICT r3 #9)."""
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.e2e.manifest import manifest_from_dict
+
+
+def test_toml_roundtrip_preserves_new_knobs(tmp_path):
+    cfg = Config(home=str(tmp_path), moniker="knobs")
+    cfg.consensus.timeout_commit = 2.5
+    cfg.mempool.size = 1234
+    cfg.mempool.cache_size = 777
+    cfg.mempool.max_txs_bytes = 9_000_000
+    cfg.mempool.keep_invalid_txs_in_cache = True
+    cfg.p2p.send_rate = 1_000_000
+    cfg.p2p.recv_rate = 2_000_000
+    cfg.p2p.dial_timeout_s = 1.5
+    cfg.p2p.handshake_timeout_s = 7.0
+    cfg.rpc.max_body_bytes = 65536
+    cfg.save()
+    back = Config.load(str(tmp_path))
+    assert back.consensus.timeout_commit == 2.5
+    assert back.mempool.size == 1234
+    assert back.mempool.cache_size == 777
+    assert back.mempool.max_txs_bytes == 9_000_000
+    assert back.mempool.keep_invalid_txs_in_cache is True
+    assert back.p2p.send_rate == 1_000_000
+    assert back.p2p.recv_rate == 2_000_000
+    assert back.p2p.dial_timeout_s == 1.5
+    assert back.p2p.handshake_timeout_s == 7.0
+    assert back.rpc.max_body_bytes == 65536
+    back.validate_basic()
+
+
+@pytest.mark.parametrize("mutate,wants", [
+    (lambda c: setattr(c.consensus, "timeout_commit", -1.0), "consensus"),
+    (lambda c: setattr(c.consensus, "timeout_propose_delta", -0.1),
+     "consensus"),
+    (lambda c: setattr(c.mempool, "size", 0), "mempool"),
+    (lambda c: setattr(c.mempool, "max_txs_bytes", -5), "mempool"),
+    (lambda c: setattr(c.mempool, "version", "v9"), "mempool"),
+    (lambda c: setattr(c.p2p, "send_rate", 0), "p2p"),
+    (lambda c: setattr(c.p2p, "max_num_peers", -1), "p2p"),
+    (lambda c: setattr(c.rpc, "max_body_bytes", 0), "rpc"),
+])
+def test_validate_basic_rejects_nonsense(mutate, wants):
+    cfg = Config(home="/tmp/x")
+    mutate(cfg)
+    with pytest.raises(ValueError, match=wants):
+        cfg.validate_basic()
+
+
+def test_node_rejects_invalid_config(tmp_path):
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.node import Node
+
+    cfg = Config(home=str(tmp_path), moniker="bad")
+    cfg.ensure_dirs()
+    cfg.consensus.timeout_commit = -3.0
+    with pytest.raises(ValueError, match="consensus"):
+        Node(cfg, KVStoreApplication(), in_memory=True)
+
+
+def test_manifest_per_node_overrides(tmp_path):
+    m = manifest_from_dict({
+        "chain_id": "ovr",
+        "node": {
+            "v0": {"mempool_size": 42, "timeout_commit": 1.25},
+            "v1": {},
+        },
+    })
+    from tendermint_tpu.e2e import E2ERunner
+    r = E2ERunner(m, str(tmp_path / "net"))
+    r.setup()
+    cfg0 = Config.load(r.nodes["v0"].home)
+    cfg1 = Config.load(r.nodes["v1"].home)
+    assert cfg0.mempool.size == 42
+    assert cfg0.consensus.timeout_commit == 1.25
+    assert cfg1.mempool.size == 5000
+    assert cfg1.consensus.timeout_commit == m.timeout_commit
