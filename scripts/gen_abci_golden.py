@@ -1,0 +1,349 @@
+#!/usr/bin/env python
+"""Generate golden ABCI socket frames from the REFERENCE proto schemas.
+
+Compiles /root/reference/proto/tendermint/abci/types.proto (and deps)
+with protoc, builds one Request and one Response per ABCI method with
+the official protobuf runtime, and writes the canonical serializations
+to tests/fixtures/abci_golden.json.  tests/test_abci_golden.py then
+asserts that abci/wire.py produces byte-identical frames and decodes
+the golden bytes back to the internal objects — the socket-interop
+proof VERDICT r3 #7 asks for in lieu of a gRPC transport (reference
+abci/types/messages.go WriteMessage; abci/client/socket_client.go).
+
+Run (repo root, reference checkout + protoc + protobuf runtime needed):
+    python scripts/gen_abci_golden.py
+The committed fixture file makes the TEST independent of protoc.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+sys.path.insert(0, REPO)
+
+from tendermint_tpu.abci import types as abci  # noqa: E402
+from tendermint_tpu.abci import wire  # noqa: E402
+from tendermint_tpu.types.basic import (BlockID, PartSetHeader,  # noqa: E402
+                                        Timestamp)
+from tendermint_tpu.types.block import Consensus, Header  # noqa: E402
+
+
+def compile_protos(tmp):
+    protos = [
+        "tendermint/abci/types.proto", "tendermint/crypto/proof.proto",
+        "tendermint/crypto/keys.proto", "tendermint/types/types.proto",
+        "tendermint/types/params.proto", "tendermint/types/validator.proto",
+        "tendermint/types/evidence.proto", "tendermint/version/types.proto",
+    ]
+    subprocess.run(
+        ["protoc", "-I", f"{REF}/proto", "-I", f"{REF}/third_party/proto",
+         f"--python_out={tmp}"]
+        + [f"{REF}/proto/{p}" for p in protos]
+        + [f"{REF}/third_party/proto/gogoproto/gogo.proto"],
+        check=True)
+    sys.path.insert(0, tmp)
+    return importlib.import_module("tendermint.abci.types_pb2")
+
+
+def make_header():
+    return Header(
+        version=Consensus(block=11, app=1), chain_id="golden-chain",
+        height=42, time=Timestamp(1700000100, 500),
+        last_block_id=BlockID(b"\x11" * 32, PartSetHeader(2, b"\x22" * 32)),
+        last_commit_hash=b"\x33" * 32, data_hash=b"\x44" * 32,
+        validators_hash=b"\x55" * 32, next_validators_hash=b"\x66" * 32,
+        consensus_hash=b"\x77" * 32, app_hash=b"\x88" * 32,
+        last_results_hash=b"\x99" * 32, evidence_hash=b"\xAA" * 32,
+        proposer_address=b"\xBB" * 20)
+
+
+def build_cases(pb):
+    ts = lambda m, s, n=0: (setattr(m, "seconds", s), setattr(m, "nanos", n))
+    H = make_header()
+    hdr_proto = H.proto()
+
+    cases = []  # (name, kind, method, internal_obj, pb_Request/Response)
+
+    def req(method, internal, fill):
+        r = pb.Request()
+        fill(getattr(r, method))
+        cases.append((f"req_{method}", "request", method, internal, r))
+
+    def rsp(method, internal, fill):
+        r = pb.Response()
+        fill(getattr(r, method))
+        cases.append((f"rsp_{method}", "response", method, internal, r))
+
+    # ---- requests ----
+    req("echo", "hello-golden",
+        lambda m: setattr(m, "message", "hello-golden"))
+    req("flush", None, lambda m: m.SetInParent())
+    req("info", abci.RequestInfo("0.34.20", 11, 8),
+        lambda m: (setattr(m, "version", "0.34.20"),
+                   setattr(m, "block_version", 11),
+                   setattr(m, "p2p_version", 8)))
+
+    icq = abci.RequestInitChain(
+        time_seconds=1700000100, chain_id="golden-chain",
+        consensus_params=abci.ConsensusParamsUpdate(
+            block_max_bytes=22020096, block_max_gas=-1),
+        validators=[abci.ValidatorUpdate("ed25519", b"\x01" * 32, 10),
+                    abci.ValidatorUpdate("secp256k1", b"\x02" * 33, 5)],
+        app_state_bytes=b'{"k":"v"}', initial_height=1)
+
+    def fill_ic(m):
+        ts(m.time, 1700000100)
+        m.chain_id = "golden-chain"
+        m.consensus_params.block.max_bytes = 22020096
+        m.consensus_params.block.max_gas = -1
+        v = m.validators.add()
+        v.pub_key.ed25519 = b"\x01" * 32
+        v.power = 10
+        v = m.validators.add()
+        v.pub_key.secp256k1 = b"\x02" * 33
+        v.power = 5
+        m.app_state_bytes = b'{"k":"v"}'
+        m.initial_height = 1
+    req("init_chain", icq, fill_ic)
+
+    req("query", abci.RequestQuery(b"key1", "/store", 7, True),
+        lambda m: (setattr(m, "data", b"key1"), setattr(m, "path", "/store"),
+                   setattr(m, "height", 7), setattr(m, "prove", True)))
+
+    mis = abci.Misbehavior(type=1, validator_address=b"\xCC" * 20,
+                           validator_power=10, height=40,
+                           time_seconds=1700000050, time_nanos=25,
+                           total_voting_power=30)
+    bbq = abci.RequestBeginBlock(
+        hash=H.hash(), header_proto=hdr_proto,
+        last_commit_votes=[
+            (type("V", (), {"address": b"\xDD" * 20, "voting_power": 10})(),
+             True),
+            (type("V", (), {"address": b"\xEE" * 20, "voting_power": 20})(),
+             False)],
+        byzantine_validators=[mis])
+
+    def fill_bb(m):
+        m.hash = H.hash()
+        m.header.ParseFromString(hdr_proto)
+        v = m.last_commit_info.votes.add()
+        v.validator.address = b"\xDD" * 20
+        v.validator.power = 10
+        v.signed_last_block = True
+        v = m.last_commit_info.votes.add()
+        v.validator.address = b"\xEE" * 20
+        v.validator.power = 20
+        v.signed_last_block = False
+        e = m.byzantine_validators.add()
+        e.type = 1
+        e.validator.address = b"\xCC" * 20
+        e.validator.power = 10
+        e.height = 40
+        ts(e.time, 1700000050, 25)
+        e.total_voting_power = 30
+    req("begin_block", bbq, fill_bb)
+
+    req("check_tx", abci.RequestCheckTx(b"tx-bytes", abci.CheckTxType.RECHECK),
+        lambda m: (setattr(m, "tx", b"tx-bytes"), setattr(m, "type", 1)))
+    req("deliver_tx", b"deliver-me",
+        lambda m: setattr(m, "tx", b"deliver-me"))
+    req("end_block", 42, lambda m: setattr(m, "height", 42))
+    req("commit", None, lambda m: m.SetInParent())
+    req("list_snapshots", None, lambda m: m.SetInParent())
+
+    snap = abci.Snapshot(height=20, format=1, chunks=3, hash=b"\xF0" * 32,
+                         metadata=b"meta")
+
+    def fill_snap(m):
+        m.height = 20
+        m.format = 1
+        m.chunks = 3
+        m.hash = b"\xF0" * 32
+        m.metadata = b"meta"
+
+    def fill_os(m):
+        fill_snap(m.snapshot)
+        m.app_hash = b"\xF1" * 32
+    req("offer_snapshot", (snap, b"\xF1" * 32), fill_os)
+
+    req("load_snapshot_chunk", (9, 1, 2),
+        lambda m: (setattr(m, "height", 9), setattr(m, "format", 1),
+                   setattr(m, "chunk", 2)))
+    req("apply_snapshot_chunk", (2, b"chunkdata", "peer-1"),
+        lambda m: (setattr(m, "index", 2), setattr(m, "chunk", b"chunkdata"),
+                   setattr(m, "sender", "peer-1")))
+    req("prepare_proposal",
+        abci.RequestPrepareProposal(block_data=[b"a", b"bb"],
+                                    block_data_size=1000),
+        lambda m: (setattr(m, "max_tx_bytes", 1000),
+                   m.txs.extend([b"a", b"bb"])))
+
+    ppq = abci.RequestProcessProposal(txs=[b"t1", b"t22"],
+                                      header_proto=hdr_proto)
+
+    def fill_pp(m):
+        m.txs.extend([b"t1", b"t22"])
+        m.hash = H.hash()
+        m.height = H.height
+        ts(m.time, H.time.seconds, H.time.nanos)
+        m.next_validators_hash = H.next_validators_hash
+        m.proposer_address = H.proposer_address
+    req("process_proposal", ppq, fill_pp)
+
+    # ---- responses ----
+    rsp("exception", "boom", lambda m: setattr(m, "error", "boom"))
+    rsp("echo", "hello-golden",
+        lambda m: setattr(m, "message", "hello-golden"))
+    rsp("flush", None, lambda m: m.SetInParent())
+    rsp("info", abci.ResponseInfo("{\"size\":1}", "0.1.0", 1, 99,
+                                  b"\xAB" * 32),
+        lambda m: (setattr(m, "data", "{\"size\":1}"),
+                   setattr(m, "version", "0.1.0"),
+                   setattr(m, "app_version", 1),
+                   setattr(m, "last_block_height", 99),
+                   setattr(m, "last_block_app_hash", b"\xAB" * 32)))
+
+    icr = abci.ResponseInitChain(
+        consensus_params=abci.ConsensusParamsUpdate(2048, 100000),
+        validators=[abci.ValidatorUpdate("ed25519", b"\x04" * 32, 7)],
+        app_hash=b"\x05" * 32)
+
+    def fill_icr(m):
+        m.consensus_params.block.max_bytes = 2048
+        m.consensus_params.block.max_gas = 100000
+        v = m.validators.add()
+        v.pub_key.ed25519 = b"\x04" * 32
+        v.power = 7
+        m.app_hash = b"\x05" * 32
+    rsp("init_chain", icr, fill_icr)
+
+    qr = abci.ResponseQuery(code=1, log="nope", info="", index=2,
+                            key=b"key1", value=b"val1", height=7,
+                            codespace="app",
+                            proof_ops=[("ics23:iavl", b"key1", b"\x0A\x01")])
+
+    def fill_qr(m):
+        m.code = 1
+        m.log = "nope"
+        m.index = 2
+        m.key = b"key1"
+        m.value = b"val1"
+        op = m.proof_ops.ops.add()
+        op.type = "ics23:iavl"
+        op.key = b"key1"
+        op.data = b"\x0A\x01"
+        m.height = 7
+        m.codespace = "app"
+    rsp("query", qr, fill_qr)
+
+    ev = abci.Event("app", {"key": "k1", "creator": "kvstore"})
+
+    def fill_event(e, ev):
+        e.type = ev.type
+        for k, v in ev.attributes.items():
+            a = e.attributes.add()
+            a.key = k.encode()
+            a.value = v.encode()
+            a.index = True
+
+    def fill_bbr(m):
+        fill_event(m.events.add(), ev)
+    rsp("begin_block", abci.ResponseBeginBlock(events=[ev]), fill_bbr)
+
+    rsp("check_tx",
+        abci.ResponseCheckTx(code=3, data=b"d", log="l", gas_wanted=10,
+                             gas_used=5, priority=77, sender="s",
+                             codespace="cs"),
+        lambda m: (setattr(m, "code", 3), setattr(m, "data", b"d"),
+                   setattr(m, "log", "l"), setattr(m, "gas_wanted", 10),
+                   setattr(m, "gas_used", 5), setattr(m, "codespace", "cs"),
+                   setattr(m, "sender", "s"), setattr(m, "priority", 77)))
+
+    dtr = abci.ResponseDeliverTx(code=0, data=b"res", log="ok",
+                                 gas_wanted=2, gas_used=1, events=[ev],
+                                 codespace="")
+
+    def fill_dtr(m):
+        m.data = b"res"
+        m.log = "ok"
+        m.gas_wanted = 2
+        m.gas_used = 1
+        fill_event(m.events.add(), ev)
+    rsp("deliver_tx", dtr, fill_dtr)
+
+    ebr = abci.ResponseEndBlock(
+        validator_updates=[abci.ValidatorUpdate("ed25519", b"\x06" * 32, 0)],
+        consensus_param_updates=abci.ConsensusParamsUpdate(4096, -1),
+        events=[ev])
+
+    def fill_ebr(m):
+        v = m.validator_updates.add()
+        v.pub_key.ed25519 = b"\x06" * 32
+        v.power = 0
+        m.consensus_param_updates.block.max_bytes = 4096
+        m.consensus_param_updates.block.max_gas = -1
+        fill_event(m.events.add(), ev)
+    rsp("end_block", ebr, fill_ebr)
+
+    rsp("commit", abci.ResponseCommit(data=b"\x0C" * 32, retain_height=50),
+        lambda m: (setattr(m, "data", b"\x0C" * 32),
+                   setattr(m, "retain_height", 50)))
+
+    def fill_ls(m):
+        fill_snap(m.snapshots.add())
+    rsp("list_snapshots", [snap], fill_ls)
+
+    rsp("offer_snapshot",
+        abci.ResponseOfferSnapshot(
+            result=abci.ResponseOfferSnapshot.REJECT_FORMAT),
+        lambda m: setattr(m, "result", 4))
+    rsp("load_snapshot_chunk", b"chunk-bytes",
+        lambda m: setattr(m, "chunk", b"chunk-bytes"))
+    rsp("apply_snapshot_chunk",
+        abci.ResponseApplySnapshotChunk(
+            result=abci.ResponseApplySnapshotChunk.RETRY,
+            refetch_chunks=[1, 3, 5], reject_senders=["bad1", "bad2"]),
+        lambda m: (setattr(m, "result", 3),
+                   m.refetch_chunks.extend([1, 3, 5]),
+                   m.reject_senders.extend(["bad1", "bad2"])))
+    rsp("prepare_proposal", abci.ResponsePrepareProposal(block_data=[b"x"]),
+        lambda m: m.txs.extend([b"x"]))
+    rsp("process_proposal", abci.ResponseProcessProposal(accept=True),
+        lambda m: setattr(m, "status", 1))
+    return cases
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="abcigolden_")
+    pb = compile_protos(tmp)
+    cases = build_cases(pb)
+    out = {}
+    mismatches = 0
+    for name, kind, method, internal, golden_msg in cases:
+        golden = golden_msg.SerializeToString()
+        mine = (wire.encode_request(method, internal) if kind == "request"
+                else wire.encode_response(method, internal))
+        status = "OK" if mine == golden else "MISMATCH"
+        if status != "OK":
+            mismatches += 1
+            print(f"{name}: {status}")
+            print(f"  golden: {golden.hex()}")
+            print(f"  mine:   {mine.hex()}")
+        out[name] = {"kind": kind, "method": method, "hex": golden.hex()}
+    path = os.path.join(REPO, "tests", "fixtures", "abci_golden.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(f"wrote {len(out)} golden frames to {path}; "
+          f"{mismatches} encoder mismatches")
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

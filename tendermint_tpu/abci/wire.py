@@ -254,6 +254,17 @@ def encode_request(method: str, req) -> bytes:
                          + pe.bytes_field(8, hdr.proposer_address))
             except Exception:
                 pass
+        else:
+            # a request decoded off the socket carries the explicit wire
+            # fields instead of a Header; re-encode them losslessly
+            from tendermint_tpu.types.basic import Timestamp
+            body += (pe.bytes_field(4, req.hash)
+                     + pe.varint_field(5, req.height)
+                     + pe.message_field_always(
+                         6, Timestamp(req.time_seconds,
+                                      req.time_nanos).proto())
+                     + pe.bytes_field(7, req.next_validators_hash)
+                     + pe.bytes_field(8, req.proposer_address))
     else:
         raise ValueError(f"unknown ABCI method {method!r}")
     return pe.message_field_always(num, body)
@@ -336,6 +347,13 @@ def decode_request(data: bytes):
         req = abci.RequestProcessProposal(txs=pd.get_messages(b, 1))
         req.hash = pd.get_bytes(b, 4)
         req.height = pd.get_int(b, 5)
+        tsb = pd.get_message(b, 6)
+        if tsb:
+            tf = pd.parse(tsb)
+            req.time_seconds = pd.get_int(tf, 1)
+            req.time_nanos = pd.get_int(tf, 2)
+        req.next_validators_hash = pd.get_bytes(b, 7)
+        req.proposer_address = pd.get_bytes(b, 8)
         return method, req
     raise pd.ProtoError(f"unhandled request {method}")
 
