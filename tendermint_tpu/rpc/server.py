@@ -13,6 +13,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qsl, urlparse
 
+from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.types.block import Block
 
 
@@ -43,8 +44,13 @@ def _int_arg(v, default=None):
     return int(v)
 
 
-class RPCServer:
-    def __init__(self, node, laddr: str):
+class RPCServer(BaseService):
+    def __init__(self, node, laddr: str,
+                 max_body_bytes: int = MAX_BODY_BYTES):
+        super().__init__("rpc")
+        self.max_body_bytes = max_body_bytes
+        from tendermint_tpu.libs import log as tmlog
+        self.log = tmlog.logger("rpc")
         self.node = node
         host, _, port = laddr.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
@@ -87,7 +93,7 @@ class RPCServer:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self):
+    def on_start(self):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,10 +112,10 @@ class RPCServer:
                 n = int(self.headers.get("Content-Length", 0))
                 # request-size cap (reference rpc/jsonrpc/server
                 # http_server.go maxBodyBytes = 1MB)
-                if n > MAX_BODY_BYTES:
+                if n > server.max_body_bytes:
                     self._reply(server._err(
                         None, -32600,
-                        f"request body too large (> {MAX_BODY_BYTES})"))
+                        f"request body too large (> {server.max_body_bytes})"))
                     return
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -165,10 +171,11 @@ class RPCServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port  # resolve port 0
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        self.spawn(self._httpd.serve_forever, name="rpc-http")
+        self.log.info("RPC server listening", laddr=self.laddr)
 
-    def stop(self):
+    def on_stop(self):
+        self.log.info("RPC server stopping")
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -489,9 +496,9 @@ class RPCServer:
         per = min(_int_arg(per_page, 30) or 30, 100)
         pg = max(_int_arg(page, 1) or 1, 1)
         chunk = vals.validators[(pg - 1) * per: pg * per]
-        return {"block_height": h,
+        return {"block_height": str(h),
                 "validators": [self._val_json(v) for v in chunk],
-                "count": len(chunk), "total": vals.size()}
+                "count": str(len(chunk)), "total": str(vals.size())}
 
     def consensus_params(self, height=None):
         h = _int_arg(height, self.node.block_store.height())
@@ -787,10 +794,14 @@ class RPCServer:
                           "hash": bid.part_set_header.hash.hex().upper()}}
 
     def _header_json(self, h):
+        # amino-JSON dialect (libs/amino_json): int64 -> string, time ->
+        # RFC3339, so reference clients parse the response unchanged
+        from tendermint_tpu.libs import amino_json as aj
         return {
-            "version": {"block": h.version.block, "app": h.version.app},
-            "chain_id": h.chain_id, "height": h.height,
-            "time": {"seconds": h.time.seconds, "nanos": h.time.nanos},
+            "version": {"block": str(h.version.block),
+                        "app": str(h.version.app)},
+            "chain_id": h.chain_id, "height": str(h.height),
+            "time": aj.ts_rfc3339(h.time),
             "last_block_id": self._bid_json(h.last_block_id),
             "last_commit_hash": h.last_commit_hash.hex().upper(),
             "data_hash": h.data_hash.hex().upper(),
@@ -804,24 +815,37 @@ class RPCServer:
         }
 
     def _commit_json(self, c):
+        from tendermint_tpu.libs import amino_json as aj
         if c is None:
             return None
         return {
-            "height": c.height, "round": c.round,
+            "height": str(c.height), "round": c.round,
             "block_id": self._bid_json(c.block_id),
             "signatures": [{
                 "block_id_flag": int(s.block_id_flag),
                 "validator_address": s.validator_address.hex().upper(),
-                "timestamp": {"seconds": s.timestamp.seconds,
-                              "nanos": s.timestamp.nanos},
+                "timestamp": aj.ts_rfc3339(s.timestamp),
                 "signature": _b64(s.signature or b""),
             } for s in c.signatures],
         }
 
+    def _vset_json(self, vs):
+        from tendermint_tpu.libs import amino_json as aj
+        prop = vs.get_proposer()
+        return {"validators": [aj.validator_json(v)
+                               for v in vs.validators],
+                "proposer": aj.validator_json(prop) if prop else None}
+
     def _block_json(self, b: Block):
+        from tendermint_tpu.libs import amino_json as aj
         return {"header": self._header_json(b.header),
                 "data": {"txs": [_b64(t) for t in b.data.txs]},
-                "evidence": {"evidence": []},
+                # tagged amino-JSON evidence (reference
+                # types/evidence.go:529 RegisterType)
+                "evidence": {"evidence": [
+                    aj.evidence_json(ev, self._header_json,
+                                     self._commit_json, self._vset_json)
+                    for ev in b.evidence]},
                 "last_commit": self._commit_json(b.last_commit)}
 
     def _meta_json(self, m):
@@ -831,8 +855,5 @@ class RPCServer:
                 "num_txs": m.num_txs}
 
     def _val_json(self, v):
-        return {"address": v.address.hex().upper(),
-                "pub_key": {"type": v.pub_key.type_name,
-                            "value": _b64(v.pub_key.bytes())},
-                "voting_power": v.voting_power,
-                "proposer_priority": v.proposer_priority}
+        from tendermint_tpu.libs import amino_json as aj
+        return aj.validator_json(v)

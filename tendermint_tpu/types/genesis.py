@@ -68,9 +68,9 @@ class GenesisDoc:
     # -- JSON persistence --------------------------------------------------
 
     def to_json(self) -> str:
+        from tendermint_tpu.libs import amino_json as aj
         return json.dumps({
-            "genesis_time": {"seconds": self.genesis_time.seconds,
-                             "nanos": self.genesis_time.nanos},
+            "genesis_time": aj.ts_rfc3339(self.genesis_time),
             "chain_id": self.chain_id,
             "initial_height": str(self.initial_height),
             "consensus_params": {
@@ -96,8 +96,8 @@ class GenesisDoc:
             "validators": [
                 {
                     "address": v.address.hex().upper(),
-                    "pub_key": {"type": v.pub_key_type,
-                                "value": v.pub_key_bytes.hex()},
+                    "pub_key": aj.pub_key_json(v.pub_key_type,
+                                               v.pub_key_bytes),
                     "power": str(v.power),
                     "name": v.name,
                 } for v in self.validators
@@ -124,22 +124,29 @@ class GenesisDoc:
                 max_bytes=int(dcp["evidence"]["max_bytes"]))
             cp.validator = ValidatorParams(
                 pub_key_types=list(dcp["validator"]["pub_key_types"]))
+        from tendermint_tpu.libs import amino_json as aj
         gt = d.get("genesis_time", {})
+        if isinstance(gt, str):
+            # amino dialect: RFC3339 (reference genesis.json)
+            genesis_time = aj.parse_rfc3339(gt)
+        else:
+            # legacy {seconds, nanos} docs keep loading
+            genesis_time = Timestamp(int(gt.get("seconds", 0)),
+                                     int(gt.get("nanos", 0)))
+
+        def _val(v):
+            ktype, kbytes = aj.pub_key_from_json(v["pub_key"])
+            return GenesisValidator(
+                address=bytes.fromhex(v.get("address", "")),
+                pub_key_type=ktype, pub_key_bytes=kbytes,
+                power=int(v["power"]), name=v.get("name", ""))
+
         doc = cls(
             chain_id=d["chain_id"],
-            genesis_time=Timestamp(int(gt.get("seconds", 0)),
-                                   int(gt.get("nanos", 0))),
+            genesis_time=genesis_time,
             initial_height=int(d.get("initial_height", 1)),
             consensus_params=cp,
-            validators=[
-                GenesisValidator(
-                    address=bytes.fromhex(v.get("address", "")),
-                    pub_key_type=v["pub_key"]["type"],
-                    pub_key_bytes=bytes.fromhex(v["pub_key"]["value"]),
-                    power=int(v["power"]),
-                    name=v.get("name", ""),
-                ) for v in d.get("validators", [])
-            ],
+            validators=[_val(v) for v in d.get("validators", [])],
             app_hash=bytes.fromhex(d.get("app_hash", "")),
             app_state=json.dumps(d.get("app_state", {})).encode(),
         )
