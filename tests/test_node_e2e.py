@@ -69,7 +69,8 @@ def test_two_process_localnet():
             for i, port in enumerate((rpc0 + 0, rpc0 + 1)):
                 try:
                     st = _rpc(port, "status")
-                    heights[i] = st["sync_info"]["latest_block_height"]
+                    heights[i] = int(
+                        st["sync_info"]["latest_block_height"])
                 except Exception:
                     pass
             for p in procs:
@@ -81,11 +82,11 @@ def test_two_process_localnet():
         st = _rpc(rpc0, "status")
         assert st["node_info"]["network"] == "e2e-chain"
         b = _rpc(rpc0, "block", height=2)
-        assert b["block"]["header"]["height"] == 2
+        assert b["block"]["header"]["height"] == "2"
         c = _rpc(rpc0, "commit", height=2)
-        assert c["signed_header"]["commit"]["height"] == 2
+        assert c["signed_header"]["commit"]["height"] == "2"
         v = _rpc(rpc0, "validators")
-        assert v["total"] == 2
+        assert v["total"] == "2"
         ni = _rpc(rpc0, "net_info")
         assert ni["n_peers"] >= 1
 
