@@ -1,0 +1,50 @@
+"""A/B the kernel conv impls (TM_TPU_MUL) on the real chip: resident
+throughput, inputs pre-staged on device, best-of-N timed passes."""
+import os, sys, time
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+def main():
+    impl = os.environ.get("TM_TPU_MUL", "school")
+    import jax
+    import jax.numpy as jnp
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.ops import pallas_ed25519 as pe
+    assert jax.devices()[0].platform == "tpu"
+    n = 32768
+    rng = np.random.default_rng(42)
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    from cryptography.hazmat.primitives import serialization
+    keys = [Ed25519PrivateKey.from_private_bytes(
+        rng.integers(0, 256, 32, dtype=np.uint8).tobytes())
+        for _ in range(64)]
+    raws = [k.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        for k in keys]
+    pubs, sigs, msgs = [], [], []
+    for i in range(n):
+        m = b"ab %d" % i
+        pubs.append(raws[i % 64])
+        sigs.append(keys[i % 64].sign(m))
+        msgs.append(m)
+    packed, host_ok = edops.prepare_batch_packed(pubs, sigs, msgs)
+    dev = jax.device_put(jnp.asarray(packed))
+    # warm/compile
+    t0 = time.perf_counter()
+    out = pe.verify_packed_pallas(dev, tile=256)
+    out.block_until_ready()
+    print(f"{impl}: compile+first {time.perf_counter()-t0:.1f}s", flush=True)
+    assert np.asarray(out).all(), "correctness failure!"
+    best = 1e9
+    for _ in range(6):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            out = pe.verify_packed_pallas(dev, tile=256)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / 4
+        best = min(best, dt)
+    print(f"{impl}: resident {n/best:,.0f} sigs/s ({best*1e3:.1f} ms / {n})", flush=True)
+
+main()

@@ -22,6 +22,7 @@ non-elementwise op.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -34,6 +35,19 @@ from . import curve as C
 from . import field as F
 
 DEFAULT_TILE = 256  # keep in lockstep with ops.ed25519.PALLAS_TILE
+
+# Convolution implementation for _mul/_sqr: "school" (22x22 schoolbook),
+# "k2" (classic Karatsuba 11+11), or "k3" (vreg-aligned 3-block Karatsuba
+# over 8/8/6 limb blocks, 6 block-convolutions instead of 9).  The
+# Karatsuba paths need the tightened operand contract (at most one lazy
+# operand; see _mul_k3) which _dbl/_add_cached/_madd_niels establish with
+# extra lazy carries when _KMUL is set.  Bounds machine-checked in
+# tests/test_field.py::test_karatsuba_bounds_proof.
+_MUL_IMPL = os.environ.get("TM_TPU_MUL", "school")
+if _MUL_IMPL not in ("school", "k2", "k3"):
+    raise ValueError(
+        f"TM_TPU_MUL={_MUL_IMPL!r}: must be 'school', 'k2' or 'k3'")
+_KMUL = _MUL_IMPL != "school"
 
 RADIX = F.RADIX
 NLIMB = F.NLIMB
@@ -101,7 +115,7 @@ def _shift_up(x, i):
     return jnp.concatenate([x[NLIMB - i :], z], axis=0)
 
 
-def _mul(a, b):
+def _mul_school(a, b):
     """Field multiply, loose-carried output.  Same operand contract as
     field.mul (22 * |a| * |b| + folds < 2^31).
 
@@ -120,12 +134,113 @@ def _mul(a, b):
     return _reduce_wide_pair(lo, hi)
 
 
+def _shift_up_n(x, i, rows):
+    """Rows 0..i-1 take x's top i rows (the conv spill above row rows-1);
+    zero-fill below."""
+    T = x.shape[1]
+    z = jnp.zeros((rows - i, T), _i32)
+    return jnp.concatenate([x[rows - i :], z], axis=0)
+
+
+def _conv_half(a, b, rows):
+    """Schoolbook convolution of two (rows, T) operand blocks, returned as
+    the (rows, T) lo half (cols 0..rows-1) and (rows, T) hi half (cols
+    rows..2*rows-2; the last row — col 2*rows-1 — is structurally 0)."""
+    lo = b * a[0:1]
+    hi = None
+    for i in range(1, rows):
+        p = b * a[i : i + 1]
+        lo = lo + _shift_down(p, i, rows)
+        up = _shift_up_n(p, i, rows)
+        hi = up if hi is None else hi + up
+    if hi is None:
+        hi = jnp.zeros_like(lo)
+    return lo, hi
+
+
+def _mul_k2(a, b):
+    """Classic Karatsuba 11+11 split: 3 11x11 block convolutions (363
+    multiplies) instead of the 22x22 schoolbook's 484.  Operand contract
+    (VALUE bounds): max|a_limb| * max|b_limb| <= 2L * L = 42,467,328 —
+    at most one lazy operand — so the sum-block convolution zm stays
+    <= 44 * that < 2^31 and every assembled column <= 33 * that plus the
+    reduce folds (tests/test_field.py::test_karatsuba_bounds_proof)."""
+    T = a.shape[1]
+    a0, a1 = a[:11], a[11:]
+    b0, b1 = b[:11], b[11:]
+    z0lo, z0hi = _conv_half(a0, b0, 11)          # cols 0..20
+    z2lo, z2hi = _conv_half(a1, b1, 11)          # cols 22..42
+    zmlo, zmhi = _conv_half(a0 + a1, b0 + b1, 11)
+    mlo = zmlo - z0lo - z2lo                      # mid = z1, cols 11..31
+    mhi = zmhi - z0hi - z2hi
+    z11 = jnp.zeros((11, T), _i32)
+    lo = jnp.concatenate([z0lo, z0hi], axis=0)    # cols 0..21 (21 is 0)
+    lo = lo + jnp.concatenate([z11, mlo], axis=0)
+    hi = jnp.concatenate([z2lo, z2hi], axis=0)    # cols 22..43 (43 is 0)
+    hi = hi + jnp.concatenate([mhi, z11], axis=0)
+    return _reduce_wide_pair(lo, hi)
+
+
+def _mul_k3(a, b):
+    """Vreg-aligned 3-block Karatsuba over 8/8/6 limb blocks
+    (A = A0 + Y*A1 + Y^2*A2, Y = x^8): 6 block convolutions instead of
+    the 9 implied by schoolbook blocks, every block an exactly-one-vreg
+    (8, T) value and every combination offset a multiple of 8 sublanes,
+    so partial-product sublane shifts only happen inside the cheap 8-wide
+    block convs.  Same operand contract as _mul_k2 (VALUE bounds,
+    machine-checked in tests/test_field.py::test_karatsuba_bounds_proof):
+        max|a_limb| * max|b_limb| <= 2L * L = 42,467,328
+    — the sum-block convolutions (e.g. (A0+A1)(B0+B1)) stay <= 32 * that
+    and overlapping c-blocks bound every wide column by 40 * that + the
+    reduce fold terms < 2^31."""
+    T = a.shape[1]
+    z2r = jnp.zeros((2, T), _i32)
+    A = [a[0:8], a[8:16], jnp.concatenate([a[16:22], z2r], axis=0)]
+    B = [b[0:8], b[8:16], jnp.concatenate([b[16:22], z2r], axis=0)]
+    P0 = _conv_half(A[0], B[0], 8)
+    P1 = _conv_half(A[1], B[1], 8)
+    P2 = _conv_half(A[2], B[2], 8)
+    P01 = _conv_half(A[0] + A[1], B[0] + B[1], 8)
+    P12 = _conv_half(A[1] + A[2], B[1] + B[2], 8)
+    P02 = _conv_half(A[0] + A[2], B[0] + B[2], 8)
+    # coefficient blocks at column offset 8k (exact VALUES:
+    # c1 = A0B1+A1B0, c2 = A0B2+A2B0+A1B1, c3 = A1B2+A2B1)
+    c1lo = P01[0] - P0[0] - P1[0]
+    c1hi = P01[1] - P0[1] - P1[1]
+    c2lo = P02[0] - P0[0] - P2[0] + P1[0]
+    c2hi = P02[1] - P0[1] - P2[1] + P1[1]
+    c3lo = P12[0] - P1[0] - P2[0]
+    c3hi = P12[1] - P1[1] - P2[1]
+    # wide rows 0..47 assembled from vreg-aligned 8-row pieces; at most
+    # two c-blocks overlap any column (worst pair c1hi+c2lo <= 40*Ba*Bb)
+    w0 = P0[0]
+    w1 = P0[1] + c1lo
+    w2 = c1hi + c2lo
+    w3 = c2hi + c3lo
+    w4 = c3hi + P2[0]
+    w5 = P2[1]            # cols 40..46; 43.. structurally 0 (A2 has 6 rows)
+    lo = jnp.concatenate([w0, w1, w2[0:6]], axis=0)           # cols 0..21
+    hi = jnp.concatenate([w2[6:8], w3, w4, w5[0:4]], axis=0)  # cols 22..43
+    return _reduce_wide_pair(lo, hi)
+
+
+def _mul(a, b):
+    if _MUL_IMPL == "k3":
+        return _mul_k3(a, b)
+    if _MUL_IMPL == "k2":
+        return _mul_k2(a, b)
+    return _mul_school(a, b)
+
+
 def _sqr(a):
     """Field square.  Measured on v5e: the symmetric half-MAC schoolbook
     (masked shrinking operands) is SLOWER than the plain convolution —
     the per-pass operand masks cost more VPU ops than the skipped
     multiplies save (multiplies and selects have the same throughput).
-    Same operand contract as one lazy add (|limb| <= 2L = 9216)."""
+    Operand contract: |limb| <= 2L = 9216 under the schoolbook impl, but
+    LOOSE (|limb| <= L) under Karatsuba (_KMUL) — the square of a lazy
+    value busts the sum-block bound, so K call sites never square lazy
+    values (_dbl computes e via 2xy instead of sqr(x+y))."""
     return _mul(a, a)
 
 
@@ -196,11 +311,21 @@ def _dbl(x, y, z, with_t=True):
     b = _sqr(y)
     zsq = _sqr(z)
     c = zsq + zsq
-    aa = _sqr(x + y)
-    e = aa - a - b
+    if _KMUL:
+        # e = 2xy = (x+y)^2 - x^2 - y^2, but computed as a product of two
+        # LOOSE operands so it is K-eligible (sqr(x+y) would square a lazy
+        # value, busting the Karatsuba sum-block bound), and |e| <= 2L
+        # keeps e itself a valid K operand below.
+        xy = _mul(x, y)
+        e = xy + xy
+    else:
+        aa = _sqr(x + y)
+        e = aa - a - b
     g = b - a
     f = _carry_lazy(g - c)
     h = -a - b
+    if _KMUL:
+        h = _carry_lazy(h)  # K contract: lazy g x h needs h loose
     return (_mul(e, f), _mul(g, h), _mul(f, g),
             _mul(e, h) if with_t else None)
 
@@ -214,6 +339,12 @@ def _add_cached(px, py, pz, pt, q):
     d2 = d + d
     e = a - b
     f = d2 - c
+    if _KMUL:
+        # K contract: e (|.|<=5632) and f (|.|<=10240) pair with the lazy
+        # h/d2-derived operands, so both must be carried to loose first
+        # (both are within carry_lazy's 3L+2^10 input bound)
+        e = _carry_lazy(e)
+        f = _carry_lazy(f)
     g = _carry_lazy(d2 + c)
     h = a + b
     return _mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)
@@ -226,6 +357,9 @@ def _madd_niels(px, py, pz, pt, nypx, nymx, nt2d):
     d2 = pz + pz
     e = a - b
     f = d2 - c
+    if _KMUL:
+        e = _carry_lazy(e)  # same K contract as _add_cached
+        f = _carry_lazy(f)
     g = _carry_lazy(d2 + c)
     h = a + b
     return _mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)
@@ -631,6 +765,61 @@ def _kernel_packed(const_ref, in_ref, out_ref, one_scr, zero_scr, digit_scr):
     ok = _verify_tile(consts, pub_b, r_b, digit_scr,
                       one_scr[:], zero_scr[:])
     out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
+
+
+def _kernel_packed_split(const_ref, pub_ref, rsk_ref, out_ref, one_scr,
+                         zero_scr, digit_scr):
+    """Split-input variant of _kernel_packed for the device-resident
+    pubkey cache (ops/ed25519 verify_packed_split_pipelined): pub_ref is
+    the cached (32, T) pubkey rows already in HBM, rsk_ref the (96, T)
+    per-call transfer (rows 0:32 R, 32:64 s, 64:96 k) — a validator
+    set's keys are fixed across blocks, so steady-state VerifyCommit
+    ships 96 B/sig instead of 128."""
+    consts = const_ref[:]
+    pub_b = pub_ref[:].astype(_i32) & 0xFF
+    r_b = rsk_ref[0:32, :].astype(_i32) & 0xFF
+    s_b = rsk_ref[32:64, :].astype(_i32) & 0xFF
+    k_b = rsk_ref[64:96, :].astype(_i32) & 0xFF
+    T = pub_ref.shape[1]
+    one_scr[:] = jnp.broadcast_to(consts[:, _COL_ONE : _COL_ONE + 1],
+                                  (NLIMB, T))
+    zero_scr[:] = jnp.broadcast_to(consts[:, _COL_ZERO : _COL_ZERO + 1],
+                                   (NLIMB, T))
+    digit_scr[0:64, :] = _digits_from_limbs(_bytes_to_limbs12(s_b, NLIMB))
+    digit_scr[64:128, :] = _digits_from_limbs(_bytes_to_limbs12(k_b, NLIMB))
+    ok = _verify_tile(consts, pub_b, r_b, digit_scr,
+                      one_scr[:], zero_scr[:])
+    out_ref[:] = jnp.broadcast_to(ok, out_ref.shape)
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def verify_packed_split_pallas(pub_t, rsk, tile: int = DEFAULT_TILE):
+    """Batched verify with device-resident pubkeys: pub_t (32, B) int8
+    (already on device via the pub cache), rsk (96, B) int8 per-call
+    rows.  B must be a multiple of `tile`.  Returns (B,) bool."""
+    B = rsk.shape[1]
+    assert pub_t.shape == (32, B) and rsk.shape[0] == 96 and B % tile == 0
+    grid = (B // tile,)
+    out = pl.pallas_call(
+        _kernel_packed_split,
+        out_shape=jax.ShapeDtypeStruct((8, B), _i32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((NLIMB, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((96, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((NLIMB, tile), _i32),
+                        pltpu.VMEM((128, tile), _i32)],
+    )(jnp.asarray(_CONSTS_PACKED), pub_t.astype(jnp.int8),
+      rsk.astype(jnp.int8))
+    return out[0].astype(jnp.bool_)
 
 
 @partial(jax.jit, static_argnames=("tile",))

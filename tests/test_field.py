@@ -303,3 +303,91 @@ def test_carry_pass_count_proof():
     assert lo.max() < 2 ** 31 - 1, lo.max()
     b = tail(pass_bound(pass_bound(lo)))
     assert b.max() < LOOSE, b
+
+
+def test_karatsuba_bounds_proof():
+    """Machine-checked proof for the Karatsuba conv variants in
+    ops/pallas_ed25519 (_mul_k2 / _mul_k3): under the K operand contract
+        Ba * Bb <= 2L * L  (at most one lazy operand, L = 4608)
+    every intermediate VALUE fits int32.  Karatsuba intermediates cancel
+    exactly (integer arithmetic), so the proof bounds true values, not
+    sub-expression intervals: a block convolution of operand blocks with
+    per-limb bounds (ba, bb) has columns <= nterms(col) * ba * bb, and the
+    assembled wide columns are sums of the overlapping exact c-block
+    values.  Also re-checks the call-site contracts established by
+    _dbl/_add_cached/_madd_niels under _KMUL."""
+    L = 4608
+    NEG = 1 << 10          # loose values live in (-2^10, L)
+    LAZY = 2 * L           # one lazy add of loose values
+    INT32 = 2.0 ** 31
+    FOLD = F.FOLD
+    # _reduce_wide fold-first terms added into lo columns (see
+    # test_carry_pass_count_proof): FOLD*(h0+h1) + FOLD*h2 + FOLD^2*h2[-1]
+    half = 1 << (F.RADIX - 1)
+    fold_slack = FOLD * half * 2 + FOLD * 128 + FOLD * FOLD * 8
+
+    def conv_cols(n, ba, bb):
+        """Column bounds of an n x n block convolution."""
+        c = np.zeros(2 * n - 1)
+        for i in range(n):
+            for j in range(n):
+                c[i + j] += ba * bb
+        return c
+
+    def check(wide, note):
+        assert wide.max() + fold_slack < INT32, (note, wide.max())
+        # and the reduce's carry passes bring it to loose (generic
+        # contract: any int32 input -> loose, already proved)
+
+    # the worst K operand pair across all kernel call sites is
+    # (lazy, loose); enumerate every pair class actually used
+    pairs = {
+        "chain mul (loose x loose)": (L, L),
+        "decompress u-muls": (L + 1, L),
+        "e*f / a-mul (lazy x loose)": (LAZY, L),
+        "g*h' (b-a x carried)": (L + NEG, L),
+        "e*h (lazy x lazy) FORBIDDEN": None,
+    }
+    for note, pair in pairs.items():
+        if pair is None:
+            continue
+        ba, bb = pair
+        # ---- k2 (11+11): zm = conv11(a0+a1, b0+b1) is the largest
+        # intermediate; assembled lo/hi columns are z0/z2 + mid (= z1)
+        zm = conv_cols(11, 2 * ba, 2 * bb)
+        assert zm.max() < INT32, (note, zm.max())
+        z_blk = conv_cols(11, ba, bb)
+        # mid value = z1 = a0*b1 + a1*b0: 2 block convs
+        mid = 2 * z_blk
+        lo = np.zeros(22)
+        lo[:21] += z_blk                  # z0 at cols 0..20
+        lo[11:] += mid[:11]               # mid cols 11..21
+        check(lo, ("k2 lo", note))
+        hi = np.zeros(22)
+        hi[:21] += z_blk                  # z2 at cols 22..42
+        hi[:10] += mid[11:]               # mid cols 22..31
+        check(hi, ("k2 hi", note))
+        # ---- k3 (8/8/6): sum-block convs like (A0+A1)(B0+B1); c-block
+        # values c1 = A0B1+A1B0 (2 convs), c2 = A0B2+A2B0+A1B1 (3)
+        p_sum = conv_cols(8, 2 * ba, 2 * bb)
+        assert p_sum.max() < INT32, (note, p_sum.max())
+        p_blk = conv_cols(8, ba, bb)
+        cblk = {0: p_blk, 1: 2 * p_blk, 2: 3 * p_blk, 3: 2 * p_blk,
+                4: p_blk}
+        wide = np.zeros(48)
+        for k, cb in cblk.items():
+            wide[8 * k : 8 * k + 15] += cb
+        check(wide[:22], ("k3 lo", note))
+        check(wide[22:44], ("k3 hi", note))
+
+    # call-site contracts under _KMUL (operand bound propagation):
+    # _dbl: e = 2*mul(x,y) -> 2L lazy; g = b - a in (-(L+NEG), L+NEG);
+    #       f carried; h carried -> every product pair <= LAZY * L
+    assert 2 * L <= LAZY and L + NEG < LAZY
+    # _add_cached/_madd_niels inputs to carry_lazy stay within its
+    # proven 3L + 2^10 contract: f = d2 - c, g-arg = d2 + c, e = a - b,
+    # h-carry arg = -a - b
+    lazy_in = 3 * L + (1 << 10)
+    assert 2 * L + L + NEG <= lazy_in          # |d2 - c|, |d2 + c|
+    assert L + NEG <= lazy_in                  # |a - b|
+    assert 2 * L <= lazy_in                    # |-a - b|, |2xy|

@@ -26,6 +26,17 @@ def interpret_pallas(monkeypatch):
         lambda *a, **k: orig(*a, **{**k, "interpret": True}))
 
 
+@pytest.fixture(params=["school", "k2", "k3"])
+def mul_impl(request, monkeypatch):
+    """Run a test under each conv implementation (_MUL_IMPL is read at
+    trace time; clear the jit cache so the monkeypatched value retraces)."""
+    monkeypatch.setattr(pe, "_MUL_IMPL", request.param)
+    monkeypatch.setattr(pe, "_KMUL", request.param != "school")
+    jax.clear_caches()
+    yield request.param
+    jax.clear_caches()
+
+
 @pytest.mark.slow
 def test_pallas_kernel_matches_oracle_interpret(interpret_pallas):
     """Full-kernel jaxpr vs the pure-Python RFC 8032 oracle, including
@@ -56,16 +67,25 @@ def test_pallas_kernel_matches_oracle_interpret(interpret_pallas):
     assert (out == expected).all()
 
 
-def test_pallas_field_ops_match_field_module(interpret_pallas):
+def test_pallas_field_ops_match_field_module(interpret_pallas, mul_impl):
     """The in-kernel field ops (mul/sqr/carry/freeze/reduce) against the
-    ops.field reference implementation on random loose inputs."""
+    ops.field reference implementation, under every conv implementation.
+    Operands sit at each impl's contract edge: schoolbook allows two lazy
+    operands; the Karatsuba impls allow at most one (the other loose)."""
     from jax.experimental.pallas import tpu as pltpu
     from tendermint_tpu.ops import field as F
 
     T = 128
     rng = np.random.default_rng(7)
     a_np = rng.integers(-9216, 9216, (22, T), dtype=np.int32)
-    b_np = rng.integers(-9216, 9216, (22, T), dtype=np.int32)
+    if mul_impl == "school":
+        b_np = rng.integers(-9216, 9216, (22, T), dtype=np.int32)
+    else:  # K contract: second operand loose, (-2^10, L)
+        b_np = rng.integers(-1024, 4608, (22, T), dtype=np.int32)
+    # pin contract-edge extremes into fixed lanes
+    a_np[:, 0] = 9216
+    a_np[:, 1] = -9216
+    b_np[:, 0] = b_np[:, 1] = (9216 if mul_impl == "school" else 4607)
 
     def run(body):
         def kern(a_ref, b_ref, o_ref):
@@ -85,8 +105,9 @@ def test_pallas_field_ops_match_field_module(interpret_pallas):
         assert val(got, c) == val(want, c)
     assert abs(got).max() < 4608
 
-    got = run(lambda a, b: pe._sqr(a))
-    want = np.asarray(F.sqr(jnp.asarray(a_np)))
+    # sqr operand: lazy allowed under schoolbook, loose-only under K
+    got = run(lambda a, b: pe._sqr(b))
+    want = np.asarray(F.sqr(jnp.asarray(b_np)))
     for c in (0, 31, T - 1):
         assert val(got, c) == val(want, c)
 
@@ -108,6 +129,34 @@ def test_pallas_field_ops_match_field_module(interpret_pallas):
     )(jnp.asarray(a_np), jnp.asarray(two_p)))
     want = np.asarray(F.freeze(jnp.asarray(a_np)))
     assert (got == want).all()
+
+
+@pytest.mark.slow
+def test_pallas_kernel_oracle_karatsuba(interpret_pallas, mul_impl):
+    """Full-kernel jaxpr vs the RFC 8032 oracle under each conv impl —
+    exercises the K call-site carries in _dbl/_add_cached/_madd_niels
+    through decompression, the table build, and the full ladder."""
+    n = 32
+    seeds = [(1000 + i).to_bytes(32, "little") for i in range(n)]
+    msgs = [b"karatsuba oracle %d" % i for i in range(n)]
+    pubs = [_edref.pubkey_from_seed(s) for s in seeds]
+    sigs = [bytearray(_edref.sign(s, m)) for s, m in zip(seeds, msgs)]
+    sigs[7][3] ^= 1
+    sigs = [bytes(s) for s in sigs]
+    packed, host_ok = edops.prepare_batch_packed(pubs, sigs, msgs)
+    out = np.asarray(pe.verify_packed_pallas(jnp.asarray(packed), tile=32))
+    out = out & host_ok
+    expected = np.array([_edref.verify(p, m, s)
+                         for p, m, s in zip(pubs, msgs, sigs)])
+    assert (out == expected).all()
+    if mul_impl == "school":
+        # split-input kernel (device-resident pubkey cache) must agree
+        # bit-for-bit with the packed kernel on the same batch
+        pub_rows, rsk, host_ok2 = edops.prepare_batch_split(pubs, sigs, msgs)
+        out2 = np.asarray(pe.verify_packed_split_pallas(
+            jnp.asarray(pub_rows.view(np.int8)), jnp.asarray(rsk), tile=32))
+        assert (host_ok2 == host_ok).all()
+        assert ((out2 & host_ok2) == expected).all()
 
 
 def test_verify_batch_routes_by_backend():
