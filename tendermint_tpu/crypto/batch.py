@@ -199,7 +199,10 @@ def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
     n = len(pubs)
     if (n >= tpu_threshold and _use_device()
             and all(p.type_name == ed.KEY_TYPE for p in pubs)):
-        return verify_ed25519_batch([p.bytes() for p in pubs], msgs, sigs)
+        # cache_pubs: a validator set's keys recur every block, so the
+        # device keeps them resident and each commit ships 96 B/sig
+        return verify_ed25519_batch([p.bytes() for p in pubs], msgs, sigs,
+                                    cache_pubs=True)
     bv = BatchVerifier(tpu_threshold=tpu_threshold)
     for i in range(n):
         bv.add(pubs[i], msgs[i], sigs[i])
@@ -208,7 +211,8 @@ def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
 
 
 def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],
-                         sigs: Sequence[bytes]) -> np.ndarray:
+                         sigs: Sequence[bytes],
+                         cache_pubs: bool = False) -> np.ndarray:
     """Raw-bytes ed25519 batch verify on the device (malformed lengths are
     rejected host-side without poisoning the batch)."""
     n = len(pubkeys)
@@ -220,13 +224,14 @@ def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],
             return ok_len
         sub = verify_ed25519_batch([pubkeys[i] for i in good],
                                    [msgs[i] for i in good],
-                                   [sigs[i] for i in good])
+                                   [sigs[i] for i in good],
+                                   cache_pubs=cache_pubs)
         out = np.zeros(n, dtype=bool)
         out[good] = sub
         return out
-    return ed_ops_verify(pubkeys, msgs, sigs)
+    return ed_ops_verify(pubkeys, msgs, sigs, cache_pubs=cache_pubs)
 
 
-def ed_ops_verify(pubkeys, msgs, sigs) -> np.ndarray:
+def ed_ops_verify(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     from tendermint_tpu.ops import ed25519 as edops
-    return edops.verify_batch(pubkeys, msgs, sigs)
+    return edops.verify_batch(pubkeys, msgs, sigs, cache_pubs=cache_pubs)

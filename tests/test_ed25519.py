@@ -152,3 +152,59 @@ def test_digit_decomposition():
     for i, s in enumerate(scalars):
         val = sum(int(digits[i, j]) << (4 * j) for j in range(64))
         assert val == s
+
+
+def test_pub_cache_routing(monkeypatch):
+    """The device-resident pubkey cache path (verify_batch cache_pubs):
+    padding, pipelined chunking, LRU bookkeeping, and host_ok merging —
+    kernel stubbed out, so this runs fast on CPU."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu.crypto import _edref
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.ops import pallas_ed25519 as pe
+
+    calls = []
+
+    def stub(pub_t, rsk, tile=None):
+        assert pub_t.shape[0] == 32 and rsk.shape[0] == 96
+        assert pub_t.shape[1] == rsk.shape[1]
+        calls.append((pub_t.shape, rsk.shape))
+        return jnp.ones(rsk.shape[1], dtype=bool)
+
+    monkeypatch.setattr(edops, "_use_pallas", lambda: True)
+    monkeypatch.setattr(edops, "PUB_CACHE_MIN", 64)
+    monkeypatch.setattr(edops, "MAX_CHUNK", 128)
+    monkeypatch.setattr(edops, "PALLAS_TILE", 32)
+    monkeypatch.setattr(pe, "verify_packed_split_pallas", stub)
+    monkeypatch.setattr(edops, "_pub_cache", {})
+
+    n = 200
+    seeds = [(7000 + i).to_bytes(32, "little") for i in range(n)]
+    msgs = [b"cache %d" % i for i in range(n)]
+    pubs = [_edref.pubkey_from_seed(s) for s in seeds]
+    sigs = [bytearray(_edref.sign(s, m)) for s, m in zip(seeds, msgs)]
+    sigs[9] = sigs[9][:32] + b"\xff" * 32  # non-canonical s -> host_ok False
+    sigs = [bytes(s) for s in sigs]
+
+    out = edops.verify_batch(pubs, msgs, sigs, cache_pubs=True)
+    assert out.shape == (n,)
+    assert not out[9] and out.sum() == n - 1  # host_ok merged
+    # bucket(200) = 256, MAX_CHUNK 128 -> 2 pipelined chunks of 128
+    assert calls == [((32, 128), (96, 128))] * 2
+    assert len(edops._pub_cache) == 1
+    (key0, chunks0), = edops._pub_cache.items()
+    assert len(chunks0) == 2
+
+    # same set again: cache hit (same chunk objects), two more launches
+    edops.verify_batch(pubs, msgs, sigs, cache_pubs=True)
+    assert len(edops._pub_cache) == 1
+    assert edops._pub_cache[key0] is chunks0
+    assert len(calls) == 4
+
+    # 4 more distinct sets -> LRU capped at _PUB_CACHE_MAX, oldest evicted
+    for j in range(4):
+        pubs_j = [pubs[(i + j + 1) % n] for i in range(n)]
+        edops.verify_batch(pubs_j, msgs, sigs, cache_pubs=True)
+    assert len(edops._pub_cache) == edops._PUB_CACHE_MAX
+    assert key0 not in edops._pub_cache
