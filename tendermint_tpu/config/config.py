@@ -71,6 +71,10 @@ class RPCConfig:
     unsafe: bool = False  # expose dial_seeds/dial_peers (ref --rpc.unsafe)
     # request body cap (reference config/config.go:468 MaxBodyBytes)
     max_body_bytes: int = 1_000_000
+    # debug/profiling endpoint (reference config/config.go:427
+    # pprof_laddr); empty = disabled.  Serves /debug/stacks, /debug/
+    # threads, /debug/profile, /debug/gc via libs/pprof.py
+    pprof_laddr: str = ""
 
     def validate_basic(self):
         if self.max_body_bytes <= 0:

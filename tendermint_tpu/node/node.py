@@ -353,6 +353,12 @@ class Node(BaseService):
                 self, cfg.rpc.laddr,
                 max_body_bytes=cfg.rpc.max_body_bytes)
 
+        # -- pprof debug endpoint (reference config.go:427 pprof_laddr) --
+        self.pprof_server = None
+        if cfg.rpc.pprof_laddr:
+            from tendermint_tpu.libs.pprof import PprofServer
+            self.pprof_server = PprofServer(cfg.rpc.pprof_laddr)
+
         self._consensus_started = threading.Event()
 
     def _pv_address(self) -> Optional[bytes]:
@@ -408,6 +414,12 @@ class Node(BaseService):
         # sync routines via its on_start)
         if self.rpc_server is not None:
             self.rpc_server.start()
+        # SIGUSR1 stack dump works regardless of pprof_laddr (a hung node
+        # must be inspectable without prior config — libs/pprof.py)
+        from tendermint_tpu.libs.pprof import install_sigusr1
+        install_sigusr1()
+        if self.pprof_server is not None:
+            self.pprof_server.start()
 
     def _statesync_routine(self):
         """Run the syncer, persist the restored state, then hand off to
@@ -464,6 +476,8 @@ class Node(BaseService):
         self.log.info("stopping node",
                       height=self.block_store.height())
         self.indexer_service.stop()
+        if self.pprof_server is not None:
+            self.pprof_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if self._consensus_started.is_set():

@@ -1,0 +1,62 @@
+"""libs/pprof: the live-debug endpoint (reference config.go:427
+PprofListenAddress / net/http/pprof equivalent)."""
+import threading
+import time
+import urllib.request
+
+from tendermint_tpu.libs.pprof import PprofServer, format_stacks
+
+
+def _get(laddr, path):
+    try:
+        with urllib.request.urlopen(f"http://{laddr}{path}",
+                                    timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_pprof_endpoints():
+    srv = PprofServer("127.0.0.1:0")
+    srv.start()
+    try:
+        # a busy worker the profiler must observe
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                time.sleep(0.002)
+
+        t = threading.Thread(target=spin, name="pprof-test-worker",
+                             daemon=True)
+        t.start()
+
+        code, body = _get(srv.laddr, "/debug/stacks")
+        assert code == 200
+        assert "pprof-test-worker" in body and "spin" in body
+
+        code, body = _get(srv.laddr, "/debug/threads")
+        assert code == 200 and "pprof-test-worker" in body
+
+        code, body = _get(srv.laddr, "/debug/profile?seconds=0.3")
+        assert code == 200
+        # folded stacks: "frame;frame;... count" lines, worker visible
+        assert "spin" in body
+        lines = [ln for ln in body.splitlines() if ln]
+        assert all(ln.rsplit(" ", 1)[1].isdigit() for ln in lines)
+
+        code, body = _get(srv.laddr, "/debug/gc")
+        assert code == 200 and "gc counts" in body
+
+        code, body = _get(srv.laddr, "/debug/nope")
+        assert code == 404
+
+        stop.set()
+        t.join()
+    finally:
+        srv.stop()
+
+
+def test_format_stacks_includes_own_thread():
+    out = format_stacks()
+    assert "format_stacks" in out or "MainThread" in out
