@@ -15,6 +15,7 @@ per-scheme sub-batches and merge bitmaps by original index.
 """
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import os
 from dataclasses import dataclass, field
 from typing import List, Sequence, Tuple
@@ -26,6 +27,13 @@ from . import ed25519 as ed
 
 
 _backend_ok = None
+
+# single worker for the device (ed25519) lane of mixed batches: verify()
+# sits on the vote-processing hot path, so the thread is spawned once,
+# not per call.  One worker is correct: jax dispatch is serialized per
+# device anyway.
+_device_lane_pool = _cf.ThreadPoolExecutor(
+    max_workers=1, thread_name_prefix="batch-device-lane")
 
 
 def _use_device() -> bool:
@@ -129,21 +137,39 @@ class BatchVerifier:
         if n == 0:
             return True, np.zeros(0, dtype=bool)
         out = np.zeros(n, dtype=bool)
-        # dispatch per key scheme
+        # dispatch per key scheme; the device (ed25519) lane runs in a
+        # worker thread OVERLAPPED with the host C lanes — the tunnel
+        # round trip dominates the device lane and the ctypes batch
+        # verifiers release the GIL, so a mixed batch costs
+        # ~max(device lane, host lanes) instead of their sum
         by_type: dict = {}
         for i, it in enumerate(self._items):
             by_type.setdefault(it.pub.type_name, []).append(i)
+        device_lane = None  # (idxs, future)
+        host_lanes = []
         for tname, idxs in by_type.items():
             items = [self._items[i] for i in idxs]
             if (tname == ed.KEY_TYPE and _use_device()
-                    and len(items) >= self.tpu_threshold):
-                bits = verify_ed25519_batch(
+                    and len(items) >= self.tpu_threshold
+                    and device_lane is None):
+                fut = _device_lane_pool.submit(
+                    verify_ed25519_batch,
                     [it.pub.bytes() for it in items],
                     [it.msg for it in items],
                     [it.sig for it in items])
-            else:
-                bits = _host_verify_items(tname, items)
-            out[np.asarray(idxs)] = bits
+                device_lane = (idxs, fut)
+                continue
+            host_lanes.append((tname, idxs, items))
+        try:
+            for tname, idxs, items in host_lanes:
+                out[np.asarray(idxs)] = _host_verify_items(tname, items)
+        finally:
+            if device_lane is not None:
+                # always drain the future: a host-lane exception must not
+                # abandon the in-flight device RPC (both failing chains
+                # via __context__)
+                idxs, fut = device_lane
+                out[np.asarray(idxs)] = fut.result()
         # remember the valid ones so later serial re-checks are cache hits
         for i, it in enumerate(self._items):
             if out[i]:

@@ -24,6 +24,7 @@
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
 typedef uint8_t u8;
+typedef uint32_t u32;
 
 /* from staging.c (same .so): (n x 64B LE) -> (n x 32B) scalars mod l */
 void tm_mod_l(const u8 *digests, u8 *out, u64 n);
@@ -1187,9 +1188,22 @@ static inline int sc_digit(const u64 sc[4], int w, int c) {
     return (int)(d & ((1u << c) - 1));
 }
 
-static int msm_window_bits(u64 m) {
-    return m < 8 ? 3 : m < 32 ? 5 : m < 128 ? 7 : m < 512 ? 8
-                 : m < 2048 ? 9 : 11;
+/* window width minimizing the modeled cost: per window, m bucket
+ * accumulations (mixed/niels add) plus the 2*2^c bucket-sum additions
+ * (full adds: the tot+=sum chain runs over every bucket).  The old
+ * fixed table picked c=11 at m=2049, where the bucket-sum pass alone
+ * (24 windows x 4096 full adds) cost ~2x the accumulation — measured
+ * ~40% of the whole batch verify wasted. */
+static int msm_window_bits(u64 m, int acc_cost, int full_cost) {
+    int best = 3;
+    double bestc = 1e300;
+    for (int c = 3; c <= 13; c++) {
+        int nw = (256 + c - 1) / c;
+        double cost = (double)nw * ((double)m * acc_cost +
+                                    (double)(2ull << c) * full_cost);
+        if (cost < bestc) { bestc = cost; best = c; }
+    }
+    return best;
 }
 
 /* --------------------------------------------------- secp256k1 batch */
@@ -1225,32 +1239,138 @@ static void jadd_mixed(jpt *r, const jpt *a, const jpt *b) {
     r->x = x3; r->y = y3; r->z = z3; r->inf = 0;
 }
 
-/* Pippenger multi-scalar multiplication; pts are affine (z=1) */
+/* Pippenger multi-scalar multiplication; pts are affine (z=1).
+ *
+ * Bucket accumulation runs in AFFINE coordinates with Montgomery
+ * batch inversion: each pass selects at most one pending addition per
+ * bucket (affine adds into the same bucket are order-dependent),
+ * batches all chord/tangent denominators, inverts the product once,
+ * and completes every add with ~1S+2M plus a 3M inversion share —
+ * versus 8M+3S for the mixed-Jacobian accumulate it replaces.  Equal-x
+ * pairs are handled exactly: tangent doubling (den = 2y; y != 0 on
+ * secp256k1 — no 2-torsion) or bucket annihilation (P + (-P) empties
+ * the bucket). */
+typedef struct { fe256 x, y; u8 ex; } apt;
+
 static void secp_msm(jpt *out, const jpt *pts, const u64 (*scs)[4],
                      u64 m) {
-    int c = msm_window_bits(m);
+    int c = msm_window_bits(m, 6, 16);  /* affine-batched acc ~6M */
     int nw = (256 + c - 1) / c;
     int nb = 1 << c;
-    jpt *buckets = malloc((u64)nb * sizeof(jpt));
+    u64 nwnb = (u64)nw * nb;
+    /* ALL windows' buckets accumulate simultaneously: digit streams of
+     * different windows are independent, so one Montgomery pass batches
+     * up to nw*nb additions behind a single inversion — per-window
+     * passes only reached ~nb and the ~320M fe_pow ate the affine
+     * savings (measured). */
+    apt *buckets = malloc(nwnb * sizeof(apt));
+    int *pend_b = malloc(nwnb * sizeof(int));
+    const jpt **pend_p = malloc(nwnb * sizeof(jpt *));
+    u8 *pend_dbl = malloc(nwnb);
+    fe256 *den = malloc(nwnb * sizeof(fe256));
+    fe256 *pref = malloc((nwnb + 1) * sizeof(fe256));
+    u64 maxwork = (m ? m : 1) * (u64)nw;
+    u32 *work = malloc(maxwork * sizeof(u32));
+    u32 *defer = malloc(maxwork * sizeof(u32));
+    u8 *busy = malloc(nwnb);
+    for (u64 b = 0; b < nwnb; b++) buckets[b].ex = 0;
+    /* worklist item = i * nw + w (point-major: a pass touches each
+     * pts[i] for several windows back to back — cache-friendly) */
+    u64 nwork = 0;
+    for (u64 i = 0; i < m; i++)
+        for (int w = 0; w < nw; w++)
+            if (sc_digit(scs[i], w, c)) work[nwork++] = (u32)(i * nw + w);
+    while (nwork) {
+        memset(busy, 0, nwnb);
+        u64 npend = 0, ndefer = 0;
+        for (u64 t = 0; t < nwork; t++) {
+            u32 item = work[t];
+            u64 i = item / (u32)nw;
+            int w = (int)(item % (u32)nw);
+            int d = sc_digit(scs[i], w, c);
+            u64 slot = (u64)w * nb + d;
+            apt *bk = &buckets[slot];
+            if (busy[slot]) { defer[ndefer++] = item; continue; }
+            busy[slot] = 1;
+            if (!bk->ex) {              /* first landing: plain copy-in */
+                bk->x = pts[i].x;
+                bk->y = pts[i].y;
+                bk->ex = 1;
+                continue;
+            }
+            if (fe_eq(&bk->x, &pts[i].x)) {
+                if (fe_eq(&bk->y, &pts[i].y)) {
+                    fe_add(&den[npend], &bk->y, &bk->y);  /* tangent: 2y */
+                    pend_dbl[npend] = 1;
+                } else {                /* P + (-P): bucket empties */
+                    bk->ex = 0;
+                    continue;
+                }
+            } else {
+                fe_sub(&den[npend], &pts[i].x, &bk->x);
+                pend_dbl[npend] = 0;
+            }
+            pend_b[npend] = (int)slot;
+            pend_p[npend] = &pts[i];
+            npend++;
+        }
+        if (npend) {                    /* one inversion for the pass */
+            pref[0] = (fe256){{1, 0, 0, 0}};
+            for (u64 k = 0; k < npend; k++)
+                fe_mul(&pref[k + 1], &pref[k], &den[k]);
+            fe256 inv_all;
+            fe_pow(&inv_all, &pref[npend], SECP_INV_E);
+            for (long long k = (long long)npend - 1; k >= 0; k--) {
+                fe256 invk, lam, num, t2, x3, y3;
+                fe_mul(&invk, &inv_all, &pref[k]);
+                fe_mul(&inv_all, &inv_all, &den[k]);
+                apt *bk = &buckets[pend_b[k]];
+                const jpt *p = pend_p[k];
+                if (pend_dbl[k]) {      /* tangent: num = 3x^2 */
+                    fe_sqr(&num, &bk->x);
+                    fe_add(&t2, &num, &num);
+                    fe_add(&num, &t2, &num);
+                } else {                /* chord: num = y2 - y1 */
+                    fe_sub(&num, &p->y, &bk->y);
+                }
+                fe_mul(&lam, &num, &invk);
+                fe_sqr(&x3, &lam);
+                fe_sub(&x3, &x3, &bk->x);
+                fe_sub(&x3, &x3, &p->x);  /* dbl: p->x == bk->x */
+                fe_sub(&t2, &bk->x, &x3);
+                fe_mul(&y3, &lam, &t2);
+                fe_sub(&y3, &y3, &bk->y);
+                bk->x = x3;
+                bk->y = y3;
+            }
+        }
+        memcpy(work, defer, ndefer * sizeof(u32));
+        nwork = ndefer;
+    }
+    /* horner over windows: acc = sum_w 2^(cw) * window_sum(w) */
     jpt acc;
     acc.inf = 1;
     for (int w = nw - 1; w >= 0; w--) {
         if (!acc.inf)
             for (int k = 0; k < c; k++) jdbl(&acc, &acc);
-        for (int b = 1; b < nb; b++) buckets[b].inf = 1;
-        for (u64 i = 0; i < m; i++) {
-            int d = sc_digit(scs[i], w, c);
-            if (d) jadd_mixed(&buckets[d], &buckets[d], &pts[i]);
-        }
         jpt sum, tot;
         sum.inf = 1; tot.inf = 1;
         for (int b = nb - 1; b >= 1; b--) {
-            jadd(&sum, &sum, &buckets[b]);
+            apt *bk = &buckets[(u64)w * nb + b];
+            if (bk->ex) {
+                jpt bj;
+                bj.x = bk->x;
+                bj.y = bk->y;
+                bj.z = (fe256){{1, 0, 0, 0}};
+                bj.inf = 0;
+                jadd_mixed(&sum, &sum, &bj);
+            }
             jadd(&tot, &tot, &sum);
         }
         jadd(&acc, &acc, &tot);
     }
-    free(buckets);
+    free(buckets); free(pend_b); free(pend_p); free(pend_dbl);
+    free(den); free(pref); free(work); free(defer); free(busy);
     *out = acc;
 }
 
@@ -1432,7 +1552,7 @@ static void ept_add_niels(ept *r, const ept *p, const nept *q) {
 
 static void ept_msm(ept *out, const nept *pts, const u64 (*scs)[4],
                     u64 m) {
-    int c = msm_window_bits(m);
+    int c = msm_window_bits(m, 8, 9);  /* niels add 8M, full add 9M */
     int nw = (256 + c - 1) / c;
     int nb = 1 << c;
     ept *buckets = malloc((u64)nb * sizeof(ept));
