@@ -223,6 +223,12 @@ def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
     commit would evict the live-vote window; callers that need cache
     population use BatchVerifier)."""
     n = len(pubs)
+    if isinstance(pubs, np.ndarray):
+        # (n, 32) raw ed25519 pubkey matrix — the validator-set fast
+        # path (types/validator_set._pub_matrix): no per-key objects
+        if n >= tpu_threshold and _use_device():
+            return verify_ed25519_batch(pubs, msgs, sigs, cache_pubs=True)
+        pubs = [ed.PubKey(bytes(p)) for p in pubs]
     if (n >= tpu_threshold and _use_device()
             and all(p.type_name == ed.KEY_TYPE for p in pubs)):
         # cache_pubs: a validator set's keys recur every block, so the
@@ -242,8 +248,12 @@ def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],
     """Raw-bytes ed25519 batch verify on the device (malformed lengths are
     rejected host-side without poisoning the batch)."""
     n = len(pubkeys)
-    ok_len = np.array([
-        len(pubkeys[i]) == 32 and len(sigs[i]) == 64 for i in range(n)])
+    if isinstance(pubkeys, np.ndarray):   # (n, 32): shape-guaranteed
+        ok_len = np.fromiter((len(sigs[i]) == 64 for i in range(n)),
+                             dtype=bool, count=n)
+    else:
+        ok_len = np.array([
+            len(pubkeys[i]) == 32 and len(sigs[i]) == 64 for i in range(n)])
     if not ok_len.all():
         good = np.flatnonzero(ok_len)
         if good.size == 0:

@@ -47,6 +47,13 @@ class RaggedBytes:
     def lengths(self) -> np.ndarray:
         return (self.offsets[1:] - self.offsets[:-1]).astype(np.int64)
 
+    def slice(self, a: int, b: int) -> "RaggedBytes":
+        """Zero-copy sub-range [a, b) (chunked staging pipelines)."""
+        base = self.offsets[a]
+        return RaggedBytes(
+            self.buf[int(base):int(self.offsets[b])],
+            (self.offsets[a:b + 1] - base).astype(np.uint64))
+
     def fixed_width(self) -> np.ndarray | None:
         """(n, w) uint8 view when every message has the same length w
         (the fixed-width fast path of native.sha512_prefixed), else None."""

@@ -174,7 +174,7 @@ def test_pub_cache_routing(monkeypatch):
 
     monkeypatch.setattr(edops, "_use_pallas", lambda: True)
     monkeypatch.setattr(edops, "PUB_CACHE_MIN", 64)
-    monkeypatch.setattr(edops, "MAX_CHUNK", 128)
+    monkeypatch.setattr(edops, "SPLIT_CHUNK", 128)
     monkeypatch.setattr(edops, "PALLAS_TILE", 32)
     monkeypatch.setattr(pe, "verify_packed_split_pallas", stub)
     monkeypatch.setattr(edops, "_pub_cache", {})
@@ -190,7 +190,7 @@ def test_pub_cache_routing(monkeypatch):
     out = edops.verify_batch(pubs, msgs, sigs, cache_pubs=True)
     assert out.shape == (n,)
     assert not out[9] and out.sum() == n - 1  # host_ok merged
-    # bucket(200) = 256, MAX_CHUNK 128 -> 2 pipelined chunks of 128
+    # bucket(200) = 256, SPLIT_CHUNK 128 -> 2 pipelined chunks of 128
     assert calls == [((32, 128), (96, 128))] * 2
     assert len(edops._pub_cache) == 1
     (key0, chunks0), = edops._pub_cache.items()
