@@ -21,9 +21,13 @@ import numpy as np
 
 BATCH = 1 << 16  # 65536 lanes per launch
 ROUNDS = 6
-# dispatch schemes tried per pass: monolithic (1) and 4-way sub-batch
-# transfer/compute pipelining (ops/ed25519.verify_packed_pipelined)
-SCHEMES = (1, 4)
+# dispatch schemes tried per pass: monolithic (1), 4-way sub-batch
+# transfer/compute pipelining (ops/ed25519.verify_packed_pipelined), and
+# the chunk-staged device-resident-pubkey pipeline ("split",
+# ops/ed25519.split_chunked_launch — 96 B/sig on the wire with staging
+# interleaved per chunk; the steady-state protocol shape, where a
+# validator set's keys are fixed across blocks)
+SCHEMES = (1, 4, "split")
 # stop retrying once e2e reaches this fraction of the resident-kernel
 # rate; measured best pipelined passes sit at ~0.85-0.95 of resident, so
 # stopping at 0.85 was leaving throughput on the table
@@ -84,6 +88,12 @@ def main():
                 return [pe.verify_packed_pallas(jnp.asarray(packed),
                                                 tile=edops.PALLAS_TILE)]
             return edops.verify_packed_pipelined(packed, nsub=nsub)
+
+        def launch_split():
+            # stages internally (per chunk, overlapped with the kernels)
+            outs, sok, _ = edops.split_chunked_launch(pubs, msgs, sigs)
+            assert sok.all()
+            return outs
     else:
         prepare = edops.prepare_batch
 
@@ -91,11 +101,18 @@ def main():
             return [edops.verify_kernel(
                 **{k: jnp.asarray(v) for k, v in dev.items()})]
 
-    # warmup/compile (both lane-count buckets: monolithic + sub-batch)
+        launch_split = None
+
+    schemes = tuple(s for s in SCHEMES
+                    if s != "split" or launch_split is not None)
+
+    # warmup/compile (all lane-count buckets: monolithic, sub-batch,
+    # and the split-path chunk size; also uploads the pub cache)
     dev, host_ok = prepare(pubs, sigs, msgs)
     assert host_ok.all()
-    for nsub in SCHEMES:
-        for out in launch(dev, nsub):
+    for nsub in schemes:
+        outs = launch_split() if nsub == "split" else launch(dev, nsub)
+        for out in outs:
             out.block_until_ready()
             assert np.asarray(out).all(), "kernel rejected valid signatures"
 
@@ -140,26 +157,34 @@ def main():
     t_budget = time.time() + budget_s
     all_outs = []
     e2e_rate = 0.0
+    scheme_best = {s: 0.0 for s in schemes}
     with ThreadPoolExecutor(1) as pool:
         npass = 0
-        while npass < 2 * len(SCHEMES) or \
+        while npass < 2 * len(schemes) or \
                 (time.time() < t_budget
                  and e2e_rate < PLATEAU * resident_rate):
-            nsub = SCHEMES[npass % len(SCHEMES)]
+            nsub = schemes[npass % len(schemes)]
             npass += 1
             t0 = time.perf_counter()
             outs = []
-            fut = pool.submit(prepare, pubs, sigs, msgs)
-            for r in range(ROUNDS):
-                dev, host_ok = fut.result()
-                if r + 1 < ROUNDS:
-                    fut = pool.submit(prepare, pubs, sigs, msgs)
-                outs += launch(dev, nsub)
+            if nsub == "split":
+                # staging happens inside, chunk-interleaved with the
+                # kernels; successive rounds pipeline on the device queue
+                for r in range(ROUNDS):
+                    outs += launch_split()
+            else:
+                fut = pool.submit(prepare, pubs, sigs, msgs)
+                for r in range(ROUNDS):
+                    dev, host_ok = fut.result()
+                    if r + 1 < ROUNDS:
+                        fut = pool.submit(prepare, pubs, sigs, msgs)
+                    outs += launch(dev, nsub)
             # one device stream executes launches in order: blocking on
             # the last covers all rounds with a single tunnel round trip
             outs[-1].block_until_ready()
-            e2e_rate = max(e2e_rate,
-                           ROUNDS * BATCH / (time.perf_counter() - t0))
+            rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+            scheme_best[nsub] = max(scheme_best[nsub], rate)
+            e2e_rate = max(e2e_rate, rate)
             all_outs += outs
             # checking results inside the loop would serialize a readback
             # into the next pass; spot-check per pass AFTER its clock
@@ -179,6 +204,7 @@ def main():
     print(f"# cpu_baseline={cpu_rate:.0f}/s platform="
           f"{jax.devices()[0].platform} passes={npass} "
           f"resident={resident_rate:.0f}/s "
+          f"scheme_best={ {str(k): round(v) for k, v in scheme_best.items()} } "
           f"total_bench_s={time.time()-t_start:.0f}",
           file=sys.stderr)
 

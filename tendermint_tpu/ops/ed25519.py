@@ -517,13 +517,18 @@ def _msgs_slice(msgs, a: int, b: int):
     return msgs[a:b]
 
 
-def _verify_split_chunked(pubkeys, msgs, sigs) -> np.ndarray:
-    """Cache-path verify with a three-stage pipeline: while the kernel
+def split_chunked_launch(pubkeys, msgs, sigs):
+    """Cache-path launcher with a three-stage pipeline: while the kernel
     runs chunk j, the host stages chunk j+1 (C challenge hashing +
     packing) and its DMA proceeds — so for big batches (100k-validator
     VerifyCommit) staging AND transfer hide behind compute and the wall
     clock approaches the kernel floor.  Pubkey rows come from the
-    device-resident cache (96 B/sig on the wire)."""
+    device-resident cache (96 B/sig on the wire).
+
+    NON-BLOCKING: returns (outs, host_ok, n) where outs is the list of
+    per-chunk device result arrays still in flight — callers that
+    pipeline multiple batches (bench.py) block once at the end; the
+    verify_batch wrapper below blocks immediately."""
     import jax
 
     from . import pallas_ed25519 as pe
@@ -569,8 +574,13 @@ def _verify_split_chunked(pubkeys, msgs, sigs) -> np.ndarray:
             # device_put is issued after the dispatch so the DMA also
             # overlaps (same scheme as verify_packed_pipelined)
             nxt = jax.device_put(stage(j + 1), dev)
-    out = outs[0] if nsub == 1 else jnp.concatenate(outs)
-    return np.asarray(out)[:n] & host_ok[:n]
+    return outs, host_ok[:n], n
+
+
+def _verify_split_chunked(pubkeys, msgs, sigs) -> np.ndarray:
+    outs, host_ok, n = split_chunked_launch(pubkeys, msgs, sigs)
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    return np.asarray(out)[:n] & host_ok
 
 
 def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
