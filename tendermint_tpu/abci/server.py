@@ -89,28 +89,9 @@ class ABCIServer:
                     wire.write_frame(conn,
                                      wire.encode_response("exception", e))
                     continue
-                if method == "echo":
-                    wire.write_frame(conn,
-                                     wire.encode_response("echo", req))
-                    continue
-                if method == "flush":
-                    wire.write_frame(conn,
-                                     wire.encode_response("flush", None))
-                    continue
                 try:
                     with self._app_lock:
-                        if method == "deliver_tx":
-                            resp = self.app.deliver_tx(req)
-                        elif method == "end_block":
-                            resp = self.app.end_block(req)
-                        elif method in ("commit", "list_snapshots"):
-                            resp = getattr(self.app, method)()
-                        elif method in ("offer_snapshot",
-                                        "load_snapshot_chunk",
-                                        "apply_snapshot_chunk"):
-                            resp = getattr(self.app, method)(*req)
-                        else:
-                            resp = getattr(self.app, method)(req)
+                        resp = dispatch_request(self.app, method, req)
                 except Exception as e:  # noqa: BLE001 - app bug -> exception
                     wire.write_frame(conn,
                                      wire.encode_response("exception", e))
@@ -120,3 +101,22 @@ class ABCIServer:
             pass
         finally:
             conn.close()
+
+
+def dispatch_request(app: abci.Application, method: str, req):
+    """Apply one decoded request to the application — the per-method
+    argument shapes shared by the socket and gRPC transports."""
+    if method == "echo":
+        return req
+    if method == "flush":
+        return None
+    if method == "deliver_tx":
+        return app.deliver_tx(req)
+    if method == "end_block":
+        return app.end_block(req)
+    if method in ("commit", "list_snapshots"):
+        return getattr(app, method)()
+    if method in ("offer_snapshot", "load_snapshot_chunk",
+                  "apply_snapshot_chunk"):
+        return getattr(app, method)(*req)
+    return getattr(app, method)(req)

@@ -104,7 +104,7 @@ def _load_app(spec: str):
             except ValueError:
                 raise SystemExit(f"bad snapshots interval in {spec!r}")
         return app
-    if spec.startswith(("unix://", "tcp://")):
+    if spec.startswith(("unix://", "tcp://", "grpc://")):
         from tendermint_tpu.proxy import AppConns, ClientCreator
         return AppConns(ClientCreator.remote(spec))
     mod, _, fn = spec.partition(":")
@@ -508,11 +508,17 @@ def cmd_e2e(args):
 
 def cmd_abci_kvstore(args):
     """Run the example kvstore as a standalone ABCI server process
-    (reference abci/cmd/abci-cli kvstore)."""
+    (reference abci/cmd/abci-cli kvstore); grpc:// addresses serve the
+    gRPC transport (reference --abci grpc)."""
     from tendermint_tpu.abci.kvstore import KVStoreApplication
-    from tendermint_tpu.abci.server import ABCIServer
 
-    srv = ABCIServer(KVStoreApplication(), args.address)
+    if args.address.startswith("grpc://"):
+        from tendermint_tpu.abci.grpc import GRPCServer
+        srv = GRPCServer(KVStoreApplication(),
+                         args.address[len("grpc://"):])
+    else:
+        from tendermint_tpu.abci.server import ABCIServer
+        srv = ABCIServer(KVStoreApplication(), args.address)
     srv.start()
     print(f"ABCI kvstore serving on {srv.addr}", flush=True)
     try:

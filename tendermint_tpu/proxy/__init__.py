@@ -25,6 +25,16 @@ class ClientCreator:
 
     @classmethod
     def remote(cls, addr: str) -> "ClientCreator":
+        """grpc://host:port selects the gRPC transport (reference
+        proxy/client.go NewRemoteClientCreator transport arg);
+        unix:///tcp:// the proto-framed socket transport."""
+        if addr.startswith("grpc://"):
+            target = addr[len("grpc://"):]
+
+            def make():
+                from tendermint_tpu.abci.grpc import GRPCClient
+                return GRPCClient(target)
+            return cls(make)
         return cls(lambda: SocketClient(addr))
 
     def new_client(self) -> abci.Application:
