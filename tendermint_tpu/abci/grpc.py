@@ -13,10 +13,9 @@ the service is declared by (method, payload codec) pairs against
 """
 from __future__ import annotations
 
-from concurrent import futures
-
 import grpc
 
+from tendermint_tpu.libs import grpc_util
 from tendermint_tpu.libs import log as tmlog
 from tendermint_tpu.libs import protodec as pd
 from tendermint_tpu.libs.service import BaseService
@@ -124,23 +123,12 @@ class GRPCServer(BaseService):
                 _logger.error("app raised", method=oneof, err=str(e))
                 ctx.abort(grpc.StatusCode.INTERNAL, str(e))
 
-        return grpc.unary_unary_rpc_method_handler(
-            unary,
-            request_deserializer=lambda b: b,
-            response_serializer=lambda b: b)
+        return grpc_util.raw_unary_handler(unary)
 
     def on_start(self):
         handlers = {m: self._handler(o) for m, o in _METHODS}
-        self._server = grpc.server(futures.ThreadPoolExecutor(
-            max_workers=self._max_workers, thread_name_prefix="abci-grpc"))
-        self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
-        port = self._server.add_insecure_port(self._addr)
-        if port == 0:
-            raise OSError(f"cannot bind gRPC ABCI server at {self._addr}")
-        host = self._addr.rsplit(":", 1)[0]
-        self._addr = f"{host}:{port}"
-        self._server.start()
+        self._server, self._addr = grpc_util.serve_generic(
+            SERVICE, handlers, self._addr, self._max_workers, "abci-grpc")
         _logger.info("ABCI gRPC server up", addr=self._addr)
 
     def on_stop(self):
@@ -155,22 +143,14 @@ class GRPCClient(abci.Application):
 
     def __init__(self, addr: str, connect_timeout: float = 10.0):
         self.addr = addr
-        self._channel = grpc.insecure_channel(addr)
         try:
-            grpc.channel_ready_future(self._channel).result(
-                timeout=connect_timeout)
-        except grpc.FutureTimeoutError:
-            self._channel.close()
+            self._channel = grpc_util.connect_channel(
+                addr, connect_timeout, "gRPC ABCI app")
+        except ConnectionError as e:
             from .client import ABCIClientError
-            raise ABCIClientError(
-                f"cannot connect to gRPC app at {addr} "
-                f"within {connect_timeout}s")
-        self._stubs = {}
-        for m, oneof in _METHODS:
-            self._stubs[oneof] = self._channel.unary_unary(
-                f"/{SERVICE}/{m}",
-                request_serializer=lambda b: b,
-                response_deserializer=lambda b: b)
+            raise ABCIClientError(str(e))
+        self._stubs = {oneof: grpc_util.raw_stub(self._channel, SERVICE, m)
+                       for m, oneof in _METHODS}
 
     def close(self):
         self._channel.close()

@@ -75,10 +75,17 @@ class RPCConfig:
     # pprof_laddr); empty = disabled.  Serves /debug/stacks, /debug/
     # threads, /debug/profile, /debug/gc via libs/pprof.py
     pprof_laddr: str = ""
+    # gRPC broadcast API (reference config/config.go GRPCListenAddress
+    # "grpc_laddr"); empty = disabled.  rpc/grpc_api.py BroadcastAPI
+    grpc_laddr: str = ""
 
     def validate_basic(self):
         if self.max_body_bytes <= 0:
             raise ValueError("rpc.max_body_bytes must be positive")
+        if self.grpc_laddr and not self.enabled:
+            raise ValueError(
+                "rpc.grpc_laddr requires the RPC server (rpc.enabled): "
+                "BroadcastTx routes through broadcast_tx_commit")
 
 
 @dataclass
@@ -223,6 +230,8 @@ laddr = "{self._q(self.rpc.laddr)}"
 enabled = {str(self.rpc.enabled).lower()}
 unsafe = {str(self.rpc.unsafe).lower()}
 max_body_bytes = {self.rpc.max_body_bytes}
+pprof_laddr = "{self._q(self.rpc.pprof_laddr)}"
+grpc_laddr = "{self._q(self.rpc.grpc_laddr)}"
 
 [block_sync]
 enable = {str(self.block_sync.enable).lower()}
@@ -293,7 +302,9 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
                             enabled=r.get("enabled", True),
                             unsafe=r.get("unsafe", False),
                             max_body_bytes=int(
-                                r.get("max_body_bytes", 1_000_000)))
+                                r.get("max_body_bytes", 1_000_000)),
+                            pprof_laddr=r.get("pprof_laddr", ""),
+                            grpc_laddr=r.get("grpc_laddr", ""))
         bs = d.get("block_sync", {})
         cfg.block_sync = BlockSyncConfig(enable=bs.get("enable", True))
         ti = d.get("tx_index", {})

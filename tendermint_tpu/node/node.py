@@ -359,6 +359,13 @@ class Node(BaseService):
             from tendermint_tpu.libs.pprof import PprofServer
             self.pprof_server = PprofServer(cfg.rpc.pprof_laddr)
 
+        # -- gRPC broadcast API (reference config.go grpc_laddr) ---------
+        self.grpc_server = None
+        if cfg.rpc.grpc_laddr and self.rpc_server is not None:
+            from tendermint_tpu.rpc.grpc_api import GRPCBroadcastServer
+            self.grpc_server = GRPCBroadcastServer(self.rpc_server,
+                                                   cfg.rpc.grpc_laddr)
+
         self._consensus_started = threading.Event()
 
     def _pv_address(self) -> Optional[bytes]:
@@ -420,6 +427,8 @@ class Node(BaseService):
         install_sigusr1()
         if self.pprof_server is not None:
             self.pprof_server.start()
+        if self.grpc_server is not None:
+            self.grpc_server.start()
 
     def _statesync_routine(self):
         """Run the syncer, persist the restored state, then hand off to
@@ -476,6 +485,8 @@ class Node(BaseService):
         self.log.info("stopping node",
                       height=self.block_store.height())
         self.indexer_service.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.pprof_server is not None:
             self.pprof_server.stop()
         if self.rpc_server is not None:

@@ -82,3 +82,28 @@ def test_manifest_per_node_overrides(tmp_path):
     assert cfg0.consensus.timeout_commit == 1.25
     assert cfg1.mempool.size == 5000
     assert cfg1.consensus.timeout_commit == m.timeout_commit
+
+
+def test_rpc_aux_laddrs_roundtrip(tmp_path):
+    """pprof_laddr / grpc_laddr survive save() -> load() (they gate the
+    debug endpoint and the gRPC broadcast API)."""
+    from tendermint_tpu.config.config import Config
+
+    cfg = Config(home=str(tmp_path))
+    cfg.ensure_dirs()
+    cfg.rpc.pprof_laddr = "127.0.0.1:6060"
+    cfg.rpc.grpc_laddr = "127.0.0.1:26660"
+    cfg.save()
+    cfg2 = Config.load(str(tmp_path))
+    assert cfg2.rpc.pprof_laddr == "127.0.0.1:6060"
+    assert cfg2.rpc.grpc_laddr == "127.0.0.1:26660"
+
+
+def test_grpc_laddr_requires_rpc_enabled(tmp_path):
+    import pytest
+
+    from tendermint_tpu.config.config import RPCConfig
+
+    rc = RPCConfig(grpc_laddr="127.0.0.1:26660", enabled=False)
+    with pytest.raises(ValueError, match="grpc_laddr"):
+        rc.validate_basic()

@@ -1,0 +1,70 @@
+"""gRPC broadcast API (reference rpc/grpc: Ping + BroadcastTx with
+broadcast_tx_commit semantics)."""
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.rpc.grpc_api import (GRPCBroadcastClient,
+                                         GRPCBroadcastServer,
+                                         _dec_broadcast_response,
+                                         _enc_broadcast_response)
+
+
+def test_broadcast_response_codec_roundtrip():
+    ct = abci.ResponseCheckTx(code=0, log="ok")
+    dt = abci.ResponseDeliverTx(code=3, log="bad key")
+    data = _enc_broadcast_response(ct, dt)
+    ct2, dt2 = _dec_broadcast_response(data)
+    assert ct2.code == 0 and ct2.log == "ok"
+    assert dt2.code == 3 and dt2.log == "bad key"
+
+
+class _FakeRPC:
+    """Stands in for rpc/server.RPCServer's handler surface."""
+
+    def __init__(self):
+        self.seen = []
+
+    def broadcast_tx_commit(self, tx=None, timeout=30.0):
+        import base64
+        self.seen.append(base64.b64decode(tx))
+        return {"check_tx": {"code": 0},
+                "deliver_tx": {"code": 0, "log": "committed"},
+                "hash": "AA", "height": 5}
+
+
+def test_grpc_broadcast_server_client():
+    rpc = _FakeRPC()
+    srv = GRPCBroadcastServer(rpc, "127.0.0.1:0")
+    srv.start()
+    try:
+        cli = GRPCBroadcastClient(srv.addr)
+        cli.ping()
+        ct, dt = cli.broadcast_tx(b"k=v")
+        assert ct.code == 0
+        assert dt.code == 0 and dt.log == "committed"
+        assert rpc.seen == [b"k=v"]
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_grpc_broadcast_error_maps_to_status():
+    import grpc as _grpc
+
+    class Boom:
+        def broadcast_tx_commit(self, tx=None, timeout=30.0):
+            raise RuntimeError("mempool is full")
+
+    srv = GRPCBroadcastServer(Boom(), "127.0.0.1:0")
+    srv.start()
+    try:
+        cli = GRPCBroadcastClient(srv.addr)
+        with pytest.raises(_grpc.RpcError, match="mempool is full"):
+            cli.broadcast_tx(b"x")
+        cli.close()
+    finally:
+        srv.stop()
