@@ -101,46 +101,72 @@ def _derive(passphrase: str, salt: bytes) -> bytes:
 
 
 def encrypt_armor_priv_key(priv_bytes: bytes, passphrase: str,
-                           key_type: str = "ed25519") -> str:
+                           key_type: str = "ed25519",
+                           aead: str = "chacha20poly1305") -> str:
     """Reference crypto/armor EncryptArmorPrivKey: armored AEAD-encrypted
-    key with kdf/salt headers."""
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-
+    key with kdf/salt headers.  aead selects "chacha20poly1305" (modern
+    default) or "xsalsa20poly1305" (the reference's legacy NaCl
+    secretbox, crypto/xsalsa20symmetric)."""
     salt = os.urandom(16)
-    nonce = os.urandom(12)
     key = _derive(passphrase, salt)
-    ct = ChaCha20Poly1305(key).encrypt(nonce, priv_bytes, None)
+    if aead == "chacha20poly1305":
+        from cryptography.hazmat.primitives.ciphers.aead import (
+            ChaCha20Poly1305)
+        nonce = os.urandom(12)
+        body = nonce + ChaCha20Poly1305(key).encrypt(nonce, priv_bytes,
+                                                     None)
+    elif aead == "xsalsa20poly1305":
+        from .xsalsa20 import encrypt_symmetric
+        body = encrypt_symmetric(priv_bytes, key)  # nonce(24)||tag||ct
+    else:
+        raise ArmorError(f"unrecognized AEAD {aead!r}")
     return encode_armor(BLOCK_TYPE_PRIV_KEY, {
         "kdf": "scrypt",
         "salt": salt.hex().upper(),
-        "aead": "chacha20poly1305",
+        "aead": aead,
         "type": key_type,
-    }, nonce + ct)
+    }, body)
 
 
 def unarmor_decrypt_priv_key(armor_text: str,
                              passphrase: str) -> Tuple[bytes, str]:
     """(priv_bytes, key_type); raises ArmorError on any mismatch
-    (reference UnarmorDecryptPrivKey)."""
-    from cryptography.exceptions import InvalidTag
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-
+    (reference UnarmorDecryptPrivKey).  Accepts both the modern
+    chacha20poly1305 armor and the xsalsa20poly1305 secretbox cipher —
+    note the KDF is always scrypt here: reference-EXPORTED legacy armor
+    (kdf: bcrypt) is still rejected because no bcrypt exists in this
+    environment; the secretbox AEAD is interop-proven (NaCl vector) but
+    end-to-end legacy import would additionally need bcrypt."""
     block_type, headers, data = decode_armor(armor_text)
     if block_type != BLOCK_TYPE_PRIV_KEY:
         raise ArmorError(f"unrecognized armor type {block_type!r}")
     if headers.get("kdf") != "scrypt":
         raise ArmorError(f"unrecognized KDF {headers.get('kdf')!r}")
-    if headers.get("aead", "chacha20poly1305") != "chacha20poly1305":
-        raise ArmorError(f"unrecognized AEAD {headers.get('aead')!r}")
+    aead = headers.get("aead", "chacha20poly1305")
+    # reject unknown AEADs from the headers alone — _derive is a
+    # deliberately expensive scrypt, not something to spend on
+    # untrusted armor that is rejectable for free
+    if aead not in ("chacha20poly1305", "xsalsa20poly1305"):
+        raise ArmorError(f"unrecognized AEAD {aead!r}")
     try:
         salt = bytes.fromhex(headers.get("salt", ""))
     except ValueError as e:
         raise ArmorError("bad salt header") from e
-    if len(data) < 12 + 16:
-        raise ArmorError("ciphertext too short")
     key = _derive(passphrase, salt)
-    try:
-        pt = ChaCha20Poly1305(key).decrypt(data[:12], data[12:], None)
-    except InvalidTag as e:
-        raise ArmorError("invalid passphrase") from e
+    if aead == "chacha20poly1305":
+        from cryptography.exceptions import InvalidTag
+        from cryptography.hazmat.primitives.ciphers.aead import (
+            ChaCha20Poly1305)
+        if len(data) < 12 + 16:
+            raise ArmorError("ciphertext too short")
+        try:
+            pt = ChaCha20Poly1305(key).decrypt(data[:12], data[12:], None)
+        except InvalidTag as e:
+            raise ArmorError("invalid passphrase") from e
+    else:  # xsalsa20poly1305 (validated above)
+        from .xsalsa20 import SymmetricError, decrypt_symmetric
+        try:
+            pt = decrypt_symmetric(data, key)
+        except SymmetricError as e:
+            raise ArmorError("invalid passphrase") from e
     return pt, headers.get("type", "ed25519")

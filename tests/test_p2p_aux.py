@@ -118,3 +118,33 @@ def test_encrypt_armor_priv_key():
     assert out == priv and kt == "ed25519"
     with pytest.raises(ArmorError):
         unarmor_decrypt_priv_key(armored, "wrong-pass")
+
+
+def test_armor_xsalsa20_legacy_aead():
+    """Legacy NaCl secretbox armor (reference crypto/xsalsa20symmetric)
+    round-trips, cross-rejects with the modern AEAD, and unknown AEAD
+    headers are refused before key derivation."""
+    import pytest
+
+    from tendermint_tpu.crypto.armor import (ArmorError, decode_armor,
+                                             encode_armor,
+                                             encrypt_armor_priv_key,
+                                             unarmor_decrypt_priv_key)
+
+    priv = bytes(range(32))
+    a = encrypt_armor_priv_key(priv, "hunter2", aead="xsalsa20poly1305")
+    btype, headers, body = decode_armor(a)
+    assert headers["aead"] == "xsalsa20poly1305"
+    pt, ktype = unarmor_decrypt_priv_key(a, "hunter2")
+    assert pt == priv and ktype == "ed25519"
+    with pytest.raises(ArmorError):
+        unarmor_decrypt_priv_key(a, "wrong")
+    # cross-AEAD: a secretbox body relabeled chacha20poly1305 (and any
+    # unknown AEAD tag) must not decrypt
+    relabeled = encode_armor(btype, {**headers,
+                                     "aead": "chacha20poly1305"}, body)
+    with pytest.raises(ArmorError):
+        unarmor_decrypt_priv_key(relabeled, "hunter2")
+    bogus = encode_armor(btype, {**headers, "aead": "bogus"}, body)
+    with pytest.raises(ArmorError, match="AEAD"):
+        unarmor_decrypt_priv_key(bogus, "hunter2")
