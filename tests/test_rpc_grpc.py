@@ -68,3 +68,53 @@ def test_grpc_broadcast_error_maps_to_status():
         cli.close()
     finally:
         srv.stop()
+
+
+def test_node_serves_grpc_broadcast_api(tmp_path):
+    """A node with [rpc] grpc_laddr set serves BroadcastAPI end to end:
+    Ping + BroadcastTx commits a tx into a block."""
+    import os
+    import time
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.consensus.config import test_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = Config(home=os.path.join(str(tmp_path), "grpc-node"))
+    cfg.ensure_dirs()
+    cfg.consensus = test_config()
+    cfg.p2p.laddr = "127.0.0.1:0"
+    cfg.rpc.laddr = "127.0.0.1:0"
+    cfg.rpc.grpc_laddr = "127.0.0.1:0"
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    NodeKey.load_or_generate(cfg.node_key_file())
+    pub = pv.get_pub_key()
+    gdoc = GenesisDoc(chain_id="grpc-chain",
+                      genesis_time=Timestamp(1700000000, 0),
+                      validators=[GenesisValidator(
+                          address=pub.address(), pub_key_type=pub.type_name,
+                          pub_key_bytes=pub.bytes(), power=10)])
+    with open(cfg.genesis_file(), "w") as f:
+        f.write(gdoc.to_json())
+    node = Node(cfg, KVStoreApplication(), in_memory=True)
+    node.start(wait_for_sync=True)
+    try:
+        assert node.grpc_server is not None
+        cli = GRPCBroadcastClient(node.grpc_server.addr)
+        cli.ping()
+        t0 = time.time()
+        ct, dt = cli.broadcast_tx(b"grpckey=grpcval")
+        assert ct.code == 0 and dt.code == 0, (ct, dt)
+        assert time.time() - t0 < 30
+        q = node.app.query(
+            abci.RequestQuery(data=b"grpckey"))
+        assert q.value == b"grpcval"
+        cli.close()
+    finally:
+        node.stop()
