@@ -400,6 +400,16 @@ class E2ERunner:
                 and item["value"]["ConflictingBlock"]["signed_header"]
                 ["commit"]["signatures"][0]["signature"] == sig)
 
+        # commit(H) is served from block H+1's last-commit, so evidence
+        # at ev_h = head-2 needs head >= 4 — wait for that runway
+        # instead of racing a barely-started chain (start() only gates
+        # on height >= 1)
+        runway = time.time() + 60.0
+        while target.height() < 4 and time.time() < runway:
+            time.sleep(0.2)
+        if target.height() < 4:
+            raise E2EError("evidence: chain never reached height 4")
+
         injected = []   # (kind, match predicate, ev)
         inject_from = target.height()
         for i in range(n):
