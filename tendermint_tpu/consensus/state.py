@@ -864,7 +864,15 @@ class ConsensusState(BaseService):
                 and vote.type == SignedMsgType.PRECOMMIT):
             if rs.step != Step.NEW_HEIGHT:
                 return
-            if rs.last_commit is not None:
+            # last_commit tracks ONLY the round that committed; a late
+            # precommit from another round of that height (e.g. our own
+            # round-0 precommit still in the internal queue after a
+            # round-1 commit) is legal consensus noise, not an error —
+            # the reference's LastCommit.AddVote refuses it without
+            # killing anything (consensus/state.go:2221, types/
+            # vote_set.go AddVote round check)
+            if (rs.last_commit is not None
+                    and vote.round == rs.last_commit.round):
                 added = rs.last_commit.add_vote(vote)
                 if added and self.config.skip_timeout_commit \
                         and rs.last_commit.has_all():

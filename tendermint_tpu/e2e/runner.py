@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import socket
 import subprocess
@@ -97,6 +98,12 @@ class E2ERunner:
         pvs = {}
         for n in self.m.nodes:
             home = os.path.join(self.workdir, n.name)
+            # a testnet run is FRESH: nodes resuming a previous run's
+            # data dir would continue the old chain and ignore the new
+            # genesis (reference runner/setup.go Setup wipes the dir) —
+            # observed as phantom heights and cross-run evidence
+            if os.path.isdir(home):
+                shutil.rmtree(home)
             h = _NodeHandle(n, home, _free_port(), _free_port())
             self.nodes[n.name] = h
             cfg = self._node_config(h)

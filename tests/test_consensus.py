@@ -200,3 +200,47 @@ def test_stale_round_own_part_not_fatal():
         assert node.cs.is_running()
     finally:
         node.stop()
+
+
+def test_late_own_precommit_from_earlier_round_not_fatal():
+    """Regression (round-4 e2e): after a height commits at round r > 0,
+    the node's OWN round-0 precommit can still be draining through the
+    internal queue; adding it to last_commit (which tracks only round r)
+    raised VoteSetError and — because own votes re-raise — killed the
+    receive routine, zombifying the node (consensus dead, RPC alive).
+    The reference's LastCommit.AddVote refuses cross-round votes without
+    error (consensus/state.go:2221)."""
+    import copy
+
+    from tendermint_tpu.types.basic import SignedMsgType
+    from tendermint_tpu.types.vote import Vote
+
+    gdoc, privs = make_genesis(1)
+    node = Node(gdoc, privs[0], name="lateown")
+    node.start()
+    try:
+        wait_for_height([node], 3, timeout=30)
+        cs = node.cs
+        with cs._mtx:
+            rs = cs.rs
+            assert rs.last_commit is not None
+            prev_h = rs.height - 1
+            # a synthetic own precommit for the previous height at a
+            # round last_commit does NOT track
+            tmpl = None
+            for v in rs.last_commit.votes:
+                if v is not None:
+                    tmpl = copy.copy(v)
+                    break
+            assert tmpl is not None
+            tmpl.round = rs.last_commit.round + 1
+        # deliver as an internal (own) message — must be dropped, not
+        # raise through the receive routine
+        from tendermint_tpu.consensus.round_types import VoteMessage
+        cs._internal_queue.put((VoteMessage(tmpl), ""))
+        wait_for_height([node], rs.height + 1, timeout=30)
+        assert cs.is_running()
+        assert tmpl.height == prev_h  # fixture sanity: height-1 precommit
+        assert tmpl.type == SignedMsgType.PRECOMMIT
+    finally:
+        node.stop()
