@@ -160,6 +160,7 @@ def main():
     t_budget = time.time() + budget_s
     all_outs = []
     e2e_rate = 0.0
+    pass_rates = []
     scheme_best = {s: 0.0 for s in schemes}
     with ThreadPoolExecutor(1) as pool:
         npass = 0
@@ -186,6 +187,7 @@ def main():
             # the last covers all rounds with a single tunnel round trip
             outs[-1].block_until_ready()
             rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+            pass_rates.append(rate)
             scheme_best[nsub] = max(scheme_best[nsub], rate)
             e2e_rate = max(e2e_rate, rate)
             all_outs += outs
@@ -198,11 +200,17 @@ def main():
     ok = all(np.asarray(o).all() for o in all_outs) and host_ok.all()
     assert ok
 
+    # best AND median-of-passes on the driver-visible line: the tunnel's
+    # weather makes best-of a pipeline measurement and median a
+    # weather-robust round-over-round comparator (VERDICT r4 weak #2)
+    median_rate = float(np.median(pass_rates)) if pass_rates else 0.0
     print(json.dumps({
         "metric": "ed25519_verify_throughput_e2e",
         "value": round(e2e_rate, 1),
         "unit": "sigs/s/chip",
         "vs_baseline": round(e2e_rate / cpu_rate, 2),
+        "median_value": round(median_rate, 1),
+        "median_vs_baseline": round(median_rate / cpu_rate, 2),
     }))
     print(f"# cpu_baseline={cpu_rate:.0f}/s platform="
           f"{jax.devices()[0].platform} passes={npass} "

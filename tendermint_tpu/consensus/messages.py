@@ -22,6 +22,10 @@ from tendermint_tpu.types.vote import Vote
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
+# dedicated catchup channel (reference consensus/reactor.go:30
+# VoteSetBitsChannel 0x23): bitmap bursts ride their own low-priority
+# queue so they can never contend with round-step announcements
+VOTE_SET_BITS_CHANNEL = 0x23
 
 
 @dataclass
@@ -262,5 +266,6 @@ def decode_msg(data: bytes):
     return wire.oneof_decode(data, _HANDLERS)
 
 
-for _ch in (STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL):
+for _ch in (STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL,
+            VOTE_SET_BITS_CHANNEL):
     wire.register_codec(_ch, encode_msg, decode_msg)

@@ -22,6 +22,7 @@ from tendermint_tpu.types.basic import SignedMsgType
 from tendermint_tpu.types.vote import Vote
 
 from .messages import (DATA_CHANNEL, STATE_CHANNEL, VOTE_CHANNEL,
+                       VOTE_SET_BITS_CHANNEL,
                        BlockPartGossip, HasVoteMessage, NewRoundStepMessage,
                        ProposalGossip, VoteGossip, VoteSetBitsMessage,
                        VoteSetMaj23Message, decode_msg)
@@ -115,6 +116,10 @@ class ConsensusReactor(Reactor):
                               send_queue_capacity=100),
             ChannelDescriptor(VOTE_CHANNEL, priority=7,
                               send_queue_capacity=200),
+            # reference reactor.go:145 gives VoteSetBits priority 1 with a
+            # tiny queue: catchup bitmaps are droppable, steps are not
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=4),
         ]
 
     # -- outbound ----------------------------------------------------------
@@ -200,7 +205,8 @@ class ConsensusReactor(Reactor):
                                         msg.index, size)
             elif isinstance(msg, VoteSetMaj23Message):
                 self._on_maj23(peer, msg)
-            elif isinstance(msg, VoteSetBitsMessage):
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, VoteSetBitsMessage):
                 from tendermint_tpu.libs.bits import BitArray
                 # peer-controlled size: must equal our validator-set size
                 # for that height or the allocation is refused (a huge
@@ -255,7 +261,7 @@ class ConsensusReactor(Reactor):
             bits = vs.bit_array_by_block_id(msg.block_id)
             if bits is None:
                 bits = vs.bit_array()
-        peer.try_send(STATE_CHANNEL, VoteSetBitsMessage(
+        peer.try_send(VOTE_SET_BITS_CHANNEL, VoteSetBitsMessage(
             msg.height, msg.round, msg.type, msg.block_id,
             bits.size(), bits.to_bytes()))
 

@@ -45,6 +45,10 @@ class Manifest:
     # reach the app as Misbehavior (reference test/e2e/pkg/manifest.go
     # Evidence + runner/evidence.go InjectEvidence)
     evidence: int = 0
+    # benchmark stage FAILS if the average block interval exceeds this
+    # (reference test/e2e/runner/benchmark.go:22 5 s/block CI budget);
+    # 0 disables the assertion
+    block_interval_budget_s: float = 0.0
 
     def validators(self) -> List[NodeManifest]:
         return [n for n in self.nodes if n.mode == "validator"]
@@ -91,6 +95,8 @@ def manifest_from_dict(d: Dict) -> Manifest:
         m.wait_height = int(d["wait_height"])
     if "evidence" in d:
         m.evidence = int(d["evidence"])
+    if "block_interval_budget_s" in d:
+        m.block_interval_budget_s = float(d["block_interval_budget_s"])
     for name, nd in (d.get("node") or {}).items():
         m.nodes.append(NodeManifest(
             name=name,

@@ -617,6 +617,15 @@ class E2ERunner:
             "txs_sent": self._load_sent,
         }
         self.log(f"e2e benchmark: {json.dumps(stats)}")
+        budget = getattr(self.m, "block_interval_budget_s", 0.0)
+        if budget and gaps and stats["interval_avg_s"] > budget:
+            # a cadence regression must FAIL the run (reference
+            # benchmark.go:54 errors when the mean interval blows the
+            # CI budget), not sail through as a log line
+            raise E2EError(
+                f"benchmark: avg block interval "
+                f"{stats['interval_avg_s']}s exceeds the manifest budget "
+                f"{budget}s")
         return stats
 
     # -- stage: cleanup ----------------------------------------------------

@@ -55,16 +55,22 @@ def ts_rfc3339(ts: Timestamp) -> str:
 
 _RFC = re.compile(
     r"^(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2}):(\d{2})"
-    r"(?:\.(\d{1,9}))?(?:Z|\+00:00)$")
+    r"(?:\.(\d{1,9}))?(?:Z|([+-])(\d{2}):(\d{2}))$")
 
 
 def parse_rfc3339(s: str) -> Timestamp:
+    """Any valid RFC3339 offset is accepted and normalized to UTC
+    (Go tooling may write genesis_time with a non-UTC zone)."""
     m = _RFC.match(s)
     if not m:
         raise ValueError(f"bad RFC3339 timestamp {s!r}")
     y, mo, d, h, mi, sec = (int(x) for x in m.groups()[:6])
     dt = datetime.datetime(y, mo, d, h, mi, sec,
                            tzinfo=datetime.timezone.utc)
+    if m.group(8):
+        off = datetime.timedelta(hours=int(m.group(9)),
+                                 minutes=int(m.group(10)))
+        dt = dt - off if m.group(8) == "+" else dt + off
     nanos = int((m.group(7) or "").ljust(9, "0") or 0)
     return Timestamp(int(dt.timestamp()), nanos)
 

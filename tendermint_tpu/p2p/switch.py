@@ -198,14 +198,26 @@ class Switch(BaseService):
 
     def on_start(self):
         """Reference p2p/switch.go:226 OnStart: start every registered
-        reactor, then listen.  A reactor already started by its owner
-        keeps running (start here would be an AlreadyStarted error)."""
-        for r in self.reactors.values():
-            if not r.is_running():
-                r.start()
+        reactor, then listen.  The listener is bound FIRST so a bind
+        failure (port in use) leaves no reactor threads running and the
+        switch can be cleanly retried.  A reactor already started by its
+        owner keeps running (start here would be an AlreadyStarted
+        error)."""
         host, port = self.listen_addr.rsplit(":", 1)
         self._listener = socket.create_server((host, int(port)))
         self._listener.settimeout(0.5)
+        started = []
+        try:
+            for r in self.reactors.values():
+                if not r.is_running():
+                    r.start()
+                    started.append(r)
+        except Exception:
+            for r in started:
+                r.stop()
+            self._listener.close()
+            self._listener = None
+            raise
         self.spawn(self._accept_routine, name="switch-accept")
 
     def actual_listen_addr(self) -> str:

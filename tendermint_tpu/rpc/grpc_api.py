@@ -12,7 +12,6 @@ sub-messages reuse the socket codec byte-for-byte.  Gated by
 """
 from __future__ import annotations
 
-import base64
 
 import grpc
 
@@ -68,15 +67,12 @@ class GRPCBroadcastServer(BaseService):
             try:
                 f = pd.parse(req_bytes)
                 tx = pd.get_bytes(f, 1)
-                res = self._rpc.broadcast_tx_commit(
-                    tx=base64.b64encode(tx).decode())
+                # full abci response objects — data/gas/events/codespace
+                # survive onto the wire (reference BroadcastAPI returns
+                # the complete ResponseCheckTx/ResponseDeliverTx)
+                ct, dt, _h = self._rpc.broadcast_tx_commit_raw(tx)
                 return _enc_broadcast_response(
-                    abci.ResponseCheckTx(
-                        code=res["check_tx"].get("code", 0),
-                        log=res["check_tx"].get("log", "")),
-                    abci.ResponseDeliverTx(
-                        code=res["deliver_tx"].get("code", 0),
-                        log=res["deliver_tx"].get("log", "")))
+                    ct, dt if dt is not None else abci.ResponseDeliverTx())
             except Exception as e:  # noqa: BLE001 - surface as status
                 _logger.error("BroadcastTx failed", err=str(e))
                 ctx.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -659,20 +659,19 @@ class RPCServer(BaseService):
         return {"code": r.code, "data": _b64(r.data or b""), "log": r.log,
                 "hash": tx_hash(raw).hex().upper()}
 
-    def broadcast_tx_commit(self, tx=None, timeout=30.0):
+    def broadcast_tx_commit_raw(self, raw: bytes, timeout=30.0):
         """Reference rpc/core/mempool.go:52: add to mempool, wait for the
-        tx to land in a committed block via the event bus."""
-        raw = _parse_tx(tx)
-        from tendermint_tpu.types.block import tx_hash
-        th = tx_hash(raw)
+        tx to land in a committed block via the event bus.  Returns the
+        full ABCI response objects (check_tx, deliver_tx, height) so both
+        the JSON route and the gRPC BroadcastAPI can surface every field
+        (data, gas, events, codespace) the reference returns."""
+        from tendermint_tpu.abci.types import ResponseDeliverTx
         sub = self.node.event_bus.subscribe("Tx") \
             if self.node.event_bus else None
         try:
             r = self.node.mempool.check_tx(raw)
             if not r.is_ok():
-                return {"check_tx": {"code": r.code, "log": r.log},
-                        "deliver_tx": {}, "hash": th.hex().upper(),
-                        "height": 0}
+                return r, None, 0
             import queue as _q
             import time as _t
             deadline = _t.monotonic() + float(timeout)
@@ -683,18 +682,41 @@ class RPCServer(BaseService):
                     continue
                 data = ev.data or {}
                 if data.get("tx") == raw:
-                    res = data.get("result")
-                    return {"check_tx": {"code": 0},
-                            "deliver_tx": {
-                                "code": res.code if res else 0,
-                                "log": res.log if res else ""},
-                            "hash": th.hex().upper(),
-                            "height": data.get("height", 0)}
+                    res = data.get("result") or ResponseDeliverTx()
+                    return r, res, data.get("height", 0)
             raise RPCError(-32603,
                            "timed out waiting for tx to be committed")
         finally:
             if sub is not None:
                 self.node.event_bus.unsubscribe(sub)
+
+    @staticmethod
+    def _tx_result_json(res) -> dict:
+        """Full ResponseCheckTx/ResponseDeliverTx projection (reference
+        rpc/core/types ResultBroadcastTxCommit JSON shape)."""
+        if res is None:
+            return {}
+        return {
+            "code": res.code,
+            "data": _b64(res.data or b""),
+            "log": res.log,
+            "gas_wanted": str(getattr(res, "gas_wanted", 0)),
+            "gas_used": str(getattr(res, "gas_used", 0)),
+            "events": [{"type": getattr(e, "type", ""),
+                        "attributes": dict(getattr(e, "attributes", None)
+                                           or {})}
+                       for e in (getattr(res, "events", None) or [])],
+            "codespace": getattr(res, "codespace", ""),
+        }
+
+    def broadcast_tx_commit(self, tx=None, timeout=30.0):
+        raw = _parse_tx(tx)
+        from tendermint_tpu.types.block import tx_hash
+        th = tx_hash(raw)
+        ct, dt, height = self.broadcast_tx_commit_raw(raw, timeout)
+        return {"check_tx": self._tx_result_json(ct),
+                "deliver_tx": self._tx_result_json(dt),
+                "hash": th.hex().upper(), "height": height}
 
     def abci_info(self):
         from tendermint_tpu.abci.types import RequestInfo

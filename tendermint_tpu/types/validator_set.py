@@ -59,10 +59,6 @@ def _sort_by_voting_power(vals: List[Validator]):
     vals.sort(key=lambda v: (-v.voting_power, v.address))
 
 
-def _sort_by_address(vals: List[Validator]):
-    vals.sort(key=lambda v: v.address)
-
-
 class ValidatorSet:
     def __init__(self, validators: Optional[List[Validator]] = None):
         """NewValidatorSet semantics (reference :71-86): copies, validates,
@@ -272,8 +268,11 @@ class ValidatorSet:
         return tvp_after_removals + removed_power
 
     def _apply_updates(self, updates: List[Validator]):
-        existing = self.validators
-        _sort_by_address(existing)
+        # sort a COPY: the current list object may be the key of a
+        # device-resident pubkey-matrix cache entry, and reordering it
+        # in place would silently misalign cached rows (the cache
+        # invalidates by retained object reference, not content)
+        existing = sorted(self.validators, key=lambda v: v.address)
         merged: List[Validator] = []
         i = j = 0
         while i < len(existing) and j < len(updates):

@@ -41,6 +41,14 @@ def test_rfc3339_time_reference_shapes():
         "2023-11-14T22:15:00.000000025Z"
     for ts in (Timestamp(1700000100, 0), Timestamp(123456, 789)):
         assert aj.parse_rfc3339(aj.ts_rfc3339(ts)) == ts
+    # arbitrary RFC3339 offsets normalize to UTC (Go tooling may write
+    # genesis_time with a non-UTC zone)
+    assert aj.parse_rfc3339("2023-11-15T00:15:00+02:00") == \
+        Timestamp(1700000100, 0)
+    assert aj.parse_rfc3339("2023-11-14T17:45:00.25-04:30") == \
+        Timestamp(1700000100, 250000000)
+    assert aj.parse_rfc3339("2023-11-14T22:15:00+00:00") == \
+        Timestamp(1700000100, 0)
 
 
 def test_vote_json_reference_shape():

@@ -239,3 +239,19 @@ def test_consensus_new_valid_block_and_pol_roundtrip():
                               proposal_pol=BitArray.from_indices(6, [5]))
     out = cm.decode_msg(cm.encode_msg(p))
     assert out.proposal_pol == p.proposal_pol
+
+
+def test_vote_set_bits_channel_codec_registered():
+    """The dedicated catchup channel 0x23 must have a wire codec: a
+    missing registration makes every VoteSetMaj23 answer raise KeyError
+    inside receive(), which the switch treats as a peer error."""
+    from tendermint_tpu.consensus import messages as cm
+    from tendermint_tpu.p2p import wire as p2p_wire
+    from tendermint_tpu.libs.bits import BitArray
+
+    msg = cm.VoteSetBitsMessage(9, 2, int(SignedMsgType.PREVOTE), BID,
+                                10, BitArray.from_indices(10, [1, 9])
+                                .to_bytes())
+    data = p2p_wire.encode(cm.VOTE_SET_BITS_CHANNEL, msg)
+    out = p2p_wire.decode(cm.VOTE_SET_BITS_CHANNEL, data)
+    assert type(out) is type(msg)

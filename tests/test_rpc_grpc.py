@@ -28,12 +28,12 @@ class _FakeRPC:
     def __init__(self):
         self.seen = []
 
-    def broadcast_tx_commit(self, tx=None, timeout=30.0):
-        import base64
-        self.seen.append(base64.b64decode(tx))
-        return {"check_tx": {"code": 0},
-                "deliver_tx": {"code": 0, "log": "committed"},
-                "hash": "AA", "height": 5}
+    def broadcast_tx_commit_raw(self, raw, timeout=30.0):
+        self.seen.append(raw)
+        return (abci.ResponseCheckTx(code=0, data=b"cd", gas_wanted=7),
+                abci.ResponseDeliverTx(code=0, log="committed",
+                                       gas_used=21, codespace="app"),
+                5)
 
 
 def test_grpc_broadcast_server_client():
@@ -45,7 +45,11 @@ def test_grpc_broadcast_server_client():
         cli.ping()
         ct, dt = cli.broadcast_tx(b"k=v")
         assert ct.code == 0
+        # full abci fields survive the wire (ADVICE r4): data, gas,
+        # codespace are no longer dropped by the server
+        assert ct.data == b"cd" and ct.gas_wanted == 7
         assert dt.code == 0 and dt.log == "committed"
+        assert dt.gas_used == 21 and dt.codespace == "app"
         assert rpc.seen == [b"k=v"]
         cli.close()
     finally:
@@ -56,7 +60,7 @@ def test_grpc_broadcast_error_maps_to_status():
     import grpc as _grpc
 
     class Boom:
-        def broadcast_tx_commit(self, tx=None, timeout=30.0):
+        def broadcast_tx_commit_raw(self, raw, timeout=30.0):
             raise RuntimeError("mempool is full")
 
     srv = GRPCBroadcastServer(Boom(), "127.0.0.1:0")
