@@ -603,6 +603,14 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     plane = data_plane()
     if plane is not None and plane.worth_sharding(len(pubkeys)):
         return plane.verify_batch(pubkeys, msgs, sigs)
+    from . import msm
+    if msm.use_rlc(len(pubkeys)):
+        # RLC+Pippenger MSM fast path (~10x less device compute than the
+        # per-sig ladder): one random-linear-combination check accepts the
+        # whole batch; on failure fall through to the exact per-signature
+        # kernel for check-all attribution (docs/adr/009)
+        if msm.verify_batch_rlc(pubkeys, msgs, sigs):
+            return np.ones(len(pubkeys), dtype=bool)
     if _use_pallas():
         from . import pallas_ed25519 as pe
         if cache_pubs and len(pubkeys) >= PUB_CACHE_MIN:

@@ -1,0 +1,411 @@
+"""Random-linear-combination (RLC) batched ed25519 verification via a
+bucketed Pippenger-style multi-scalar multiplication, built TPU-first.
+
+Why: the per-signature Straus ladder (ops/pallas_ed25519.py) pays ~3,400
+field multiplies per signature — optimal per signature, but a steady-state
+VerifyCommit batch is ALL-VALID, and validity of the whole batch can be
+established with ~10x less compute by checking one random linear
+combination (the scheme the repo already proved out in C for
+secp256k1/sr25519, native/ecverify.c):
+
+    [8] ( [sum_i z_i s_i] B  -  sum_i [z_i] R_i  -  sum_i [z_i k_i] A_i )
+        == identity
+
+for secret uniform 128-bit z_i, k_i = SHA-512(R_i || A_i || M_i) mod L.
+If every signature satisfies the (cofactored) ed25519 equation the check
+always passes; if any does not, it fails except with probability <= ~2^-125
+over z.  On failure the caller re-runs the exact per-signature kernel,
+preserving check-all attribution semantics (reference
+types/validator_set.go:657-661) — the fallback costs the old price but
+only for batches that actually contain an invalid signature.
+
+Semantics (docs/adr/009-rlc-batch-verification.md): the fast path is the
+*cofactored* check — the ZIP-215 / ed25519-consensus semantics that made
+batch verification viable for consensus systems — while the per-signature
+paths are the reference-exact cofactorless check (reference
+crypto/ed25519/ed25519.go:148).  The two agree on every signature an
+RFC 8032 signer can produce; they differ only for adversarially crafted
+signatures whose residual is a pure small-order component.  Canonicity
+stays exact: s < L and canonical R encodings are screened on the host
+before the fast path is attempted (a non-canonical R decodes fine but the
+per-sig byte compare rejects it, so such batches skip straight to the
+per-sig path; non-canonical A is accepted-and-reduced by BOTH paths,
+matching Go's fe.SetBytes).
+
+The MSM itself is shaped for the TPU rather than ported from a CPU
+Pippenger: scatter-free, static shapes, everything batched on lanes.
+
+  window digits    device, vectorized bit slicing (c-bit unsigned windows)
+  (key, row) sort  ONE lax.sort over every window of every scalar
+  bucket fill      "layered" accumulation: lanes = (window, bucket); layer
+                   t adds the t-th member of every bucket IN PARALLEL —
+                   a lax.scan of T unified cached adds over K lanes (or
+                   the fused Pallas kernel, ops/pallas_msm.py), where
+                   T ~ M/K + tail margin.  No scatter, no segmented tree.
+  bucket->window   weighted suffix scan over the digit axis:
+                   sum_b b*S_b = sum_{j>=1} (sum_{b>=j} S_b)
+  window->result   host Horner over the ~26 window sums (Python bignum),
+                   then the cofactor multiply and identity test.
+"""
+from __future__ import annotations
+
+import math
+import os
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import curve as C
+from . import ed25519 as ed
+from . import field as F
+
+L = ed.L
+_i32 = jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# plan: static MSM geometry per (n, c)
+# ---------------------------------------------------------------------------
+
+class Plan:
+    """Static shape plan for a batch of n signatures with c-bit windows.
+
+    Items: every (scalar, window) pair contributes one bucket member:
+      n * W_A for the [z_i k_i](-A_i) terms (253-bit scalars),
+      n * W_R for the [z_i](-R_i) terms (128-bit z),
+      W_A     for the [sum z_i s_i](B) term.
+    Key space: window w owns buckets [w * 2^c, (w+1) * 2^c).  R items use
+    the low W_R windows (same weights as A windows — the Horner combine is
+    per-window, so sharing the key space just densifies the buckets).
+    """
+
+    def __init__(self, n: int, c: int):
+        self.n, self.c = n, c
+        self.W_A = -(-253 // c)
+        self.W_R = -(-128 // c)
+        self.K = self.W_A << c
+        self.M = n * (self.W_A + self.W_R) + self.W_A
+        avg = self.M / self.K
+        # layered-scan depth: mean bucket load plus a Poisson tail margin
+        # sized so P(any bucket overflows) < ~2^-30 for uniform-random
+        # digits (z is secret and uniform, so digits are not adversarially
+        # steerable).  Overflow is detected on device and falls back.
+        lg = math.log(self.K * (1 << 30))
+        self.T = int(avg + math.sqrt(2.0 * avg * lg) + lg + 4)
+
+
+def _pick_c(n: int) -> int:
+    if n >= 8192:
+        return 10
+    if n >= 1024:
+        return 8
+    if n >= 128:
+        return 6
+    return 4
+
+
+# ---------------------------------------------------------------------------
+# device helpers (XLA; shared by the CPU path and the TPU driver)
+# ---------------------------------------------------------------------------
+
+def _bytes_to_y_sign(b):
+    """(m, 32) uint8 rows -> ((NLIMB, m) limbs of low 255 bits, (m,) sign)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((b[:, :, None] >> shifts) & 1).reshape(b.shape[0], 256)
+    bits = bits.astype(_i32)
+    sign = bits[:, 255]
+    y_bits = bits.at[:, 255].set(0)
+    pad = jnp.zeros((b.shape[0], F.TOTAL_BITS - 256), dtype=_i32)
+    y_bits = jnp.concatenate([y_bits, pad], axis=1)
+    weights = 1 << jnp.arange(F.RADIX, dtype=_i32)
+    y = (y_bits.reshape(-1, F.NLIMB, F.RADIX) * weights).sum(
+        axis=-1, dtype=_i32).T
+    return y, sign
+
+
+def _digits(b, c: int, W: int):
+    """(m, NB) uint8 little-endian scalars -> (W, m) int32 c-bit digits.
+    Requires W * c >= meaningful bit length (the value's top bits beyond
+    NB*8 are zero-padded; slicing below W*c never drops a set bit because
+    callers size W to cover the scalar)."""
+    m, NB = b.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((b[:, :, None] >> shifts) & 1).reshape(m, NB * 8).astype(_i32)
+    need = W * c
+    if need > NB * 8:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((m, need - NB * 8), dtype=_i32)], axis=1)
+    else:
+        bits = bits[:, :need]
+    w = 1 << jnp.arange(c, dtype=_i32)
+    return (bits.reshape(m, W, c) * w).sum(axis=-1, dtype=_i32).T
+
+
+def _ext_add(p: C.Ext, q: C.Ext) -> C.Ext:
+    return C.add_cached(p, C.to_cached(q))
+
+
+def _bucket_scan_xla(layers, K: int) -> C.Ext:
+    """layers: Cached arrays each (T, NLIMB, K).  Returns bucket sums as
+    Ext (NLIMB, K)."""
+    def body(acc, layer):
+        return C.add_cached(acc, C.Cached(*layer)), None
+
+    acc, _ = jax.lax.scan(body, C.identity((K,)), layers)
+    return acc
+
+
+def _aggregate(acc: C.Ext, W: int, c: int) -> C.Ext:
+    """Bucket sums (NLIMB, K = W * 2^c) -> per-window weighted sums
+    sum_b b * S_{w,b} as Ext (NLIMB, W), via the classic running-sum
+    identity sum_b b*S_b = sum_{j>=1} (sum_{b>=j} S_b): one lax.scan from
+    the top digit down carrying (suffix, total) — a deliberately small
+    graph (2 unified adds per step) that scans 2^c - 1 steps over W-wide
+    lanes."""
+    nb = 1 << c
+    e = C.Ext(*(v.reshape(F.NLIMB, W, nb) for v in acc))
+    # scan high digit -> digit 1; digit 0 has weight 0 and is skipped
+    seq = C.Ext(*(jnp.moveaxis(v[:, :, 1:], 2, 0)[::-1] for v in e))
+
+    def body(carry, s_b):
+        suffix, total = carry
+        suffix = _ext_add(suffix, C.Ext(*s_b))
+        total = _ext_add(total, suffix)
+        return (suffix, total), None
+
+    ident = C.identity((W,))
+    (_, total), _ = jax.lax.scan(body, (ident, ident), seq)
+    return total
+
+
+# the basepoint's cached row and the cached identity, as import-time consts
+def _cached_row_ints(x: int, y: int):
+    t = x * y % C.P
+    return ((y + x) % C.P, (y - x) % C.P, 1, 2 * C.D_INT * t % C.P)
+
+
+_B_CACHED = _cached_row_ints(C.BX_INT, C.BY_INT)
+_ID_CACHED = (1, 1, 1, 0)
+
+
+def _build_table(r_bytes, pub_bytes):
+    """Decompress -R_i and -A_i on device and assemble the cached-point
+    table: row 0 = identity, rows 1..n = -R, rows n+1..2n = -A, row
+    2n+1 = B.  Returns (4 cached arrays (NLIMB, 2n+2), ok_all scalar)."""
+    n = r_bytes.shape[0]
+    yr, sr = _bytes_to_y_sign(r_bytes)
+    ya, sa = _bytes_to_y_sign(pub_bytes)
+    y = jnp.concatenate([yr, ya], axis=1)
+    s = jnp.concatenate([sr, sa], axis=0)
+    pt, ok = C.decompress(y, s)
+    # negate: both R and A enter the MSM negated
+    neg = C.Ext(F.carry_lazy(-pt.x), pt.y, pt.z, F.carry_lazy(-pt.t))
+    cached = C.to_cached(neg)
+    consts = np.zeros((4, F.NLIMB, 2), dtype=np.int32)
+    for j, (ident_v, b_v) in enumerate(zip(_ID_CACHED, _B_CACHED)):
+        consts[j, :, 0] = F.int_to_limbs(ident_v)
+        consts[j, :, 1] = F.int_to_limbs(b_v)
+    consts = jnp.asarray(consts)
+    rows = tuple(
+        jnp.concatenate([consts[j][:, :1], cached[j], consts[j][:, 1:]],
+                        axis=1)
+        for j in range(4))
+    return rows, jnp.all(ok)
+
+
+@partial(jax.jit, static_argnames=("c",))
+def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int):
+    """The full device pipeline.  Inputs (all uint8, batch-major):
+    r_bytes/pub_bytes/zk (n, 32), z (n, 16), zs (32,).  Returns
+    (window sums stacked (4, NLIMB, W_A), decode_ok_all, overflow)."""
+    n = r_bytes.shape[0]
+    plan = Plan(n, c)
+    W_A, W_R, K, M, T = plan.W_A, plan.W_R, plan.K, plan.M, plan.T
+
+    table, ok_all = _build_table(r_bytes, pub_bytes)
+
+    dA = _digits(zk, c, W_A)                       # (W_A, n)
+    dR = _digits(z, c, W_R)                        # (W_R, n)
+    dB = _digits(zs[None, :], c, W_A)              # (W_A, 1)
+    wA = jnp.arange(W_A, dtype=_i32)[:, None]
+    wR = jnp.arange(W_R, dtype=_i32)[:, None]
+    # digit-0 items have weight 0: send them to a trash key (== K) that
+    # sorts past every real bucket and is never scanned.  This matters
+    # structurally, not just for speed: the TOP window's digit is almost
+    # always zero (zk < L ~ 2^252), so without the trash key one bucket
+    # per batch collects nearly every scalar's top item and the layered
+    # scan would need T ~ n; padded lanes (zero scalars) also all land
+    # here, making bucket padding free.
+    def key_of(w, d):
+        return jnp.where(d == 0, K, (w << c) + d)
+
+    keys = jnp.concatenate([
+        key_of(wA, dA).reshape(-1),
+        key_of(wR, dR).reshape(-1),
+        key_of(wA, dB).reshape(-1),
+    ])
+    ar = jnp.arange(n, dtype=_i32)[None, :]
+    rows = jnp.concatenate([
+        jnp.broadcast_to(ar + n + 1, (W_A, n)).reshape(-1),   # -A rows
+        jnp.broadcast_to(ar + 1, (W_R, n)).reshape(-1),       # -R rows
+        jnp.full((W_A,), 2 * n + 1, dtype=_i32),              # B row
+    ])
+    sk, srows = jax.lax.sort((keys, rows), num_keys=1)
+
+    starts = jnp.searchsorted(sk, jnp.arange(K + 1, dtype=_i32)).astype(_i32)
+    seg_len = starts[1:] - starts[:-1]
+    overflow = jnp.max(seg_len) > T
+    t_idx = jnp.arange(T, dtype=_i32)[:, None]
+    pos = jnp.clip(starts[:-1][None, :] + t_idx, 0, M - 1)
+    valid = t_idx < seg_len[None, :]
+    layer_rows = jnp.where(valid, srows[pos], 0)              # (T, K)
+
+    idx = layer_rows.reshape(-1)
+    layers = tuple(
+        jnp.take(tab, idx, axis=1).reshape(F.NLIMB, T, K).transpose(1, 0, 2)
+        for tab in table)
+    buckets = _bucket_scan_xla(layers, K)
+    wsums = _aggregate(buckets, W_A, c)
+    return jnp.stack(list(wsums)), ok_all, overflow
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+def _add_int(P, Q):
+    """Unified extended-coords addition on Python ints (add-2008-hwcd-3,
+    a = -1; the bignum mirror of curve.add_cached)."""
+    p = C.P
+    X1, Y1, Z1, T1 = P
+    X2, Y2, Z2, T2 = Q
+    a = (Y1 - X1) * (Y2 - X2) % p
+    b = (Y1 + X1) * (Y2 + X2) % p
+    cc = T1 * T2 % p * (2 * C.D_INT) % p
+    d = 2 * Z1 * Z2 % p
+    e, f, g, h = b - a, d - cc, d + cc, b + a
+    return (e * f % p, g * h % p, f * g % p, e * h % p)
+
+
+def _dbl_int(P):
+    p = C.P
+    X1, Y1, Z1, _ = P
+    a = X1 * X1 % p
+    b = Y1 * Y1 % p
+    cc = 2 * Z1 * Z1 % p
+    e = ((X1 + Y1) * (X1 + Y1) - a - b) % p
+    g = b - a
+    f = (g - cc) % p
+    h = (-a - b) % p
+    return (e * f % p, g * h % p, f * g % p, e * h % p)
+
+
+def _combine_windows_host(ws: np.ndarray, c: int) -> bool:
+    """ws: (4, NLIMB, W) device window sums.  Horner-combine with window
+    weight 2^(c*w), multiply by the cofactor, test for the identity."""
+    W = ws.shape[2]
+    pts = [tuple(F.limbs_to_int(ws[j, :, w]) % C.P for j in range(4))
+           for w in range(W)]
+    total = (0, 1, 1, 0)
+    for w in reversed(range(W)):
+        for _ in range(c):
+            total = _dbl_int(total)
+        total = _add_int(total, pts[w])
+    for _ in range(3):                     # cofactor 8
+        total = _dbl_int(total)
+    X, Y, Z, _ = total
+    return X % C.P == 0 and (Y - Z) % C.P == 0
+
+
+def _r_canonical(r_bytes: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8: y(R) < p vectorized (the per-sig path rejects a
+    non-canonical R via its byte compare; the MSM path would decode it,
+    so such batches must skip the fast path)."""
+    w = np.ascontiguousarray(r_bytes).copy()
+    w[:, 31] &= 0x7F
+    ww = w.view("<u8")
+    top = np.uint64(0x7FFFFFFFFFFFFFFF)
+    ones = np.uint64(0xFFFFFFFFFFFFFFFF)
+    lo = np.uint64(0xFFFFFFFFFFFFFFED)
+    return ~((ww[:, 3] == top) & (ww[:, 2] == ones) & (ww[:, 1] == ones)
+             & (ww[:, 0] >= lo))
+
+
+def _rlc_scalars_host(z: np.ndarray, k: np.ndarray, s: np.ndarray):
+    """Pure-Python fallback for native.rlc_scalars."""
+    n = z.shape[0]
+    zk = np.empty((n, 32), dtype=np.uint8)
+    acc = 0
+    for i in range(n):
+        zi = int.from_bytes(z[i].tobytes(), "little")
+        ki = int.from_bytes(k[i].tobytes(), "little")
+        si = int.from_bytes(s[i].tobytes(), "little")
+        zk[i] = np.frombuffer(((zi * ki) % L).to_bytes(32, "little"),
+                              dtype=np.uint8)
+        acc = (acc + zi * si) % L
+    return zk, np.frombuffer(acc.to_bytes(32, "little"), dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# the public fast path
+# ---------------------------------------------------------------------------
+
+def _rlc_min() -> int:
+    return int(os.environ.get("TM_TPU_RLC_MIN", "1024"))
+
+
+def use_rlc(n: int) -> bool:
+    """Whether the RLC fast path should be attempted for an n-sig batch
+    (below RLC_MIN the per-sig kernel is already launch-bound and the
+    extra compile cache entries are not worth it)."""
+    return os.environ.get("TM_TPU_RLC", "1") != "0" and n >= _rlc_min()
+
+
+def _b_enc_bytes() -> np.ndarray:
+    enc = (C.BY_INT | ((C.BX_INT & 1) << 255)).to_bytes(32, "little")
+    return np.frombuffer(enc, dtype=np.uint8)
+
+
+_B_ENC = _b_enc_bytes()
+
+
+def verify_batch_rlc(pubkeys, msgs, sigs) -> bool:
+    """All-or-nothing RLC batch verification.  True: every signature
+    passes (cofactored semantics — see module docstring); False: at least
+    one signature fails OR the batch is ineligible (non-canonical
+    encodings, bucket overflow) — the caller must fall back to the
+    per-signature path for exact attribution."""
+    from tendermint_tpu.libs import native
+
+    pub_m = ed._to_u8_matrix(pubkeys, 32)
+    sig_m = ed._to_u8_matrix(sigs, 64)
+    n = pub_m.shape[0]
+    if n == 0:
+        return True
+    _, r_bytes, s_bytes, k, host_ok = ed._stage_rows(pub_m, sig_m, msgs)
+    if not host_ok.all() or not _r_canonical(r_bytes).all():
+        return False
+    z = np.frombuffer(os.urandom(16 * n), dtype=np.uint8).reshape(n, 16)
+    res = native.rlc_scalars(z, k, s_bytes)
+    if res is None:
+        res = _rlc_scalars_host(z, k, s_bytes)
+    zk, zs = res
+    # pad to the shared shape bucket with zero-scalar basepoint items:
+    # digit 0 everywhere -> bucket 0 -> weight 0, and B decodes fine
+    nb = ed.bucket_size(n)
+    if nb != n:
+        pad = nb - n
+        r_bytes = np.concatenate(
+            [r_bytes, np.broadcast_to(_B_ENC, (pad, 32))])
+        pub_m = np.concatenate([pub_m, np.broadcast_to(_B_ENC, (pad, 32))])
+        zk = np.concatenate([zk, np.zeros((pad, 32), np.uint8)])
+        z = np.concatenate([z, np.zeros((pad, 16), np.uint8)])
+    c = _pick_c(nb)
+    ws, ok_all, overflow = _msm_core(
+        jnp.asarray(r_bytes), jnp.asarray(pub_m), jnp.asarray(zk),
+        jnp.asarray(z), jnp.asarray(zs), c)
+    if not bool(ok_all) or bool(overflow):
+        return False
+    return _combine_windows_host(np.asarray(ws), c)

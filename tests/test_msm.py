@@ -1,0 +1,134 @@
+"""RLC+Pippenger MSM batch verification (ops/msm.py): the all-valid fast
+path must accept exactly the batches the per-signature kernel accepts
+(honest-signer signatures), reject every tampered class, screen
+non-canonical encodings, and fall back with exact attribution through the
+verify_batch seam (reference types/validator_set.go:657-661 check-all
+semantics; docs/adr/009-rlc-batch-verification.md)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.ops import ed25519 as edops
+from tendermint_tpu.ops import msm
+
+
+def _batch(n, tag=b""):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
+
+    privs = [Ed25519PrivateKey.from_private_bytes(
+        (9000 + i).to_bytes(32, "little")) for i in range(n)]
+    msgs = [b"msm vote %d " % i + tag for i in range(n)]
+    sigs = [privs[i].sign(msgs[i]) for i in range(n)]
+    pubs = [k.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+            for k in privs]
+    return pubs, msgs, sigs
+
+
+def test_rlc_accepts_valid_rejects_tampered():
+    pubs, msgs, sigs = _batch(50)
+    assert msm.verify_batch_rlc(pubs, msgs, sigs) is True
+    # every tamper class must fail the linear combination
+    bad_sig = [bytearray(s) for s in sigs]
+    bad_sig[7][3] ^= 1
+    assert msm.verify_batch_rlc(
+        pubs, msgs, [bytes(b) for b in bad_sig]) is False
+    bad_msg = list(msgs)
+    bad_msg[0] = b"tampered"
+    assert msm.verify_batch_rlc(pubs, bad_msg, sigs) is False
+    bad_pub = list(pubs)
+    bad_pub[3] = pubs[4]
+    assert msm.verify_batch_rlc(bad_pub, msgs, sigs) is False
+    # swapped signatures (valid individually, wrong lanes)
+    swapped = list(sigs)
+    swapped[1], swapped[2] = swapped[2], swapped[1]
+    assert msm.verify_batch_rlc(pubs, msgs, swapped) is False
+
+
+def test_rlc_screens_noncanonical_encodings():
+    pubs, msgs, sigs = _batch(8)
+    # s >= L: the host canonicity screen must bounce the batch
+    bad = [bytearray(s) for s in sigs]
+    bad[1][63] = 0xFF
+    assert msm.verify_batch_rlc(pubs, msgs, [bytes(b) for b in bad]) \
+        is False
+    # non-canonical R (y >= p): decodable, but the per-sig byte compare
+    # rejects it, so the fast path must refuse to vouch for the batch
+    bad = [bytearray(s) for s in sigs]
+    bad[2][:32] = (2**255 - 19).to_bytes(32, "little")  # y = p -> y mod p = 0
+    assert msm.verify_batch_rlc(pubs, msgs, [bytes(b) for b in bad]) \
+        is False
+
+
+def test_r_canonical_vector():
+    p = 2**255 - 19
+    rows = np.stack([
+        np.frombuffer((p - 1).to_bytes(32, "little"), np.uint8),
+        np.frombuffer(p.to_bytes(32, "little"), np.uint8),
+        np.frombuffer((p + 5).to_bytes(32, "little"), np.uint8),
+        # sign bit set on a canonical y must stay canonical
+        np.frombuffer(((p - 1) | (1 << 255)).to_bytes(32, "little"),
+                      np.uint8),
+        np.frombuffer((0).to_bytes(32, "little"), np.uint8),
+    ])
+    assert msm._r_canonical(rows).tolist() == [True, False, False, True,
+                                               True]
+
+
+def test_verify_batch_seam_fast_path_and_fallback(monkeypatch):
+    """Through the production seam: an all-valid batch takes the RLC fast
+    path (observed via a call counter), an invalid batch falls back to
+    the per-sig kernel with an EXACT bitmap."""
+    monkeypatch.setenv("TM_TPU_RLC_MIN", "16")
+    # the virtual 8-device CPU mesh (conftest) would otherwise route the
+    # batch through the sharded data plane before RLC is considered
+    monkeypatch.setattr("tendermint_tpu.parallel.sharding.data_plane",
+                        lambda: None)
+    pubs, msgs, sigs = _batch(50)
+    calls = []
+    orig = msm.verify_batch_rlc
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        calls.append(r)
+        return r
+
+    monkeypatch.setattr(msm, "verify_batch_rlc", spy)
+    out = edops.verify_batch(pubs, msgs, sigs)
+    assert out.all() and calls == [True]
+
+    bad = [bytearray(s) for s in sigs]
+    bad[11][5] ^= 0x40
+    out = edops.verify_batch(pubs, msgs, [bytes(b) for b in bad])
+    assert calls == [True, False]
+    want = np.ones(50, dtype=bool)
+    want[11] = False
+    assert (out == want).all()
+
+
+def test_rlc_bucket_overflow_falls_back(monkeypatch):
+    """A (vanishingly unlikely) bucket overflow must be detected on
+    device and routed to the per-sig path, never silently truncated."""
+    class TinyT(msm.Plan):
+        def __init__(self, n, c):
+            super().__init__(n, c)
+            self.T = 1
+
+    monkeypatch.setattr(msm, "Plan", TinyT)
+    pubs, msgs, sigs = _batch(8)
+    import jax
+    with jax.disable_jit():
+        assert msm.verify_batch_rlc(pubs, msgs, sigs) is False
+
+
+def test_combine_windows_host_identity():
+    """Zero window sums (all-identity buckets) combine to the identity."""
+    from tendermint_tpu.ops import field as F
+    W = 4
+    ws = np.zeros((4, F.NLIMB, W), dtype=np.int32)
+    ws[1, 0, :] = 1  # y = 1
+    ws[2, 0, :] = 1  # z = 1
+    assert msm._combine_windows_host(ws, 4) is True
