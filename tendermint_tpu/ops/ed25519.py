@@ -600,17 +600,20 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     steady-state VerifyCommit ships 96 B/sig instead of 128."""
     from tendermint_tpu.parallel.sharding import data_plane
 
-    plane = data_plane()
-    if plane is not None and plane.worth_sharding(len(pubkeys)):
-        return plane.verify_batch(pubkeys, msgs, sigs)
     from . import msm
     if msm.use_rlc(len(pubkeys)):
         # RLC+Pippenger MSM fast path (~10x less device compute than the
         # per-sig ladder): one random-linear-combination check accepts the
-        # whole batch; on failure fall through to the exact per-signature
-        # kernel for check-all attribution (docs/adr/009)
+        # whole batch; on failure fall through to the sharded/per-sig
+        # paths for check-all attribution (docs/adr/009).  Tried BEFORE
+        # the mesh plane: the plane parallelizes the per-sig kernel, but
+        # RLC needs ~10x less total compute even on one device; sharding
+        # the MSM itself over the mesh is the noted follow-up.
         if msm.verify_batch_rlc(pubkeys, msgs, sigs):
             return np.ones(len(pubkeys), dtype=bool)
+    plane = data_plane()
+    if plane is not None and plane.worth_sharding(len(pubkeys)):
+        return plane.verify_batch(pubkeys, msgs, sigs)
     if _use_pallas():
         from . import pallas_ed25519 as pe
         if cache_pubs and len(pubkeys) >= PUB_CACHE_MIN:

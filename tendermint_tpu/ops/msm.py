@@ -86,6 +86,10 @@ class Plan:
         self.W_A = -(-253 // c)
         self.W_R = -(-128 // c)
         self.K = self.W_A << c
+        # bucket lanes padded to a full TPU lane tile so the Pallas scan
+        # always gets 256-wide blocks; the pad lanes hold identity layers
+        # and are sliced off before aggregation
+        self.K_pad = -(-self.K // 256) * 256
         self.M = n * (self.W_A + self.W_R) + self.W_A
         avg = self.M / self.K
         # layered-scan depth: mean bucket load plus a Poisson tail margin
@@ -148,10 +152,11 @@ def _ext_add(p: C.Ext, q: C.Ext) -> C.Ext:
 
 
 def _bucket_scan_xla(layers, K: int) -> C.Ext:
-    """layers: Cached arrays each (T, NLIMB, K).  Returns bucket sums as
-    Ext (NLIMB, K)."""
+    """layers: Niels arrays each (T, NLIMB, K) — every table row has
+    Z = 1 (decompressed points, identity, basepoint), so the scan step is
+    the cheaper niels mixed add.  Returns bucket sums as Ext (NLIMB, K)."""
     def body(acc, layer):
-        return C.add_cached(acc, C.Cached(*layer)), None
+        return C.madd_niels(acc, C.Niels(*layer)), None
 
     acc, _ = jax.lax.scan(body, C.identity((K,)), layers)
     return acc
@@ -180,51 +185,69 @@ def _aggregate(acc: C.Ext, W: int, c: int) -> C.Ext:
     return total
 
 
-# the basepoint's cached row and the cached identity, as import-time consts
-def _cached_row_ints(x: int, y: int):
+# the basepoint's niels row and the niels identity, as import-time consts
+def _niels_row_ints(x: int, y: int):
     t = x * y % C.P
-    return ((y + x) % C.P, (y - x) % C.P, 1, 2 * C.D_INT * t % C.P)
+    return ((y + x) % C.P, (y - x) % C.P, 2 * C.D_INT * t % C.P)
 
 
-_B_CACHED = _cached_row_ints(C.BX_INT, C.BY_INT)
-_ID_CACHED = (1, 1, 1, 0)
+_B_NIELS = _niels_row_ints(C.BX_INT, C.BY_INT)
+_ID_NIELS = (1, 1, 0)
+
+
+def assemble_table(coords):
+    """Wrap decompressed negated-niels coords (3 arrays (NLIMB, 2n)) into
+    the MSM point table: row 0 = identity, rows 1..n = -R, rows n+1..2n =
+    -A, row 2n+1 = B.  Single source of the table layout for the XLA and
+    Pallas builders."""
+    consts = np.zeros((3, F.NLIMB, 2), dtype=np.int32)
+    for j, (ident_v, b_v) in enumerate(zip(_ID_NIELS, _B_NIELS)):
+        consts[j, :, 0] = F.int_to_limbs(ident_v)
+        consts[j, :, 1] = F.int_to_limbs(b_v)
+    consts = jnp.asarray(consts)
+    return tuple(
+        jnp.concatenate([consts[j][:, :1], coord, consts[j][:, 1:]],
+                        axis=1)
+        for j, coord in enumerate(coords))
 
 
 def _build_table(r_bytes, pub_bytes):
-    """Decompress -R_i and -A_i on device and assemble the cached-point
-    table: row 0 = identity, rows 1..n = -R, rows n+1..2n = -A, row
-    2n+1 = B.  Returns (4 cached arrays (NLIMB, 2n+2), ok_all scalar)."""
-    n = r_bytes.shape[0]
+    """Decompress -R_i and -A_i on device and assemble the niels-point
+    table (every row has Z = 1).  Returns (3 niels arrays
+    (NLIMB, 2n+2), ok_all scalar)."""
     yr, sr = _bytes_to_y_sign(r_bytes)
     ya, sa = _bytes_to_y_sign(pub_bytes)
     y = jnp.concatenate([yr, ya], axis=1)
     s = jnp.concatenate([sr, sa], axis=0)
     pt, ok = C.decompress(y, s)
-    # negate: both R and A enter the MSM negated
-    neg = C.Ext(F.carry_lazy(-pt.x), pt.y, pt.z, F.carry_lazy(-pt.t))
-    cached = C.to_cached(neg)
-    consts = np.zeros((4, F.NLIMB, 2), dtype=np.int32)
-    for j, (ident_v, b_v) in enumerate(zip(_ID_CACHED, _B_CACHED)):
-        consts[j, :, 0] = F.int_to_limbs(ident_v)
-        consts[j, :, 1] = F.int_to_limbs(b_v)
-    consts = jnp.asarray(consts)
-    rows = tuple(
-        jnp.concatenate([consts[j][:, :1], cached[j], consts[j][:, 1:]],
-                        axis=1)
-        for j in range(4))
-    return rows, jnp.all(ok)
+    # negate: both R and A enter the MSM negated.  niels(-P) swaps
+    # (y+x, y-x) and negates 2dt
+    ypx = F.carry_lazy(pt.y - pt.x)
+    ymx = F.carry_lazy(pt.y + pt.x)
+    t2d = F.mul(F.carry_lazy(-pt.t), C._d2)
+    return assemble_table((ypx, ymx, t2d)), jnp.all(ok)
 
 
-@partial(jax.jit, static_argnames=("c",))
-def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int):
+@partial(jax.jit, static_argnames=("c", "use_pallas"))
+def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int,
+              use_pallas: bool = False):
     """The full device pipeline.  Inputs (all uint8, batch-major):
     r_bytes/pub_bytes/zk (n, 32), z (n, 16), zs (32,).  Returns
-    (window sums stacked (4, NLIMB, W_A), decode_ok_all, overflow)."""
+    (window sums stacked (4, NLIMB, W_A), decode_ok_all, overflow).
+
+    use_pallas routes the two arithmetic-dense stages (point
+    decompression, layered bucket fill) through the fused Mosaic kernels
+    (ops/pallas_msm.py); digits/sort/gather/aggregation stay XLA."""
     n = r_bytes.shape[0]
     plan = Plan(n, c)
     W_A, W_R, K, M, T = plan.W_A, plan.W_R, plan.K, plan.M, plan.T
+    K_pad = plan.K_pad
 
-    table, ok_all = _build_table(r_bytes, pub_bytes)
+    if use_pallas:
+        from . import pallas_msm as pm
+        table, ok_all = pm.build_table_pallas(r_bytes, pub_bytes)
+    else:
+        table, ok_all = _build_table(r_bytes, pub_bytes)
 
     dA = _digits(zk, c, W_A)                       # (W_A, n)
     dR = _digits(z, c, W_R)                        # (W_R, n)
@@ -261,12 +284,21 @@ def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int):
     pos = jnp.clip(starts[:-1][None, :] + t_idx, 0, M - 1)
     valid = t_idx < seg_len[None, :]
     layer_rows = jnp.where(valid, srows[pos], 0)              # (T, K)
+    if K_pad != K:  # pad bucket lanes to the TPU lane tile (identity rows)
+        layer_rows = jnp.pad(layer_rows, ((0, 0), (0, K_pad - K)))
 
     idx = layer_rows.reshape(-1)
     layers = tuple(
-        jnp.take(tab, idx, axis=1).reshape(F.NLIMB, T, K).transpose(1, 0, 2)
+        jnp.take(tab, idx, axis=1).reshape(F.NLIMB, T, K_pad)
+        .transpose(1, 0, 2)
         for tab in table)
-    buckets = _bucket_scan_xla(layers, K)
+    if use_pallas:
+        from . import pallas_msm as pm
+        buckets = pm.bucket_scan_pallas(layers, K_pad)
+    else:
+        buckets = _bucket_scan_xla(layers, K_pad)
+    if K_pad != K:
+        buckets = C.Ext(*(v[:, :K] for v in buckets))
     wsums = _aggregate(buckets, W_A, c)
     return jnp.stack(list(wsums)), ok_all, overflow
 
@@ -405,7 +437,7 @@ def verify_batch_rlc(pubkeys, msgs, sigs) -> bool:
     c = _pick_c(nb)
     ws, ok_all, overflow = _msm_core(
         jnp.asarray(r_bytes), jnp.asarray(pub_m), jnp.asarray(zk),
-        jnp.asarray(z), jnp.asarray(zs), c)
+        jnp.asarray(z), jnp.asarray(zs), c, use_pallas=ed._use_pallas())
     if not bool(ok_all) or bool(overflow):
         return False
     return _combine_windows_host(np.asarray(ws), c)

@@ -132,3 +132,63 @@ def test_combine_windows_host_identity():
     ws[1, 0, :] = 1  # y = 1
     ws[2, 0, :] = 1  # z = 1
     assert msm._combine_windows_host(ws, 4) is True
+
+
+def test_pallas_msm_kernels_interpret(monkeypatch):
+    """The fused Mosaic kernels (decompress-to-niels, layered bucket
+    scan) must agree with the XLA path through the pallas interpreter
+    (same jaxpr, CPU-executable; Mosaic lowering itself needs real
+    hardware)."""
+    import os
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    import tendermint_tpu.ops.pallas_msm as pm
+    from tendermint_tpu.libs import native
+
+    orig = pl.pallas_call
+    monkeypatch.setattr(
+        pm.pl, "pallas_call",
+        lambda *a, **k: orig(*a, **{**k, "interpret": True}))
+
+    n = 64
+    pubs, msgs, sigs = _batch(n)
+    pub_m = edops._to_u8_matrix(pubs, 32)
+    sig_m = edops._to_u8_matrix(sigs, 64)
+    _, r_b, s_b, k, host_ok = edops._stage_rows(pub_m, sig_m, msgs)
+    assert host_ok.all()
+    z = np.frombuffer(os.urandom(16 * n), np.uint8).reshape(n, 16)
+    res = native.rlc_scalars(z, k, s_b)
+    if res is None:
+        res = msm._rlc_scalars_host(z, k, s_b)
+    zk, zs = res
+    args = (jnp.asarray(r_b), jnp.asarray(pub_m), jnp.asarray(zk),
+            jnp.asarray(z), jnp.asarray(zs))
+    ws_p, ok_p, ovf_p = msm._msm_core(*args, 4, use_pallas=True)
+    assert bool(ok_p) and not bool(ovf_p)
+    assert msm._combine_windows_host(np.asarray(ws_p), 4) is True
+    # window sums must agree with the XLA path VALUE-wise (limb
+    # representations may differ: mul vs mul_const produce different
+    # loose forms of the same field element)
+    from tendermint_tpu.ops import curve as C
+    from tendermint_tpu.ops import field as F
+    ws_x, ok_x, ovf_x = msm._msm_core(*args, 4, use_pallas=False)
+    assert bool(ok_x) and not bool(ovf_x)
+    wp, wx = np.asarray(ws_p), np.asarray(ws_x)
+    for j in range(4):
+        for w in range(wp.shape[2]):
+            assert F.limbs_to_int(wp[j, :, w]) % C.P == \
+                F.limbs_to_int(wx[j, :, w]) % C.P, (j, w)
+    # tampered batch must fail through the pallas path too
+    sig2 = sig_m.copy()
+    sig2[5, 7] ^= 1
+    _, r_b2, s_b2, k2, _ = edops._stage_rows(pub_m, sig2, msgs)
+    res2 = native.rlc_scalars(z, k2, s_b2)
+    if res2 is None:
+        res2 = msm._rlc_scalars_host(z, k2, s_b2)
+    zk2, zs2 = res2
+    ws2, ok2, _ = msm._msm_core(
+        jnp.asarray(r_b2), jnp.asarray(pub_m), jnp.asarray(zk2),
+        jnp.asarray(z), jnp.asarray(zs2), 4, use_pallas=True)
+    assert msm._combine_windows_host(np.asarray(ws2), 4) is False
