@@ -145,36 +145,56 @@ class BatchVerifier:
         by_type: dict = {}
         for i, it in enumerate(self._items):
             by_type.setdefault(it.pub.type_name, []).append(i)
-        device_lane = None  # (idxs, future)
+        device_lanes = []  # [(idxs, future)] — one worker, runs in order
         host_lanes = []
         for tname, idxs in by_type.items():
             items = [self._items[i] for i in idxs]
-            if (tname == ed.KEY_TYPE and _use_device()
-                    and len(items) >= self.tpu_threshold
-                    and device_lane is None):
+            verifier = _device_verifier(tname)
+            if (verifier is not None and _use_device()
+                    and len(items) >= self.tpu_threshold):
                 fut = _device_lane_pool.submit(
-                    verify_ed25519_batch,
+                    verifier,
                     [it.pub.bytes() for it in items],
                     [it.msg for it in items],
                     [it.sig for it in items])
-                device_lane = (idxs, fut)
+                device_lanes.append((idxs, fut))
                 continue
             host_lanes.append((tname, idxs, items))
         try:
             for tname, idxs, items in host_lanes:
                 out[np.asarray(idxs)] = _host_verify_items(tname, items)
         finally:
-            if device_lane is not None:
-                # always drain the future: a host-lane exception must not
-                # abandon the in-flight device RPC (both failing chains
-                # via __context__)
-                idxs, fut = device_lane
-                out[np.asarray(idxs)] = fut.result()
+            # always drain EVERY future: a host-lane exception (or an
+            # earlier lane's failure) must not abandon an in-flight
+            # device RPC.  Collect per-lane errors, re-raise the first.
+            first_err = None
+            for idxs, fut in device_lanes:
+                try:
+                    out[np.asarray(idxs)] = fut.result()
+                except Exception as e:  # noqa: BLE001 - drain all lanes
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
         # remember the valid ones so later serial re-checks are cache hits
         for i, it in enumerate(self._items):
             if out[i]:
                 verified_sigs.add(it.pub.bytes(), it.msg, it.sig)
         return bool(out.all()), out
+
+
+def _device_verifier(tname: str):
+    """The TPU lane for a key scheme, or None if that scheme stays on the
+    host.  ed25519: the fused ladder / RLC MSM stack (ops/ed25519.py);
+    sr25519: same curve, ristretto lane (ops/sr25519.py)."""
+    if tname == ed.KEY_TYPE:
+        return verify_ed25519_batch
+    if tname == "sr25519":
+        def _sr(pubs, msgs, sigs):
+            from tendermint_tpu.ops import sr25519 as srlane
+            return srlane.verify_batch_device(pubs, msgs, sigs)
+        return _sr
+    return None
 
 
 def _host_verify_items(tname: str, items) -> np.ndarray:

@@ -113,6 +113,9 @@ def get_lib():
                                                         u8p, u8p, u8p, u64]
                 lib.tm_secp_verify_batch.restype = None
                 lib.tm_sr25519_verify_batch.restype = None
+                lib.tm_sr25519_stage.argtypes = [u8p, u8p, u64p, u8p,
+                                                 u8p, u8p, u8p, u64]
+                lib.tm_sr25519_stage.restype = None
                 for fn in (lib.tm_sha512_prefixed, lib.tm_sha512_batch,
                            lib.tm_sha512_plain, lib.tm_scalar_canonical,
                            lib.tm_mod_l, lib.tm_challenge_prefixed,
@@ -312,6 +315,30 @@ def _ec_verify(fn_name: str, keysize: int, pubs, msgs, sigs):
                           _u8p(sig_arr), _u8p(seed), _u8p(out),
                           ctypes.c_uint64(n))
     return out.astype(bool)
+
+
+def sr25519_stage(pubs, msgs, sigs):
+    """Host staging for the TPU sr25519 lane: merlin challenge k (mod L)
+    and unmasked s per signature, host screens (marker bit, s < L) as an
+    ok bitmap.  Returns (k (n,32), s (n,32), ok (n,)) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(pubs)
+    pub_arr = np.frombuffer(b"".join(bytes(p) for p in pubs),
+                            dtype=np.uint8)
+    sig_arr = np.frombuffer(b"".join(bytes(s) for s in sigs),
+                            dtype=np.uint8)
+    if pub_arr.size != n * 32 or sig_arr.size != n * 64:
+        return None
+    buf, offsets = _ragged(msgs, n)
+    out_k = np.empty((n, 32), dtype=np.uint8)
+    out_s = np.empty((n, 32), dtype=np.uint8)
+    ok = np.empty(n, dtype=np.uint8)
+    lib.tm_sr25519_stage(_u8p(pub_arr), _u8p(buf), _u64p(offsets),
+                         _u8p(sig_arr), _u8p(out_k), _u8p(out_s),
+                         _u8p(ok), ctypes.c_uint64(n))
+    return out_k, out_s, ok.astype(bool)
 
 
 def secp_verify(pubs, msgs, sigs) -> np.ndarray | None:

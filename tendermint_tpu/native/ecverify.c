@@ -1042,20 +1042,30 @@ static int scalar_lt_l(const u8 *s) {
     return 0;
 }
 
-static int sr25519_verify_one(const u8 *pub32, const u8 *msg, u64 mlen,
-                              const u8 *sig) {
+/* Shared scalar staging for every sr25519 entry point: schnorrkel
+ * marker bit, masked s with s < L screen, and the merlin transcript
+ * challenge k = H(transcript) mod L. */
+static int sr25519_stage_one(const u8 *pub32, const u8 *sig, const u8 *msg,
+                             u64 mlen, u8 *k32, u8 *s_out) {
     if (!(sig[63] & 0x80)) return 0; /* schnorrkel marker */
-    ept A, R, Rp, B, negA;
-    if (!ristretto_decode(&A, pub32)) return 0;
-    if (!ristretto_decode(&R, sig)) return 0;
     u8 s_bytes[32];
     memcpy(s_bytes, sig + 32, 32);
     s_bytes[31] &= 0x7F;
     if (!scalar_lt_l(s_bytes)) return 0;
-    /* challenge k = wide64 mod l (tm_mod_l expects 64B LE) */
-    u8 wide[64], k32[32];
+    u8 wide[64];
     sr25519_challenge(wide, pub32, sig, msg, mlen);
     tm_mod_l(wide, k32, 1);
+    memcpy(s_out, s_bytes, 32);
+    return 1;
+}
+
+static int sr25519_verify_one(const u8 *pub32, const u8 *msg, u64 mlen,
+                              const u8 *sig) {
+    ept A, R, Rp, B, negA;
+    u8 s_bytes[32], k32[32];
+    if (!sr25519_stage_one(pub32, sig, msg, mlen, k32, s_bytes)) return 0;
+    if (!ristretto_decode(&A, pub32)) return 0;
+    if (!ristretto_decode(&R, sig)) return 0;
     /* R' = s*B + k*(-A) */
     f25519_from_le(&B.x, BX_BYTES);
     f25519_from_le(&B.y, BY_BYTES);
@@ -1613,18 +1623,12 @@ static void ept_negate(ept *p) {
 
 static int sr_decode_one(sr_sig *o, const u8 *pub32, const u8 *msg,
                          u64 mlen, const u8 *sig) {
-    if (!(sig[63] & 0x80)) return 0;
+    u8 s_bytes[32], k32[32];
+    if (!sr25519_stage_one(pub32, sig, msg, mlen, k32, s_bytes)) return 0;
     ept A, R;
     if (!ristretto_decode(&A, pub32)) return 0;
     if (!ristretto_decode(&R, sig)) return 0;
-    u8 s_bytes[32];
-    memcpy(s_bytes, sig + 32, 32);
-    s_bytes[31] &= 0x7F;
-    if (!scalar_lt_l(s_bytes)) return 0;
     le_load4(o->s, s_bytes);
-    u8 wide[64], k32[32];
-    sr25519_challenge(wide, pub32, sig, msg, mlen);
-    tm_mod_l(wide, k32, 1);
     le_load4(o->c, k32);
     ept_negate(&A);
     ept_negate(&R);
@@ -1717,4 +1721,24 @@ EXPORT void tm_sr25519_verify_batch(const u8 *pubs32, const u8 *msgbuf,
     }
     free(ss);
     free(idx);
+}
+
+/* ------------------------------------------------ device-lane staging */
+
+/* Host staging for the TPU sr25519 lane (ops/sr25519.py): the merlin
+ * transcript challenge k = H(transcript) mod L and the unmasked scalar s,
+ * leaving ristretto decode + the double-scalar ladder to the device.
+ * out_ok = 0 marks signatures failing the HOST screens only (marker bit,
+ * s < L); curve-level rejects surface from the device kernel. */
+EXPORT void tm_sr25519_stage(const u8 *pubs32, const u8 *msgbuf,
+                             const u64 *offsets, const u8 *sigs,
+                             u8 *out_k, u8 *out_s, u8 *out_ok, u64 n) {
+    for (u64 i = 0; i < n; i++) {
+        const u8 *sig = sigs + 64 * i;
+        memset(out_k + 32 * i, 0, 32);
+        memset(out_s + 32 * i, 0, 32);
+        out_ok[i] = (u8)sr25519_stage_one(
+            pubs32 + 32 * i, sig, msgbuf + offsets[i],
+            offsets[i + 1] - offsets[i], out_k + 32 * i, out_s + 32 * i);
+    }
 }

@@ -334,21 +334,12 @@ def _gather_cached(tab: C.Cached, digit):
     return C.cond_neg_cached(q, digit < 0)
 
 
-def verify_impl(a_y, a_sign, r_bits, s_digits, k_digits):
-    """Batched cofactorless verify: ok iff A decodes and
-    encode([s]B + [k](-A)) == R.   All inputs batched on the last axis.
-
-    a_y: (NLIMB, B) limbs of A's y-encoding (sign bit masked)
-    a_sign: (B,) 0/1     r_bits: (256, B) 0/1
-    s_digits, k_digits: (64, B) int32 signed radix-16 digits
-    Returns (B,) bool.
-    """
-    a, decode_ok = C.decompress(a_y, a_sign)
-    neg_a = C.Ext(F.carry_lazy(-a.x), a.y, a.z, F.carry_lazy(-a.t))
+def straus_ladder(neg_a: C.Ext, s_digits, k_digits):
+    """The 64-iteration joint Straus ladder shared by the ed25519 and
+    sr25519 XLA lanes: returns [s]B + [k]neg_a for per-lane signed
+    radix-16 digit columns s_digits/k_digits ((64, B) int32)."""
     tab = _build_var_table(neg_a)
-
-    batch = a_y.shape[1:]
-    p0 = C.identity(batch)
+    p0 = C.identity(neg_a.x.shape[1:])
 
     def body(i, p):
         pos = 63 - i
@@ -361,10 +352,43 @@ def verify_impl(a_y, a_sign, r_bits, s_digits, k_digits):
         p = C.add_cached(p, _gather_cached(tab, da))
         return p
 
-    p = jax.lax.fori_loop(0, 64, body, p0)
+    return jax.lax.fori_loop(0, 64, body, p0)
+
+
+def verify_impl(a_y, a_sign, r_bits, s_digits, k_digits):
+    """Batched cofactorless verify: ok iff A decodes and
+    encode([s]B + [k](-A)) == R.   All inputs batched on the last axis.
+
+    a_y: (NLIMB, B) limbs of A's y-encoding (sign bit masked)
+    a_sign: (B,) 0/1     r_bits: (256, B) 0/1
+    s_digits, k_digits: (64, B) int32 signed radix-16 digits
+    Returns (B,) bool.
+    """
+    a, decode_ok = C.decompress(a_y, a_sign)
+    neg_a = C.Ext(F.carry_lazy(-a.x), a.y, a.z, F.carry_lazy(-a.t))
+    p = straus_ladder(neg_a, s_digits, k_digits)
     bits = C.encode_bits(p)
     r_eq = jnp.all(bits == r_bits, axis=0)
     return decode_ok & r_eq
+
+
+def bytes256_to_limbs(b, mask_sign: bool = False):
+    """(B, 32) uint8 rows -> ((NLIMB, B) radix-2^12 limbs, (B,) bit 255).
+    With mask_sign the top bit is cleared before packing (the ed25519
+    y-encoding convention); the returned sign is bit 255 either way.
+    Shared by the ed25519 staging and the sr25519 ristretto lane."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((b[:, :, None] >> shifts) & 1).reshape(b.shape[0], 256)
+    bits = bits.astype(jnp.int32)
+    sign = bits[:, 255]
+    if mask_sign:
+        bits = bits.at[:, 255].set(0)
+    pad = jnp.zeros((b.shape[0], F.TOTAL_BITS - 256), dtype=jnp.int32)
+    bits = jnp.concatenate([bits, pad], axis=1)
+    weights = (1 << jnp.arange(F.RADIX, dtype=jnp.int32))
+    limbs = (bits.reshape(-1, F.NLIMB, F.RADIX) * weights).sum(
+        axis=-1, dtype=jnp.int32).T
+    return limbs, sign
 
 
 def device_stage(pub, r, s_digits, k_digits):
@@ -375,17 +399,8 @@ def device_stage(pub, r, s_digits, k_digits):
 
     pub, r: (B, 32) uint8;  s_digits, k_digits: (B, 64) int8.
     """
+    a_y, a_sign = bytes256_to_limbs(pub, mask_sign=True)
     shifts = jnp.arange(8, dtype=jnp.uint8)
-    pub_bits = ((pub[:, :, None] >> shifts) & 1).reshape(pub.shape[0], 256)
-    pub_bits = pub_bits.astype(jnp.int32)
-    a_sign = pub_bits[:, 255]
-    y_bits = pub_bits.at[:, 255].set(0)  # mask the x-sign bit
-    # (B, 256) bits -> (NLIMB, B) radix-2^12 limbs
-    pad = jnp.zeros((pub.shape[0], F.TOTAL_BITS - 256), dtype=jnp.int32)
-    y_bits = jnp.concatenate([y_bits, pad], axis=1)
-    weights = (1 << jnp.arange(F.RADIX, dtype=jnp.int32))
-    a_y = (y_bits.reshape(-1, F.NLIMB, F.RADIX) * weights).sum(
-        axis=-1, dtype=jnp.int32).T
     r_bits = ((r[:, :, None] >> shifts) & 1).reshape(r.shape[0], 256)
     r_bits = r_bits.astype(jnp.int32).T
     return (a_y, a_sign, r_bits,

@@ -116,17 +116,7 @@ def _pick_c(n: int) -> int:
 
 def _bytes_to_y_sign(b):
     """(m, 32) uint8 rows -> ((NLIMB, m) limbs of low 255 bits, (m,) sign)."""
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = ((b[:, :, None] >> shifts) & 1).reshape(b.shape[0], 256)
-    bits = bits.astype(_i32)
-    sign = bits[:, 255]
-    y_bits = bits.at[:, 255].set(0)
-    pad = jnp.zeros((b.shape[0], F.TOTAL_BITS - 256), dtype=_i32)
-    y_bits = jnp.concatenate([y_bits, pad], axis=1)
-    weights = 1 << jnp.arange(F.RADIX, dtype=_i32)
-    y = (y_bits.reshape(-1, F.NLIMB, F.RADIX) * weights).sum(
-        axis=-1, dtype=_i32).T
-    return y, sign
+    return ed.bytes256_to_limbs(b, mask_sign=True)
 
 
 def _digits(b, c: int, W: int):

@@ -1,0 +1,96 @@
+"""Batched ristretto255 group encoding on TPU (XLA-composed over
+ops/field.py) — the device half of the sr25519 lane.
+
+The reference verifies sr25519 one signature at a time through
+go-schnorrkel (reference crypto/sr25519/pubkey.go:29-59); the repo's host
+C lane (native/ecverify.c) batches with RLC+Pippenger on one CPU core.
+This module moves the curve work onto TPU lanes: ristretto decode is an
+inverse-square-root chain (~300 field muls, the same shape as ed25519
+point decompression) and runs one point per lane.
+
+Algorithms follow RFC 9496 §4.3.1 (decode) and §4.5 (equality), checked
+against crypto/_ristretto.py (the bignum reference implementation) in
+tests/test_sr25519_lane.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import curve as C
+from . import field as F
+
+_i32 = jnp.int32
+
+# sqrt(-1) as limbs comes from curve.py; D too.  The decode needs no
+# other curve constants.
+
+
+def _sqrt_ratio_m1(u, v):
+    """(was_square, r) with r = sqrt(u/v) (or sqrt(i*u/v) when u/v is
+    non-square), RFC 9496 §4.2, batched over trailing axes.  r is the
+    nonnegative root."""
+    v3 = F.mul(F.sqr(v), v)
+    v7 = F.mul(F.sqr(v3), v)
+    r = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
+    check = F.mul(v, F.sqr(r))
+    neg_u = F.carry_lazy(-u)
+    correct = F.eq(check, u)
+    flipped = F.eq(check, neg_u)
+    flipped_i = F.eq(check, F.mul(neg_u, C._sqrt_m1))
+    r = F.select(flipped | flipped_i, F.mul(r, C._sqrt_m1), r)
+    # CT_ABS: the nonnegative root
+    r = F.select(F.is_neg(r), F.carry_lazy(-r), r)
+    return correct | flipped, r
+
+
+def decode(s_limbs):
+    """Batched ristretto255 decode (RFC 9496 §4.3.1) from field-element
+    limbs of the encoding (caller enforces the byte-level canonicity
+    screens: s < p and s nonnegative/even — both host-vectorizable).
+    Returns (Ext point, ok)."""
+    batch = s_limbs.shape[1:]
+    one = F.one(batch)
+    s = F.carry_lazy(s_limbs)
+    ss = F.sqr(s)
+    u1 = F.carry_lazy(one - ss)
+    u2 = F.carry_lazy(F.add(one, ss))
+    u2_sqr = F.sqr(u2)
+    # v = -(D * u1^2) - u2_sqr
+    du1sq = F.mul(F.sqr(u1), C._d)
+    v = F.carry_lazy(F.carry_lazy(-du1sq) - u2_sqr)
+    was_square, invsqrt = _sqrt_ratio_m1(one, F.mul(v, u2_sqr))
+    den_x = F.mul(invsqrt, u2)
+    den_y = F.mul(F.mul(invsqrt, den_x), v)
+    x = F.mul(F.add(s, s), den_x)
+    x = F.select(F.is_neg(x), F.carry_lazy(-x), x)   # CT_ABS
+    y = F.mul(u1, den_y)
+    t = F.mul(x, y)
+    ok = was_square & ~F.is_neg(t) & ~F.is_zero(y)
+    return C.Ext(x, y, F.one(batch), t), ok
+
+
+def eq(p: C.Ext, q: C.Ext):
+    """Batched ristretto equality (RFC 9496 §4.5, a = -1):
+    representatives are equal iff x1*y2 == y1*x2 or y1*y2 == x1*x2
+    (crypto/_ristretto.py Point.equals is the bignum reference)."""
+    a = F.eq(F.mul(p.x, q.y), F.mul(p.y, q.x))
+    b = F.eq(F.mul(p.y, q.y), F.mul(p.x, q.x))
+    return a | b
+
+
+def bytes_canonical_nonneg(b: "np.ndarray"):
+    """Host screen for ristretto encodings: value < p AND even (the
+    IS_NEGATIVE(s) check of RFC 9496 on the canonical value).  b: (n, 32)
+    uint8.  Returns (n,) bool (numpy)."""
+    import numpy as np
+
+    w = np.ascontiguousarray(b).copy()
+    high_ok = (w[:, 31] & 0x80) == 0      # bit 255 must be clear
+    ww = w.view("<u8")
+    top = np.uint64(0x7FFFFFFFFFFFFFFF)
+    ones = np.uint64(0xFFFFFFFFFFFFFFFF)
+    lo = np.uint64(0xFFFFFFFFFFFFFFED)
+    lt_p = ~((ww[:, 3] == top) & (ww[:, 2] == ones) & (ww[:, 1] == ones)
+             & (ww[:, 0] >= lo)) & ((ww[:, 3] >> np.uint64(63)) == 0)
+    even = (w[:, 0] & 1) == 0
+    return high_ok & lt_p & even
