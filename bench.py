@@ -71,6 +71,34 @@ def main():
     cpu_rate = nbase / (time.perf_counter() - t0)
 
     # --- TPU batched verify --------------------------------------------
+    # Degradation, not rc=1: a missing/unreachable accelerator (tunnel
+    # down, backend init failure) must report the host path's number with
+    # an explicit note — the same ladder the node itself follows
+    # (crypto/degrade.py), so a bench run on a degraded host still emits
+    # ONE parseable JSON line instead of a traceback.
+    try:
+        _device_bench(pubs, msgs, sigs, cpu_rate, t_start)
+    except AssertionError:
+        # correctness asserts (kernel rejected valid signatures, bad
+        # readback) must stay LOUD: a device computing wrong results is
+        # a bug report, not an availability problem
+        raise
+    except Exception as e:  # noqa: BLE001 - backend/tunnel faults degrade
+        print(json.dumps({
+            "metric": "ed25519_verify_throughput_e2e",
+            "value": round(cpu_rate, 1),
+            "unit": "sigs/s/chip",
+            "vs_baseline": 1.0,
+            "median_value": round(cpu_rate, 1),
+            "median_vs_baseline": 1.0,
+            "note": "device unavailable, host fallback",
+        }))
+        print(f"# device bench failed, host fallback: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return
+
+
+def _device_bench(pubs, msgs, sigs, cpu_rate, t_start):
     import jax
     import jax.numpy as jnp
     from tendermint_tpu.ops import ed25519 as edops

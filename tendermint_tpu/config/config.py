@@ -7,7 +7,10 @@ data/ (stores + WAL).
 from __future__ import annotations
 
 import os
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is the same parser/API
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 from tendermint_tpu.consensus.config import ConsensusConfig
@@ -117,6 +120,11 @@ class BatchVerifierConfig:
     """TPU data-plane routing (no reference analog — the new component)."""
     tpu_threshold: int = 32
     enable: bool = True
+    # opt-in to the cofactored RLC batch fast path (ops/msm.py).  OFF by
+    # default for wire-compat: RLC uses ZIP-215/cofactored semantics, the
+    # reference Go verifier is cofactorless, and a mixed fleet could be
+    # chain-split by an adversarial small-order-component signature.
+    rlc: bool = False
 
 
 @dataclass
@@ -250,6 +258,7 @@ trust_period = {self.state_sync.trust_period}
 [batch_verifier]
 tpu_threshold = {self.batch_verifier.tpu_threshold}
 enable = {str(self.batch_verifier.enable).lower()}
+rlc = {str(self.batch_verifier.rlc).lower()}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -321,7 +330,8 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
         bv = d.get("batch_verifier", {})
         cfg.batch_verifier = BatchVerifierConfig(
             tpu_threshold=bv.get("tpu_threshold", 32),
-            enable=bv.get("enable", True))
+            enable=bv.get("enable", True),
+            rlc=bool(bv.get("rlc", False)))
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

@@ -154,11 +154,25 @@ class ConsensusState(BaseService):
                 # block, so there is nothing left to replay)
                 self.log.info("catchup replay error, proceeding to "
                               "start state anyway", err=str(e))
+        # the receive-loop coalescer batch-verifies queued votes through
+        # the device lane (_preverify_votes); observe breaker transitions
+        # so the log shows when vote preverification degrades to the host
+        # path and when the lane recovers (crypto/degrade.py)
+        from tendermint_tpu.crypto import degrade
+        self._breaker_unsub = degrade.runtime().breaker.add_listener(
+            self._on_breaker_transition)
         self._thread = self.spawn(self._receive_routine,
                                   name=f"consensus-{self.name}")
         self._schedule_round0()
 
+    def _on_breaker_transition(self, old: str, new: str, reason: str):
+        self.log.info("vote preverify device lane breaker transition",
+                      **{"from": old}, to=new, reason=reason)
+
     def on_stop(self):
+        if getattr(self, "_breaker_unsub", None) is not None:
+            self._breaker_unsub()
+            self._breaker_unsub = None
         self._ticker.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -15,44 +15,58 @@ per-scheme sub-batches and merge bitmaps by original index.
 """
 from __future__ import annotations
 
-import concurrent.futures as _cf
 import os
+import random
 from dataclasses import dataclass, field
+from functools import partial
 from typing import List, Sequence, Tuple
 
 import numpy as np
 
 from . import PubKey
+from . import degrade
 from . import ed25519 as ed
-
-
-_backend_ok = None
-
-# single worker for the device (ed25519) lane of mixed batches: verify()
-# sits on the vote-processing hot path, so the thread is spawned once,
-# not per call.  One worker is correct: jax dispatch is serialized per
-# device anyway.
-_device_lane_pool = _cf.ThreadPoolExecutor(
-    max_workers=1, thread_name_prefix="batch-device-lane")
 
 
 def _use_device() -> bool:
     """Route to the device kernel only when an accelerator is attached.
     When jax's default backend is plain host CPU the serial OpenSSL path is
     strictly faster than the jitted ladder, so the batch stays on the host
-    (TM_TPU_FORCE_BATCH=1 overrides, for kernel tests on CPU)."""
+    (TM_TPU_FORCE_BATCH=1 overrides, for kernel tests on CPU).  Backend
+    probing lives in the degradation runtime: an init FAILURE is re-probed
+    with backoff instead of cached forever, and the circuit breaker (which
+    gates each launch separately, in try_acquire) still applies under
+    FORCE_BATCH so chaos tests exercise it on CPU."""
     if os.environ.get("TM_TPU_DISABLE_BATCH", "") == "1":
         return False
     if os.environ.get("TM_TPU_FORCE_BATCH", "") == "1":
         return True
-    global _backend_ok
-    if _backend_ok is None:
+    return degrade.runtime().backend_available()
+
+
+def _spot_check(n, triple_at):
+    """Integrity guard closure for a device lane: re-verify ONE random
+    triple on the host and require the device's bit to agree — one host
+    verify per launch, and a device returning garbage bitmaps (chaos
+    mode "corrupt-bitmap", a real silent-corruption class) is degraded
+    instead of trusted.  `triple_at(j) -> (pub, msg, sig)` with pub a
+    PubKey object."""
+    def check(bits: np.ndarray) -> bool:
+        if n == 0 or len(bits) != n:
+            return len(bits) == n
+        j = random.randrange(n)
         try:
-            import jax
-            _backend_ok = jax.default_backend() != "cpu"
-        except Exception:
-            _backend_ok = False
-    return _backend_ok
+            pub, msg, sig = triple_at(j)
+            host = pub.verify_signature(msg, sig)
+        except Exception:  # noqa: BLE001 - malformed input = invalid
+            host = False
+        return bool(bits[j]) == bool(host)
+    return check
+
+
+def _spot_check_items(items):
+    return _spot_check(len(items),
+                       lambda j: (items[j].pub, items[j].msg, items[j].sig))
 
 
 @dataclass
@@ -145,37 +159,41 @@ class BatchVerifier:
         by_type: dict = {}
         for i, it in enumerate(self._items):
             by_type.setdefault(it.pub.type_name, []).append(i)
-        device_lanes = []  # [(idxs, future)] — one worker, runs in order
+        rt = degrade.runtime()
+        device_lanes = []  # [(tname, idxs, items, future)] — one worker
         host_lanes = []
         for tname, idxs in by_type.items():
             items = [self._items[i] for i in idxs]
             verifier = _device_verifier(tname)
             if (verifier is not None and _use_device()
                     and len(items) >= self.tpu_threshold):
-                fut = _device_lane_pool.submit(
-                    verifier,
-                    [it.pub.bytes() for it in items],
-                    [it.msg for it in items],
-                    [it.sig for it in items])
-                device_lanes.append((idxs, fut))
-                continue
+                if rt.try_acquire():
+                    fut = rt.submit(
+                        f"batch.{tname}", verifier,
+                        [it.pub.bytes() for it in items],
+                        [it.msg for it in items],
+                        [it.sig for it in items])
+                    device_lanes.append((tname, idxs, items, fut))
+                    continue
+                # breaker open: this lane WOULD have gone to the device
+                rt.metrics.host_fallbacks.inc(site=f"batch.{tname}",
+                                              reason="breaker_open")
             host_lanes.append((tname, idxs, items))
         try:
             for tname, idxs, items in host_lanes:
                 out[np.asarray(idxs)] = _host_verify_items(tname, items)
         finally:
-            # always drain EVERY future: a host-lane exception (or an
-            # earlier lane's failure) must not abandon an in-flight
-            # device RPC.  Collect per-lane errors, re-raise the first.
-            first_err = None
-            for idxs, fut in device_lanes:
-                try:
-                    out[np.asarray(idxs)] = fut.result()
-                except Exception as e:  # noqa: BLE001 - drain all lanes
-                    if first_err is None:
-                        first_err = e
-            if first_err is not None:
-                raise first_err
+            # always settle EVERY device lane: a host-lane exception must
+            # not abandon an in-flight device RPC or leave the breaker's
+            # acquire unbalanced.  collect() never raises — a launch that
+            # times out, raises, or fails the host spot check is counted
+            # against the breaker and the lane re-verifies through the
+            # host path, preserving the exact per-triple bitmap.
+            for tname, idxs, items, fut in device_lanes:
+                out[np.asarray(idxs)] = rt.collect(
+                    f"batch.{tname}", fut,
+                    host_fn=partial(_host_verify_items, tname, items),
+                    spot_check=_spot_check_items(items))
         # remember the valid ones so later serial re-checks are cache hits
         for i, it in enumerate(self._items):
             if out[i]:
@@ -243,23 +261,59 @@ def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
     commit would evict the live-vote window; callers that need cache
     population use BatchVerifier)."""
     n = len(pubs)
+    rt = degrade.runtime()
     if isinstance(pubs, np.ndarray):
         # (n, 32) raw ed25519 pubkey matrix — the validator-set fast
         # path (types/validator_set._pub_matrix): no per-key objects
         if n >= tpu_threshold and _use_device():
-            return verify_ed25519_batch(pubs, msgs, sigs, cache_pubs=True)
+            return rt.run(
+                "bulk.ed25519",
+                partial(verify_ed25519_batch, pubs, msgs, sigs,
+                        cache_pubs=True),
+                host_fn=partial(_host_bulk_ed25519, pubs, msgs, sigs),
+                spot_check=_spot_check_bulk(pubs, msgs, sigs))
         pubs = [ed.PubKey(bytes(p)) for p in pubs]
     if (n >= tpu_threshold and _use_device()
             and all(p.type_name == ed.KEY_TYPE for p in pubs)):
         # cache_pubs: a validator set's keys recur every block, so the
         # device keeps them resident and each commit ships 96 B/sig
-        return verify_ed25519_batch([p.bytes() for p in pubs], msgs, sigs,
-                                    cache_pubs=True)
+        return rt.run(
+            "bulk.ed25519",
+            partial(verify_ed25519_batch, [p.bytes() for p in pubs],
+                    msgs, sigs, cache_pubs=True),
+            host_fn=partial(_host_bulk_ed25519, pubs, msgs, sigs),
+            spot_check=_spot_check_bulk(pubs, msgs, sigs))
     bv = BatchVerifier(tpu_threshold=tpu_threshold)
     for i in range(n):
         bv.add(pubs[i], msgs[i], sigs[i])
     _, bits = bv.verify()
     return bits
+
+
+def _as_ed_pub(p) -> PubKey:
+    return p if isinstance(p, PubKey) else ed.PubKey(bytes(p))
+
+
+def _host_bulk_ed25519(pubs, msgs, sigs) -> np.ndarray:
+    """Host re-verification of a whole-commit batch — the degradation
+    target when the device lane times out, raises, or the breaker is
+    open.  Same per-triple semantics as the device path: malformed
+    lengths are simply invalid, never exceptions."""
+    n = len(pubs)
+    bits = np.zeros(n, dtype=bool)
+    for i in range(n):
+        try:
+            bits[i] = _as_ed_pub(pubs[i]).verify_signature(
+                bytes(msgs[i]), bytes(sigs[i]))
+        except Exception:  # noqa: BLE001 - malformed input = invalid
+            bits[i] = False
+    return bits
+
+
+def _spot_check_bulk(pubs, msgs, sigs):
+    return _spot_check(
+        len(pubs),
+        lambda j: (_as_ed_pub(pubs[j]), bytes(msgs[j]), bytes(sigs[j])))
 
 
 def verify_ed25519_batch(pubkeys: Sequence[bytes], msgs: Sequence[bytes],

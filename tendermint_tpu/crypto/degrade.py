@@ -1,0 +1,390 @@
+"""Device-lane degradation runtime: the resilience layer between the
+batch verifier's routing policy (crypto/batch.py) and the accelerator.
+
+The TPU lane is the consensus hot path's fast plane, but the device is
+the least reliable component in the node: the backend may fail to
+initialize (tunnel down), a launch may wedge (tunnel weather, runtime
+fault) or raise, and a flaky device must never stall or kill consensus.
+This module implements the degradation ladder
+
+    device -> [launch timeout / raise -> host re-verify, failure counted]
+           -> breaker OPEN (everything host-side)
+           -> half-open probe with exponential backoff + jitter
+           -> re-close on a successful launch
+
+with three guarantees the callers rely on:
+
+  1. exact bitmap semantics: every fallback re-verifies the SAME triples
+     on the host OpenSSL path, so callers observe the identical
+     per-triple bitmap whether the device worked, timed out, raised, or
+     the breaker was open.
+  2. bounded wall clock: a launch that misses its deadline is abandoned
+     (its worker is quarantined; a fresh lane thread takes over) and the
+     batch is re-verified host-side immediately.
+  3. no cached doom: the old `_backend_ok` one-shot probe cached a
+     transient init failure forever; backend probing here re-evaluates
+     with exponential backoff, so a tunnel that comes back is found.
+
+Observability: breaker transitions fire listener callbacks (node.py and
+the consensus receive-loop coalescer log them) and every launch/failure/
+fallback/probe increments libs/metrics counters.  Chaos tests force each
+failure class deterministically through libs/fail.py injection sites
+(see docs/adr/adr-010-device-lane-degradation.md).
+"""
+from __future__ import annotations
+
+import concurrent.futures as _cf
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from tendermint_tpu.libs import fail
+
+# breaker states (rendered into the tendermint_crypto_breaker_state
+# gauge as 0 / 0.5 / 1)
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}
+
+
+class DeviceLaneError(RuntimeError):
+    """A device launch failed (raise, timeout, or integrity mismatch)."""
+
+
+@dataclass
+class DegradeConfig:
+    """Knobs for the resilience runtime.  Env-overridable so operators
+    can tune a deployed node without code changes."""
+    failure_threshold: int = 3     # consecutive failures that open
+    launch_timeout_s: float = 60.0  # per-launch wall clock (first launch
+    #                                 includes jit compile; keep generous)
+    backoff_base_s: float = 1.0    # first re-probe delay after opening
+    backoff_max_s: float = 120.0
+    backoff_jitter: float = 0.2    # +/- fraction applied to each delay
+    spot_check: bool = True        # host-re-verify one lane per launch
+
+    @classmethod
+    def from_env(cls) -> "DegradeConfig":
+        c = cls()
+        env = os.environ.get
+        c.failure_threshold = int(env("TM_TPU_BREAKER_THRESHOLD",
+                                      c.failure_threshold))
+        c.launch_timeout_s = float(env("TM_TPU_DEVICE_TIMEOUT_S",
+                                       c.launch_timeout_s))
+        c.backoff_base_s = float(env("TM_TPU_BREAKER_BACKOFF_S",
+                                     c.backoff_base_s))
+        c.backoff_max_s = float(env("TM_TPU_BREAKER_BACKOFF_MAX_S",
+                                    c.backoff_max_s))
+        c.spot_check = env("TM_TPU_SPOT_CHECK", "1") != "0"
+        return c
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probing.
+
+    CLOSED: launches flow.  After `failure_threshold` consecutive
+    failures the breaker OPENs: try_acquire() denies everything until
+    the backoff deadline, then grants exactly ONE caller a HALF_OPEN
+    trial.  A successful trial re-closes (and resets the backoff); a
+    failed trial re-opens with the delay doubled (capped, jittered).
+
+    Thread-safe.  `clock` is injectable so tests drive the backoff
+    schedule deterministically."""
+
+    def __init__(self, cfg: Optional[DegradeConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
+        self.cfg = cfg or DegradeConfig.from_env()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._backoff = self.cfg.backoff_base_s
+        self._probe_at = 0.0
+        self._listeners: List[Callable[[str, str, str], None]] = []
+        self._metrics = metrics
+        self.opened_total = 0
+
+    # -- observation -------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def add_listener(self, fn: Callable[[str, str, str], None]):
+        """fn(old_state, new_state, reason) on every transition; returns
+        an unsubscribe callable (listeners are process-global, so every
+        subscriber — node, consensus loop, tests — must detach on
+        stop)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+        def _unsub():
+            with self._lock:
+                if fn in self._listeners:
+                    self._listeners.remove(fn)
+        return _unsub
+
+    def _transition(self, new: str, reason: str):
+        # lock held by caller; fire listeners outside the lock
+        old, self._state = self._state, new
+        if new == OPEN:
+            self.opened_total += 1
+        if self._metrics is not None:
+            self._metrics.breaker_state.set(_STATE_GAUGE[new])
+            self._metrics.breaker_transitions.inc(to=new)
+        listeners = list(self._listeners)
+        return lambda: [fn(old, new, reason) for fn in listeners]
+
+    # -- the gate ----------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """May this launch go to the device?  Every grant MUST be settled
+        by exactly one record_success/record_failure."""
+        notify = None
+        try:
+            with self._lock:
+                if self._state == CLOSED:
+                    return True
+                if self._state == OPEN and \
+                        self._clock() >= self._probe_at:
+                    notify = self._transition(HALF_OPEN, "probe due")
+                    return True
+                return False  # OPEN before deadline, or trial in flight
+        finally:
+            if notify is not None:
+                notify()
+
+    def record_success(self):
+        notify = None
+        with self._lock:
+            self._consecutive = 0
+            if self._state != CLOSED:
+                self._backoff = self.cfg.backoff_base_s
+                notify = self._transition(CLOSED, "device launch ok")
+        if notify is not None:
+            notify()
+
+    def record_failure(self, reason: str):
+        notify = None
+        with self._lock:
+            self._consecutive += 1
+            reopen = self._state == HALF_OPEN
+            if reopen or (self._state == CLOSED and
+                          self._consecutive >= self.cfg.failure_threshold):
+                if reopen:  # failed probe: back off harder
+                    self._backoff = min(self._backoff * 2,
+                                        self.cfg.backoff_max_s)
+                delay = self._backoff
+                if self.cfg.backoff_jitter:
+                    delay *= 1 + self.cfg.backoff_jitter * \
+                        random.uniform(-1.0, 1.0)
+                self._probe_at = self._clock() + delay
+                notify = self._transition(OPEN, reason)
+        if notify is not None:
+            notify()
+
+
+class DeviceLaneRuntime:
+    """Owns the device-lane worker pool, the circuit breaker, and the
+    backend probe.  crypto/batch.py routes every device dispatch through
+    submit()/collect() (overlapped lanes) or run() (synchronous)."""
+
+    def __init__(self, cfg: Optional[DegradeConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        from tendermint_tpu.libs.metrics import CryptoMetrics
+
+        self.cfg = cfg or DegradeConfig.from_env()
+        self.metrics = CryptoMetrics(registry)
+        self.breaker = CircuitBreaker(self.cfg, clock=clock,
+                                      metrics=self.metrics)
+        self._clock = clock
+        self._pool_lock = threading.Lock()
+        self._pool: Optional[_cf.ThreadPoolExecutor] = None
+        # backend probe state: None = never probed, True = accelerator,
+        # False-stable = plain-CPU backend (a fixed property of the
+        # process), False-transient = init raised, re-probe after backoff
+        self._backend_lock = threading.Lock()
+        self._backend: Optional[bool] = None
+        self._backend_stable = False
+        self._backend_next_probe = 0.0
+        self._backend_backoff = self.cfg.backoff_base_s
+
+    # -- worker pool -------------------------------------------------------
+
+    def _get_pool(self) -> _cf.ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = _cf.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="batch-device-lane")
+            return self._pool
+
+    def _quarantine_pool(self):
+        """A launch missed its deadline: the worker may be wedged on the
+        device, so later launches must not queue behind it.  Abandon the
+        executor (its thread finishes or wedges on its own) and lazily
+        build a fresh one."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    # -- backend probing (replaces batch.py's one-shot _backend_ok) --------
+
+    def backend_available(self) -> bool:
+        """True once jax reports a non-CPU default backend.  An init
+        FAILURE is treated as transient: re-probed after an exponential
+        backoff instead of being cached forever."""
+        with self._backend_lock:
+            if self._backend is not None and \
+                    (self._backend or self._backend_stable):
+                return self._backend
+            if self._backend is not None and \
+                    self._clock() < self._backend_next_probe:
+                return False
+        try:
+            import jax
+            ok = jax.default_backend() != "cpu"
+            with self._backend_lock:
+                self._backend = ok
+                self._backend_stable = True   # a live backend is fixed
+                self._backend_backoff = self.cfg.backoff_base_s
+            self.metrics.backend_probes.inc(
+                result="accelerator" if ok else "cpu")
+            return ok
+        except Exception:
+            with self._backend_lock:
+                self._backend = False
+                self._backend_stable = False
+                self._backend_next_probe = \
+                    self._clock() + self._backend_backoff
+                self._backend_backoff = min(
+                    self._backend_backoff * 2, self.cfg.backoff_max_s)
+            self.metrics.backend_probes.inc(result="error")
+            return False
+
+    # -- launch plumbing ---------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        return self.breaker.try_acquire()
+
+    def submit(self, site: str, fn: Callable, *args) -> _cf.Future:
+        """Dispatch a device launch on the lane worker.  The fail-point
+        injection runs INSIDE the worker so `latency:` modes are subject
+        to the launch deadline exactly like real device stalls.  Caller
+        must settle via collect() — submit itself never raises (a
+        dispatch failure comes back as a failed future), so an acquired
+        breaker grant can always be settled."""
+        self.metrics.device_launches.inc(site=site)
+
+        def _launch():
+            fail.inject(site)
+            return fn(*args)
+        try:
+            return self._get_pool().submit(_launch)
+        except Exception as e:  # noqa: BLE001 - e.g. pool at shutdown
+            f: _cf.Future = _cf.Future()
+            f.set_exception(e)
+            return f
+
+    def collect(self, site: str, fut: _cf.Future,
+                host_fn: Callable[[], np.ndarray],
+                spot_check: Optional[Callable[[np.ndarray], bool]] = None,
+                ) -> np.ndarray:
+        """Settle a launch: bounded wait, integrity check, breaker
+        bookkeeping — and on ANY device failure re-verify the batch
+        through host_fn so the caller's bitmap is exact regardless."""
+        t0 = self._clock()
+        reason = None
+        try:
+            out = fut.result(timeout=self.cfg.launch_timeout_s)
+            out = fail.corrupt_bitmap(site, out)
+            if spot_check is not None and self.cfg.spot_check \
+                    and not spot_check(np.asarray(out)):
+                raise DeviceLaneError(
+                    f"{site}: device bitmap disagrees with host spot check")
+        except (_cf.TimeoutError, TimeoutError):
+            # on 3.11+ futures.TimeoutError IS builtin TimeoutError, so a
+            # TimeoutError raised by the device fn itself (e.g. a socket
+            # timeout on the tunnel) lands here too: only a future that
+            # is genuinely still running means the WAIT timed out and the
+            # worker may be wedged — anything else is a device raise
+            if fut.done():
+                reason = "raise"
+            else:
+                reason = "timeout"
+                self._quarantine_pool()
+                fut.cancel()
+        except Exception as e:  # noqa: BLE001 - any fault degrades
+            reason = "integrity" if isinstance(e, DeviceLaneError) \
+                else "raise"
+        if reason is None:
+            self.metrics.device_launch_seconds.observe(
+                self._clock() - t0, site=site)
+            self.breaker.record_success()
+            return np.asarray(out)
+        self.metrics.device_failures.inc(site=site, reason=reason)
+        self.breaker.record_failure(f"{site}: {reason}")
+        return self.host_fallback(site, reason, host_fn)
+
+    def host_fallback(self, site: str, reason: str,
+                      host_fn: Callable[[], np.ndarray]) -> np.ndarray:
+        self.metrics.host_fallbacks.inc(site=site, reason=reason)
+        return host_fn()
+
+    def run(self, site: str, device_fn: Callable[[], np.ndarray],
+            host_fn: Callable[[], np.ndarray],
+            spot_check: Optional[Callable[[np.ndarray], bool]] = None,
+            ) -> np.ndarray:
+        """Synchronous wrapper: breaker gate + launch + settle.  The
+        whole-commit path (crypto/batch.verify_sigs_bulk) uses this; the
+        mixed-batch path uses submit()/collect() to overlap the device
+        lane with its host lanes."""
+        if not self.try_acquire():
+            return self.host_fallback(site, "breaker_open", host_fn)
+        return self.collect(site, self.submit(site, device_fn), host_fn,
+                            spot_check=spot_check)
+
+
+# ---------------------------------------------------------------------------
+# process-global runtime (one device per process, like the lane pool it
+# replaces); tests swap it out via configure()/reset()
+# ---------------------------------------------------------------------------
+
+_runtime: Optional[DeviceLaneRuntime] = None
+_runtime_lock = threading.Lock()
+
+
+def runtime() -> DeviceLaneRuntime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = DeviceLaneRuntime()
+        return _runtime
+
+
+def configure(cfg: Optional[DegradeConfig] = None,
+              clock: Callable[[], float] = time.monotonic,
+              registry=None) -> DeviceLaneRuntime:
+    """Install a fresh runtime (tests: deterministic clock / private
+    metrics registry; node assembly: config-derived thresholds)."""
+    global _runtime
+    with _runtime_lock:
+        _runtime = DeviceLaneRuntime(cfg, clock=clock, registry=registry)
+        return _runtime
+
+
+def reset():
+    """Drop the global runtime (next access rebuilds from env)."""
+    global _runtime
+    with _runtime_lock:
+        _runtime = None

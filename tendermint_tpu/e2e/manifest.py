@@ -5,7 +5,10 @@ state sync, perturbations, and the load profile — and the runner
 """
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is the same parser/API
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Dict, List
 

@@ -68,8 +68,12 @@ def parse_rfc3339(s: str) -> Timestamp:
     dt = datetime.datetime(y, mo, d, h, mi, sec,
                            tzinfo=datetime.timezone.utc)
     if m.group(8):
-        off = datetime.timedelta(hours=int(m.group(9)),
-                                 minutes=int(m.group(10)))
+        oh, om = int(m.group(9)), int(m.group(10))
+        # a UTC offset like "+99:99" is not a timezone; silently applying
+        # it would shift genesis_time by days (RFC3339: hh <= 23, mm <= 59)
+        if oh > 23 or om > 59:
+            raise ValueError(f"bad RFC3339 timezone offset in {s!r}")
+        off = datetime.timedelta(hours=oh, minutes=om)
         dt = dt - off if m.group(8) == "+" else dt + off
     nanos = int((m.group(7) or "").ljust(9, "0") or 0)
     return Timestamp(int(dt.timestamp()), nanos)

@@ -1,17 +1,47 @@
-"""Indexed fail-point injection (reference libs/fail/fail.go:28-39).
+"""Fault injection: indexed crash points + named chaos modes.
 
-Call sites are numbered in execution order by a process-global counter;
-when the counter reaches $FAIL_TEST_INDEX the process dies immediately.
-Used by crash/recovery tests to die between WAL-fsync, block-save and
-app-commit (reference consensus/state.go:1653-1733, state/execution.go).
+Two mechanisms share this module:
+
+1. Indexed fail points (reference libs/fail/fail.go:28-39): call sites
+   are numbered in execution order by a process-global counter; when the
+   counter reaches $FAIL_TEST_INDEX the process dies immediately.  Used
+   by crash/recovery tests to die between WAL-fsync, block-save and
+   app-commit (reference consensus/state.go:1653-1733,
+   state/execution.go).
+
+2. Named, mode-keyed injection for the device-lane chaos matrix
+   (crypto/degrade.py, tests/test_chaos_matrix.py).  A site like
+   "ops.ed25519.verify_batch" calls inject(site) on entry; an armed mode
+   forces one failure class deterministically:
+
+       raise          raise InjectedFault at the site
+       latency:<ms>   sleep <ms> before proceeding (drives the launch
+                      deadline in the degradation runtime)
+       corrupt-bitmap invert the device result bitmap (exercises the
+                      runtime's host spot-check integrity guard)
+       exit           os._exit(77), the crash-matrix convention
+
+   Armed programmatically (set_mode / clear) for in-process tests, or
+   via $TM_TPU_FAILPOINTS="site=mode;site2=mode" for subprocess tests;
+   site "*" matches every site.  fired() exposes hit counts so tests
+   can assert the injection actually triggered.
 """
 from __future__ import annotations
 
 import os
 import threading
+import time
+from typing import Dict, Optional, Tuple
 
 _counter = 0
 _lock = threading.Lock()
+
+_modes: Dict[str, str] = {}
+_fired: Dict[Tuple[str, str], int] = {}
+
+
+class InjectedFault(RuntimeError):
+    """A chaos-injected device fault (mode "raise")."""
 
 
 def _target() -> int:
@@ -36,3 +66,85 @@ def reset():
     global _counter
     with _lock:
         _counter = 0
+        _modes.clear()
+        _fired.clear()
+
+
+# ---------------------------------------------------------------------------
+# named chaos modes
+# ---------------------------------------------------------------------------
+
+def set_mode(site: str, mode: Optional[str]):
+    """Arm (or with mode=None disarm) an injection mode at a named site.
+    The mode stays armed until cleared — chaos tests drive the breaker
+    through open/backoff/re-close by arming, verifying repeatedly, then
+    disarming."""
+    with _lock:
+        if mode is None:
+            _modes.pop(site, None)
+        else:
+            _modes[site] = mode
+
+
+def clear(site: Optional[str] = None):
+    with _lock:
+        if site is None:
+            _modes.clear()
+        else:
+            _modes.pop(site, None)
+
+
+def fired(site: str, mode: str) -> int:
+    with _lock:
+        return _fired.get((site, mode), 0)
+
+
+def _mode_for(site: str) -> Optional[str]:
+    with _lock:
+        m = _modes.get(site) or _modes.get("*")
+    if m is not None:
+        return m
+    env = os.environ.get("TM_TPU_FAILPOINTS", "")
+    if not env:
+        return None
+    for entry in env.split(";"):
+        k, _, v = entry.partition("=")
+        if v and k.strip() in (site, "*"):
+            return v.strip()
+    return None
+
+
+def _count(site: str, mode: str):
+    with _lock:
+        _fired[(site, mode)] = _fired.get((site, mode), 0) + 1
+
+
+def inject(site: str):
+    """Entry hook of a named fail-point site: raise / stall / die per the
+    armed mode.  "corrupt-bitmap" is a result-transform mode and is a
+    no-op here (see corrupt_bitmap)."""
+    mode = _mode_for(site)
+    if mode is None or mode == "corrupt-bitmap":
+        return
+    if mode == "raise":
+        _count(site, mode)
+        raise InjectedFault(f"injected fault at {site}")
+    if mode.startswith("latency:"):
+        _count(site, mode)
+        time.sleep(float(mode.split(":", 1)[1]) / 1000.0)
+        return
+    if mode == "exit":
+        _count(site, mode)
+        os._exit(77)
+    raise ValueError(f"unknown fail mode {mode!r} at {site}")
+
+
+def corrupt_bitmap(site: str, bits):
+    """Result hook of a device-lane site: under "corrupt-bitmap" return
+    the inverted bitmap (a device replying with garbage), which the
+    degradation runtime's host spot check must catch."""
+    if _mode_for(site) == "corrupt-bitmap":
+        import numpy as np
+        _count(site, "corrupt-bitmap")
+        return ~np.asarray(bits, dtype=bool)
+    return bits

@@ -128,12 +128,14 @@ class Histogram(_Metric):
                 cum = 0
                 for i, ub in enumerate(self.buckets):
                     cum += self._counts[key][i]
+                    le = 'le="{:g}"'.format(ub)
                     out.append(f"{self.name}_bucket"
-                               f"{self._fmt_labels(key, f'le=\"{ub:g}\"')}"
+                               f"{self._fmt_labels(key, le)}"
                                f" {cum}")
                 cum += self._counts[key][-1]
+                inf = 'le="+Inf"'
                 out.append(f"{self.name}_bucket"
-                           f"{self._fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                           f"{self._fmt_labels(key, inf)} {cum}")
                 out.append(f"{self.name}_sum{self._fmt_labels(key)}"
                            f" {self._sum[key]:g}")
                 out.append(f"{self.name}_count{self._fmt_labels(key)}"
@@ -236,6 +238,42 @@ class StateMetrics:
             "state", "batch_verify_size",
             "Signatures per batched verify call (TPU data plane).",
             buckets=[1, 4, 16, 64, 256, 1024, 4096, 16384, 65536])
+
+
+class CryptoMetrics:
+    """Device-lane degradation runtime (crypto/degrade.py): launches,
+    failure classes, host fallbacks, breaker lifecycle and backend
+    probing — the operator's view of whether the accelerator is serving
+    the verify hot path or the node has degraded to host verification
+    (docs/adr/adr-010-device-lane-degradation.md)."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.device_launches = reg.counter(
+            "crypto", "device_launch_total",
+            "Device verify launches dispatched.", labels=("site",))
+        self.device_failures = reg.counter(
+            "crypto", "device_failure_total",
+            "Device launches that failed, by failure class.",
+            labels=("site", "reason"))
+        self.host_fallbacks = reg.counter(
+            "crypto", "host_fallback_total",
+            "Batches re-verified on the host OpenSSL path.",
+            labels=("site", "reason"))
+        self.breaker_state = reg.gauge(
+            "crypto", "breaker_state",
+            "Device-lane circuit breaker: 0 closed, 0.5 half-open, "
+            "1 open.")
+        self.breaker_transitions = reg.counter(
+            "crypto", "breaker_transitions_total",
+            "Breaker state transitions.", labels=("to",))
+        self.backend_probes = reg.counter(
+            "crypto", "backend_probe_total",
+            "Accelerator backend probes, by outcome.", labels=("result",))
+        self.device_launch_seconds = reg.histogram(
+            "crypto", "device_launch_seconds",
+            "Wall-clock of successful device verify launches.",
+            labels=("site",), buckets=exp_buckets(0.001, 4, 10))
 
 
 class P2PMetrics:

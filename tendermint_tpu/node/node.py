@@ -405,6 +405,20 @@ class Node(BaseService):
                       node_id=self.node_key.node_id,
                       chain_id=self.genesis.chain_id,
                       height=self.state.last_block_height)
+        # device-lane degradation runtime (crypto/degrade.py): surface
+        # breaker transitions in the node log so an operator sees the
+        # moment the verify hot path degrades to (or recovers from) host
+        # verification; the consensus receive loop registers its own
+        # listener for the coalescer's view
+        from tendermint_tpu.crypto import degrade
+        self._breaker_unsub = degrade.runtime().breaker.add_listener(
+            self._on_breaker_transition)
+        # the node's config decides the cofactored RLC fast path in BOTH
+        # directions: a stale TM_TPU_RLC=1 env must not override an
+        # operator's rlc=false (the env remains the knob only for
+        # node-less tooling: benches, tests)
+        from tendermint_tpu.ops import msm
+        msm.set_enabled(self.config.batch_verifier.rlc)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,
@@ -429,6 +443,10 @@ class Node(BaseService):
             self.pprof_server.start()
         if self.grpc_server is not None:
             self.grpc_server.start()
+
+    def _on_breaker_transition(self, old: str, new: str, reason: str):
+        self.log.info("device verify lane breaker transition",
+                      **{"from": old}, to=new, reason=reason)
 
     def _statesync_routine(self):
         """Run the syncer, persist the restored state, then hand off to
@@ -484,6 +502,9 @@ class Node(BaseService):
         the switch (which stops every reactor), then the app conns."""
         self.log.info("stopping node",
                       height=self.block_store.height())
+        if getattr(self, "_breaker_unsub", None) is not None:
+            self._breaker_unsub()
+            self._breaker_unsub = None
         self.indexer_service.stop()
         if self.grpc_server is not None:
             self.grpc_server.stop()

@@ -613,7 +613,13 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     (validator-set paths — crypto/batch.verify_sigs_bulk): the (32, B)
     pubkey rows are kept device-resident keyed by content hash, so
     steady-state VerifyCommit ships 96 B/sig instead of 128."""
+    from tendermint_tpu.libs import fail
     from tendermint_tpu.parallel.sharding import data_plane
+
+    # chaos seam: the degradation runtime (crypto/degrade.py) wraps every
+    # dispatch into this function, so an injected raise/latency here is
+    # indistinguishable from a real device fault to the callers
+    fail.inject("ops.ed25519.verify_batch")
 
     from . import msm
     if msm.use_rlc(len(pubkeys)):

@@ -378,11 +378,31 @@ def _rlc_min() -> int:
     return int(os.environ.get("TM_TPU_RLC_MIN", "1024"))
 
 
+# explicit opt-in (config [batch_verifier] rlc, or TM_TPU_RLC=1), wired
+# by node assembly via set_enabled().  Default OFF for wire-compat: the
+# RLC check is *cofactored* (ZIP-215 semantics) while the reference Go
+# verifier is cofactorless, so a mixed Go/TPU fleet could in principle be
+# chain-split by an adversarially small-order-component signature that
+# one side accepts and the other rejects.  Operators running homogeneous
+# TPU fleets opt in deliberately (docs/adr/009-rlc-batch-verification.md).
+_enabled_override: "bool | None" = None
+
+
+def set_enabled(on: bool):
+    """Config-driven override of the RLC opt-in (wins over the env)."""
+    global _enabled_override
+    _enabled_override = bool(on)
+
+
 def use_rlc(n: int) -> bool:
     """Whether the RLC fast path should be attempted for an n-sig batch
     (below RLC_MIN the per-sig kernel is already launch-bound and the
     extra compile cache entries are not worth it)."""
-    return os.environ.get("TM_TPU_RLC", "1") != "0" and n >= _rlc_min()
+    if _enabled_override is not None:
+        enabled = _enabled_override
+    else:
+        enabled = os.environ.get("TM_TPU_RLC", "0") == "1"
+    return enabled and n >= _rlc_min()
 
 
 def _b_enc_bytes() -> np.ndarray:
@@ -430,4 +450,14 @@ def verify_batch_rlc(pubkeys, msgs, sigs) -> bool:
         jnp.asarray(z), jnp.asarray(zs), c, use_pallas=ed._use_pallas())
     if not bool(ok_all) or bool(overflow):
         return False
-    return _combine_windows_host(np.asarray(ws), c)
+    vouched = _combine_windows_host(np.asarray(ws), c)
+    if vouched:
+        # audit line for mixed Go/TPU fleets: the cofactored check stood
+        # in for n exact cofactorless verifies — if a chain split is ever
+        # suspected, these lines say which batches the fast path vouched
+        # for (docs/adr/009; the two checks only differ on adversarial
+        # small-order-component signatures)
+        from tendermint_tpu.libs import log as tmlog
+        tmlog.logger("crypto").info(
+            "rlc cofactored batch check vouched", sigs=n)
+    return vouched

@@ -91,6 +91,11 @@ def verify_batch_device(pubs, msgs, sigs) -> np.ndarray:
     Returns a (n,) bool bitmap with per-sig exact semantics.  Malformed
     lengths are rejected host-side without poisoning the batch (same
     guard as crypto/batch.verify_ed25519_batch)."""
+    from tendermint_tpu.libs import fail
+
+    # chaos seam: same role as ops/ed25519.verify_batch's — the degrade
+    # runtime treats an injected fault here as a device-lane failure
+    fail.inject("ops.sr25519.verify_batch")
     n = len(pubs)
     if n == 0:
         return np.zeros(0, dtype=bool)

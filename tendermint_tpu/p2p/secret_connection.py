@@ -18,11 +18,19 @@ import socket
 import struct
 import threading
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+# gated: this module sits on the import path of every reactor (via
+# p2p/connection.py), so a missing `cryptography` package must degrade
+# to a clear error at CONNECTION time, not take down node assembly /
+# in-process harnesses that never open a wire connection
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+    _HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover - environment-dependent
+    _HAVE_CRYPTO = False
 
 from tendermint_tpu.crypto import ed25519 as edkeys
 
@@ -45,6 +53,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 class SecretConnection:
     def __init__(self, sock: socket.socket, priv_key: edkeys.PrivKey):
+        if not _HAVE_CRYPTO:
+            raise SecretConnectionError(
+                "cryptography package unavailable: secret connection "
+                "needs X25519/HKDF/ChaCha20-Poly1305")
         self.sock = sock
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()

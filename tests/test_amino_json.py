@@ -49,6 +49,16 @@ def test_rfc3339_time_reference_shapes():
         Timestamp(1700000100, 250000000)
     assert aj.parse_rfc3339("2023-11-14T22:15:00+00:00") == \
         Timestamp(1700000100, 0)
+    # but a nonsense offset is rejected, not silently applied as a
+    # multi-day shift (hours <= 23, minutes <= 59)
+    import pytest
+    for bad in ("2023-11-14T22:15:00+99:99", "2023-11-14T22:15:00-24:00",
+                "2023-11-14T22:15:00+00:60"):
+        with pytest.raises(ValueError):
+            aj.parse_rfc3339(bad)
+    # boundary offsets stay valid
+    assert aj.parse_rfc3339("2023-11-15T22:14:00+23:59") == \
+        Timestamp(1700000100, 0)
 
 
 def test_vote_json_reference_shape():

@@ -1,0 +1,208 @@
+"""Chaos matrix over the device verify lane (crypto/degrade.py).
+
+Counterpart of tests/test_crash_matrix.py for the NON-fatal failure
+classes: instead of killing the process at indexed fail points, each
+case arms a libs/fail.py mode at the device-lane seams and asserts the
+degradation runtime's contract — BatchVerifier.verify() returns the
+EXACT bitmap of the pure-host path (no hang, no crash, no exception)
+under every injected failure class, and the circuit breaker demonstrably
+opens, backs off, and re-closes (ISSUE 1 acceptance criteria).
+
+The device lane here is the XLA-composed kernel forced onto CPU
+(TM_TPU_FORCE_BATCH=1, same trick as the sr25519 lane tests): the
+degradation runtime sits strictly above the kernel, so the failure
+plumbing exercised is exactly what runs against real hardware.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import batch as cb
+from tendermint_tpu.crypto import degrade
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.metrics import Registry
+
+rng = random.Random(77)
+
+
+@pytest.fixture(autouse=True)
+def _force_device(monkeypatch):
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.delenv("TM_TPU_DISABLE_BATCH", raising=False)
+    fail.reset()
+    yield
+    fail.reset()
+    degrade.reset()
+
+
+def _runtime(clk=None, **kw):
+    cfg = degrade.DegradeConfig(
+        failure_threshold=kw.pop("failure_threshold", 3),
+        launch_timeout_s=kw.pop("launch_timeout_s", 120.0),
+        backoff_base_s=10.0, backoff_max_s=100.0, backoff_jitter=0.0)
+    return degrade.configure(cfg, clock=clk or (lambda: 0.0),
+                             registry=Registry("chaos"))
+
+
+def _mixed_batch(n=24, bad=(3, 11, 17)):
+    """n ed25519 triples, `bad` lanes invalid (flipped sig byte, one
+    truncated) — the bitmap must attribute failures exactly."""
+    privs = [edkeys.PrivKey(bytes([i + 1]) * 32) for i in range(n)]
+    msgs = [b"chaos vote %d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    for i in bad:
+        sigs[i] = (sigs[i][:50] if i == bad[-1]
+                   else bytes([sigs[i][0] ^ 1]) + sigs[i][1:])
+    return privs, msgs, sigs
+
+
+def _verify(privs, msgs, sigs, threshold=4):
+    bv = cb.BatchVerifier(tpu_threshold=threshold)
+    for p, m, s in zip(privs, msgs, sigs):
+        bv.add(p.pub_key(), m, s)
+    return bv.verify()
+
+
+def _host_baseline(privs, msgs, sigs, monkeypatch):
+    monkeypatch.setenv("TM_TPU_DISABLE_BATCH", "1")
+    try:
+        _, bits = _verify(privs, msgs, sigs)
+    finally:
+        monkeypatch.delenv("TM_TPU_DISABLE_BATCH")
+    return bits
+
+
+# (site, mode, failure-class counter the case must increment)
+CASES = [
+    (None, None, None),                                   # control
+    ("ops.ed25519.verify_batch", "raise", "raise"),       # device raises
+    ("ops.ed25519.verify_batch", "latency:25", None),     # slow, in budget
+    ("batch.ed25519", "corrupt-bitmap", "integrity"),     # garbage bitmap
+]
+
+
+@pytest.mark.parametrize("site,mode,reason", CASES,
+                         ids=["control", "raise", "latency", "corrupt"])
+def test_bitmap_identical_to_host_under_injection(monkeypatch, site,
+                                                  mode, reason):
+    rt = _runtime()
+    privs, msgs, sigs = _mixed_batch()
+    base = _host_baseline(privs, msgs, sigs, monkeypatch)
+    assert not base.all() and base.sum() == len(privs) - 3
+    if site:
+        fail.set_mode(site, mode)
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all(), (mode, bits, base)
+    assert ok == bool(base.all())
+    if mode:
+        assert fail.fired(site, mode) >= 1, "injection never triggered"
+    if reason:
+        assert rt.metrics.device_failures.value(
+            site="batch.ed25519", reason=reason) == 1
+        assert rt.metrics.host_fallbacks.value(
+            site="batch.ed25519", reason=reason) == 1
+
+
+def test_latency_past_deadline_times_out_bitmap_exact(monkeypatch):
+    """The timeout class: a launch stalled past its wall-clock budget is
+    abandoned and the batch re-verifies host-side — same bitmap, no
+    hang.  Warm the kernel first so the tight deadline measures the
+    injected stall, not jit compile."""
+    rt = _runtime(launch_timeout_s=120.0)
+    privs, msgs, sigs = _mixed_batch()
+    base = _host_baseline(privs, msgs, sigs, monkeypatch)
+    _verify(privs, msgs, sigs)  # warmup/compile through the device lane
+    assert rt.breaker.state == degrade.CLOSED
+    rt.cfg.launch_timeout_s = 0.05
+    fail.set_mode("ops.ed25519.verify_batch", "latency:400")
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all()
+    assert rt.metrics.device_failures.value(
+        site="batch.ed25519", reason="timeout") == 1
+    # the quarantined worker must not poison the next launch
+    rt.cfg.launch_timeout_s = 120.0
+    fail.clear()
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all()
+
+
+def test_breaker_opens_backs_off_and_recloses(monkeypatch):
+    """The acceptance-criteria lifecycle, through the production verify
+    seam: N consecutive device faults open the breaker (everything
+    host-side, no device launches), the open interval backs off, a
+    post-deadline probe re-closes it, and the bitmap is host-exact at
+    every step."""
+    clk_t = [0.0]
+    rt = _runtime(clk=lambda: clk_t[0], failure_threshold=2)
+    trans = []
+    rt.breaker.add_listener(lambda o, n, r: trans.append((o, n)))
+    privs, msgs, sigs = _mixed_batch()
+    base = _host_baseline(privs, msgs, sigs, monkeypatch)
+
+    fail.set_mode("ops.ed25519.verify_batch", "raise")
+    for _ in range(2):
+        ok, bits = _verify(privs, msgs, sigs)
+        assert (bits == base).all()
+    assert rt.breaker.state == degrade.OPEN
+    launches_when_open = rt.metrics.device_launches.value(
+        site="batch.ed25519")
+
+    # open: host-routed, zero new device launches, bitmap exact
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all()
+    assert rt.metrics.device_launches.value(site="batch.ed25519") == \
+        launches_when_open
+    assert rt.metrics.host_fallbacks.value(
+        site="batch.ed25519", reason="breaker_open") == 1
+
+    # before the backoff deadline the probe is still denied
+    clk_t[0] = 9.9
+    _verify(privs, msgs, sigs)
+    assert rt.breaker.state == degrade.OPEN
+
+    # device healthy again + deadline passed -> half-open probe -> close
+    fail.clear()
+    clk_t[0] = 10.1
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all()
+    assert rt.breaker.state == degrade.CLOSED
+    assert (degrade.OPEN, degrade.HALF_OPEN) in trans
+    assert (degrade.HALF_OPEN, degrade.CLOSED) in trans
+
+    # and the re-closed lane actually serves from the device again
+    before = rt.metrics.device_launches.value(site="batch.ed25519")
+    ok, bits = _verify(privs, msgs, sigs)
+    assert (bits == base).all()
+    assert rt.metrics.device_launches.value(site="batch.ed25519") == \
+        before + 1
+
+
+def test_chaos_sweep_bulk_seam(monkeypatch):
+    """Same sweep through verify_sigs_bulk (the whole-commit path, raw
+    pubkey matrix — no per-key objects) — every injected class must
+    yield the host-exact bitmap."""
+    _runtime()
+    privs, msgs, sigs = _mixed_batch(n=16, bad=(2, 9))
+    pubs = np.stack([np.frombuffer(p.pub_key().bytes(), np.uint8)
+                     for p in privs])
+    sig_list = [bytes(s) for s in sigs]
+    monkeypatch.setenv("TM_TPU_DISABLE_BATCH", "1")
+    base = cb.verify_sigs_bulk(pubs, msgs, sig_list, tpu_threshold=4)
+    monkeypatch.delenv("TM_TPU_DISABLE_BATCH")
+    assert base.sum() == 14
+    for site, mode in ((None, None),
+                       ("ops.ed25519.verify_batch", "raise"),
+                       ("bulk.ed25519", "corrupt-bitmap")):
+        fail.reset()
+        degrade.configure(degrade.DegradeConfig(backoff_jitter=0.0),
+                          registry=Registry("chaos2"))
+        if site:
+            fail.set_mode(site, mode)
+        bits = cb.verify_sigs_bulk(pubs, msgs, sig_list, tpu_threshold=4)
+        assert (bits == base).all(), (mode, bits, base)
+        if site:
+            assert fail.fired(site, mode) >= 1

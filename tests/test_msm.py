@@ -14,17 +14,16 @@ from tendermint_tpu.ops import msm
 
 
 def _batch(n, tag=b""):
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey)
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding, PublicFormat)
+    # the in-repo signer (OpenSSL when present, pure-Python otherwise)
+    # produces the same deterministic RFC 8032 signatures as the
+    # cryptography package, without requiring it in the test image
+    from tendermint_tpu.crypto import ed25519 as edk
 
-    privs = [Ed25519PrivateKey.from_private_bytes(
-        (9000 + i).to_bytes(32, "little")) for i in range(n)]
+    privs = [edk.PrivKey((9000 + i).to_bytes(32, "little"))
+             for i in range(n)]
     msgs = [b"msm vote %d " % i + tag for i in range(n)]
     sigs = [privs[i].sign(msgs[i]) for i in range(n)]
-    pubs = [k.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
-            for k in privs]
+    pubs = [k.pub_key().bytes() for k in privs]
     return pubs, msgs, sigs
 
 
@@ -83,6 +82,12 @@ def test_verify_batch_seam_fast_path_and_fallback(monkeypatch):
     path (observed via a call counter), an invalid batch falls back to
     the per-sig kernel with an EXACT bitmap."""
     monkeypatch.setenv("TM_TPU_RLC_MIN", "16")
+    # RLC is opt-in (cofactored semantics, wire-compat risk for mixed
+    # Go/TPU fleets) — default off since the degrade/robustness PR; a
+    # node started earlier in the process may have pinned the config
+    # override, so clear it and opt in via the env
+    monkeypatch.setattr(msm, "_enabled_override", None)
+    monkeypatch.setenv("TM_TPU_RLC", "1")
     # the virtual 8-device CPU mesh (conftest) would otherwise route the
     # batch through the sharded data plane before RLC is considered
     monkeypatch.setattr("tendermint_tpu.parallel.sharding.data_plane",
@@ -107,6 +112,25 @@ def test_verify_batch_seam_fast_path_and_fallback(monkeypatch):
     want = np.ones(50, dtype=bool)
     want[11] = False
     assert (out == want).all()
+
+
+def test_rlc_default_off_and_config_optin(monkeypatch):
+    """The cofactored fast path is explicit opt-in: off by default (wire
+    compat for mixed Go/TPU fleets), enabled via env or the
+    [batch_verifier] rlc config knob (node assembly -> set_enabled)."""
+    monkeypatch.delenv("TM_TPU_RLC", raising=False)
+    monkeypatch.setattr(msm, "_enabled_override", None)
+    assert msm.use_rlc(1 << 20) is False
+    monkeypatch.setenv("TM_TPU_RLC", "1")
+    assert msm.use_rlc(1 << 20) is True
+    # config override wins over env, both directions
+    monkeypatch.setattr(msm, "_enabled_override", None)
+    msm.set_enabled(False)
+    assert msm.use_rlc(1 << 20) is False
+    msm.set_enabled(True)
+    monkeypatch.delenv("TM_TPU_RLC")
+    assert msm.use_rlc(1 << 20) is True
+    assert msm.use_rlc(8) is False  # below RLC_MIN regardless
 
 
 def test_rlc_bucket_overflow_falls_back(monkeypatch):

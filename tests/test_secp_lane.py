@@ -1,0 +1,158 @@
+"""ops/field_secp.py limb arithmetic against a Python-bignum oracle.
+
+GF(2^256 - 2^32 - 977) on radix-2^12 int32 limb vectors is the secp256k1
+counterpart of ops/field.py; its docstring promises the int32 bounds are
+"regression-checked against a bignum oracle in tests/test_secp_lane.py
+rather than re-proved" — this is that file.  Every ring op, predicate and
+exponentiation chain is compared to Python integer arithmetic mod p over
+structured edge values (0, 1, p-1, p, 2^256-1, fold-boundary patterns)
+and seeded random field elements, both as single lanes and batched.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.ops import field_secp as FS
+
+P = FS.P
+rng = random.Random(20260803)
+
+# structured values that stress every fold path: small, the 2^256
+# boundary, the p boundary, all-ones limbs, and the fold multipliers'
+# weight positions (2^32, 2^40)
+EDGE = [0, 1, 2, 976, 977, 978,
+        (1 << 32) - 1, 1 << 32, (1 << 32) + 977,
+        (1 << 40) - 1, 1 << 40,
+        P - 1, P, P + 1, P + 977,
+        (1 << 255), (1 << 256) - 1,
+        int("aa" * 32, 16), int("55" * 32, 16)]
+
+
+def _rand(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def _col(xs):
+    """ints -> (NLIMB, B) device array (batch on the trailing axis)."""
+    return jnp.stack([jnp.asarray(FS.int_to_limbs(x)) for x in xs], axis=1)
+
+
+def _vals(limbs):
+    """(NLIMB, B) limbs -> list of ints (no reduction: callers mod p)."""
+    arr = np.asarray(limbs)
+    return [FS.limbs_to_int(arr[:, j]) for j in range(arr.shape[1])]
+
+
+def test_int_limb_roundtrip_and_canonical_range():
+    for x in EDGE + _rand(20):
+        limbs = FS.int_to_limbs(x)
+        assert FS.limbs_to_int(limbs) == x % P
+        assert ((limbs >= 0) & (limbs <= FS.MASK)).all(), x
+
+
+def test_mul_oracle():
+    xs = EDGE + _rand(30)
+    ys = list(reversed(xs))
+    out = _vals(FS.mul(_col(xs), _col(ys)))
+    for x, y, got in zip(xs, ys, out):
+        assert got % P == (x % P) * (y % P) % P, (x, y)
+
+
+def test_sqr_oracle():
+    xs = EDGE + _rand(30)
+    out = _vals(FS.sqr(_col(xs)))
+    for x, got in zip(xs, out):
+        assert got % P == (x % P) ** 2 % P, x
+
+
+def test_mul_small_oracle():
+    xs = EDGE + _rand(10)
+    for k in (0, 1, 2, 8, 977, 250112):
+        out = _vals(FS.mul_small(_col(xs), k))
+        for x, got in zip(xs, out):
+            assert got % P == (x % P) * k % P, (x, k)
+
+
+def test_add_sub_carry_chain_oracle():
+    """Lazy add/sub feed the next mul without an intermediate carry —
+    the operand-budget contract of the parent design.  Exercise the
+    worst chain the curve formulas produce: (a+b) * (c-d)."""
+    a, b = EDGE + _rand(10), list(reversed(EDGE + _rand(10)))
+    c, d = _rand(len(a)), _rand(len(a))
+    la, lb, lc, ld = map(_col, (a, b, c, d))
+    out = _vals(FS.mul(FS.add(la, lb), FS.sub(lc, ld)))
+    for i, got in enumerate(out):
+        want = (a[i] + b[i]) % P * ((c[i] - d[i]) % P) % P
+        assert got % P == want, i
+
+
+def test_carry_bounds_after_mul():
+    """mul's output limbs must be loose-carried (small enough for lazy
+    reuse): check against a generous int32-safety envelope."""
+    xs = EDGE + _rand(50)
+    limbs = np.asarray(FS.mul(_col(xs), _col(list(reversed(xs)))))
+    assert np.abs(limbs).max() < (1 << 16), np.abs(limbs).max()
+
+
+def test_freeze_canonical_oracle():
+    """freeze: any loose value -> the canonical representative in
+    [0, p), limb-exact against int_to_limbs."""
+    xs = EDGE + _rand(30)
+    ys = list(reversed(xs))
+    loose = FS.mul(_col(xs), _col(ys))  # loose-carried input
+    frozen = np.asarray(FS.freeze(loose))
+    for j, (x, y) in enumerate(zip(xs, ys)):
+        want = FS.int_to_limbs(x * y % P)
+        assert (frozen[:, j] == want).all(), (x, y)
+        assert ((frozen[:, j] >= 0) & (frozen[:, j] <= FS.MASK)).all()
+
+
+def test_eq_is_zero_is_odd_oracle():
+    xs = [0, 1, P - 1, 977] + _rand(8)
+    la = _col(xs)
+    # a representation shifted by +p must still compare equal
+    lb = la + jnp.asarray(FS.int_to_limbs(0) +
+                          np.array([(P >> (12 * i)) & FS.MASK
+                                    for i in range(FS.NLIMB)],
+                                   dtype=np.int32)).reshape(FS.NLIMB, 1)
+    assert np.asarray(FS.eq(la, lb)).all()
+    assert np.asarray(FS.is_zero(la)).tolist() == [x % P == 0 for x in xs]
+    assert np.asarray(FS.is_odd(la)).tolist() == [x % P % 2 == 1
+                                                  for x in xs]
+
+
+def test_invert_oracle():
+    xs = [x for x in EDGE if x % P != 0] + _rand(10)
+    inv = FS.invert(_col(xs))
+    prod = _vals(FS.mul(_col(xs), inv))
+    assert all(v % P == 1 for v in prod)
+    for x, got in zip(xs, _vals(inv)):
+        assert got % P == pow(x, P - 2, P), x
+
+
+def test_sqrt_oracle():
+    """p = 3 (mod 4): sqrt via a^((p+1)/4) on quadratic residues; the
+    caller-side contract is sqr(sqrt(a)) == a, checked here, plus the
+    value against the bignum exponentiation."""
+    roots = [2, 3, 976, P - 2] + _rand(8)
+    qrs = [r * r % P for r in roots]
+    s = FS.sqrt(_col(qrs))
+    back = _vals(FS.sqr(s))
+    for a, got in zip(qrs, back):
+        assert got % P == a, a
+    for a, got in zip(qrs, _vals(s)):
+        assert got % P == pow(a, (P + 1) // 4, P), a
+
+
+def test_sqrt_non_residue_detectable():
+    """Non-residues yield garbage by contract — but sqr(result) != a
+    must hold so the caller's check catches them."""
+    # find a non-residue (Euler's criterion)
+    nr = next(x for x in range(2, 50)
+              if pow(x, (P - 1) // 2, P) == P - 1)
+    s = FS.sqrt(_col([nr]))
+    assert _vals(FS.sqr(s))[0] % P != nr
