@@ -296,6 +296,52 @@ def config6_verify_commit_100k(n=100_000, cpu_sample=4000):
             "speedup": round(cpu_100k_s / best, 1)}
 
 
+def config7_rlc_sharded(n=8192):
+    """Mesh-sharded RLC/MSM fast path through the production
+    ops/ed25519.verify_batch seam: per-shard partial Pippenger bucket
+    sums reduced on the local mesh before the single cofactored check.
+    Reports which path actually ran (rlc-sharded / rlc-single / per-sig)
+    so a capture where the policy declined or the combination fell back
+    is visible as such."""
+    import jax
+
+    from bench import _make_batch_selfhosted
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.ops import msm
+    from tendermint_tpu.parallel.sharding import data_plane
+
+    if jax.default_backend() == "cpu":
+        # same degrade condition as BENCH_RLC=1 bench.py: an MSM timed
+        # on host XLA is not the RLC config, it's a CPU artifact
+        return {"config": f"7: sharded-RLC MSM ({n} sigs)",
+                "note": "device unavailable (cpu backend), skipped"}
+
+    pubs, msgs, sigs = _make_batch_selfhosted(n)
+    prev_rlc = msm._enabled_override
+    msm.set_enabled(True)
+    try:
+        # warm (compiles the MSM shape bucket; cached per process)
+        assert edops.verify_batch(pubs, msgs, sigs).all()
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            assert edops.verify_batch(pubs, msgs, sigs).all()
+        dt = (time.perf_counter() - t0) / reps
+        route = msm.last_route()
+    finally:
+        msm.set_enabled(prev_rlc)  # restore, don't clobber
+    plane = data_plane()
+    # path is only honest when outcome == "vouched": a dispatch that
+    # overflowed fell back to (and timed) the per-sig ladder
+    path = route.get("path") if route.get("outcome") == "vouched" \
+        else "per-sig"
+    return {"config": f"7: sharded-RLC MSM ({n} sigs)",
+            "wall_s": round(dt, 3), "sigs_per_s": round(n / dt),
+            "path": path, "outcome": route.get("outcome"),
+            "shards": route.get("shards"),
+            "mesh_devices": plane.nshard if plane is not None else 1}
+
+
 def main():
     import json
 
@@ -303,7 +349,7 @@ def main():
     print(f"# platform={jax.devices()[0].platform} "
           f"cpu_openssl={_cpu_verify_rate():.0f}/s", flush=True)
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
-           config5_mixed, config6_verify_commit_100k)
+           config5_mixed, config6_verify_commit_100k, config7_rlc_sharded)
     only = os.environ.get("BENCH_ONLY", "")
     for fn in fns:
         if only and only not in fn.__name__:

@@ -125,6 +125,11 @@ class BatchVerifierConfig:
     # reference Go verifier is cofactorless, and a mixed fleet could be
     # chain-split by an adversarial small-order-component signature.
     rlc: bool = False
+    # opt-in to the secp256k1 TPU lane (ops/secp.py).  OFF by default:
+    # verdicts are exact either way, but the host C lane is the measured
+    # production path and the device lane only pays off with a
+    # co-located chip.
+    secp_lane: bool = False
 
 
 @dataclass
@@ -259,6 +264,7 @@ trust_period = {self.state_sync.trust_period}
 tpu_threshold = {self.batch_verifier.tpu_threshold}
 enable = {str(self.batch_verifier.enable).lower()}
 rlc = {str(self.batch_verifier.rlc).lower()}
+secp_lane = {str(self.batch_verifier.secp_lane).lower()}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -331,7 +337,8 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
         cfg.batch_verifier = BatchVerifierConfig(
             tpu_threshold=bv.get("tpu_threshold", 32),
             enable=bv.get("enable", True),
-            rlc=bool(bv.get("rlc", False)))
+            rlc=bool(bv.get("rlc", False)),
+            secp_lane=bool(bv.get("secp_lane", False)))
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

@@ -159,12 +159,17 @@ class BatchVerifier:
         by_type: dict = {}
         for i, it in enumerate(self._items):
             by_type.setdefault(it.pub.type_name, []).append(i)
-        rt = degrade.runtime()
+        # tiny-batch hot path (a consensus vote window): below the
+        # threshold no per-scheme lane can reach the device either, so
+        # skip the _use_device()/degrade.runtime() dance entirely — the
+        # runtime's breaker lock is shared across reactor threads and
+        # pure contention for batches that could never dispatch
+        rt = degrade.runtime() if n >= self.tpu_threshold else None
         device_lanes = []  # [(tname, idxs, items, future)] — one worker
         host_lanes = []
         for tname, idxs in by_type.items():
             items = [self._items[i] for i in idxs]
-            verifier = _device_verifier(tname)
+            verifier = _device_verifier(tname) if rt is not None else None
             if (verifier is not None and _use_device()
                     and len(items) >= self.tpu_threshold):
                 if rt.try_acquire():
@@ -204,7 +209,10 @@ class BatchVerifier:
 def _device_verifier(tname: str):
     """The TPU lane for a key scheme, or None if that scheme stays on the
     host.  ed25519: the fused ladder / RLC MSM stack (ops/ed25519.py);
-    sr25519: same curve, ristretto lane (ops/sr25519.py)."""
+    sr25519: same curve, ristretto lane (ops/sr25519.py); secp256k1:
+    the Jacobian Straus lane (ops/secp.py), opt-in via
+    TM_TPU_SECP_LANE=1 / [batch_verifier] secp_lane — the host C lane
+    stays the default."""
     if tname == ed.KEY_TYPE:
         return verify_ed25519_batch
     if tname == "sr25519":
@@ -212,6 +220,12 @@ def _device_verifier(tname: str):
             from tendermint_tpu.ops import sr25519 as srlane
             return srlane.verify_batch_device(pubs, msgs, sigs)
         return _sr
+    if tname == "secp256k1":
+        from tendermint_tpu.ops import secp as secp_ops
+        if secp_ops.use_lane():
+            def _secp(pubs, msgs, sigs):
+                return secp_ops.verify_batch_device(pubs, msgs, sigs)
+            return _secp
     return None
 
 

@@ -419,6 +419,10 @@ class Node(BaseService):
         # node-less tooling: benches, tests)
         from tendermint_tpu.ops import msm
         msm.set_enabled(self.config.batch_verifier.rlc)
+        # same pattern for the secp256k1 device lane: the operator's
+        # config wins over any stale env in BOTH directions
+        from tendermint_tpu.ops import secp as secp_ops
+        secp_ops.set_lane_enabled(self.config.batch_verifier.secp_lane)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,

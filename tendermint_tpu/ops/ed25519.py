@@ -622,17 +622,20 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     fail.inject("ops.ed25519.verify_batch")
 
     from . import msm
-    if msm.use_rlc(len(pubkeys)):
-        # RLC+Pippenger MSM fast path (~10x less device compute than the
-        # per-sig ladder): one random-linear-combination check accepts the
-        # whole batch; on failure fall through to the sharded/per-sig
-        # paths for check-all attribution (docs/adr/009).  Tried BEFORE
-        # the mesh plane: the plane parallelizes the per-sig kernel, but
-        # RLC needs ~10x less total compute even on one device; sharding
-        # the MSM itself over the mesh is the noted follow-up.
-        if msm.verify_batch_rlc(pubkeys, msgs, sigs):
-            return np.ones(len(pubkeys), dtype=bool)
+
+    # the mesh data plane is consulted FIRST, and the RLC fast path
+    # dispatches THROUGH it: on a multi-chip host the Pippenger bucket
+    # accumulation runs as per-shard partial MSMs with an on-mesh
+    # reduction (parallel/sharding.msm_window_sums), so the
+    # highest-throughput verifier uses every local chip instead of
+    # leaving N-1 idle.  RLC-ineligible batches (non-canonical
+    # encodings, failed combination, MSM shapes the plane policy
+    # declines) fall through to the sharded per-signature ladder for
+    # check-all attribution (docs/adr/009).
     plane = data_plane()
+    if msm.use_rlc(len(pubkeys)):
+        if msm.verify_batch_rlc(pubkeys, msgs, sigs, plane=plane):
+            return np.ones(len(pubkeys), dtype=bool)
     if plane is not None and plane.worth_sharding(len(pubkeys)):
         return plane.verify_batch(pubkeys, msgs, sigs)
     if _use_pallas():

@@ -246,16 +246,26 @@ def select(cond, a, b):
 # ---------------------------------------------------------------------------
 
 def _pow_fixed(a, e: int):
-    """Unrolled MSB-first square-and-multiply by a fixed public exponent.
-    ~256 sqr + popcount(e) mul; used once per decompress (sqrt) and once
-    per batch affine-ize (invert), where the cost is amortized across all
-    lanes."""
-    bits = bin(e)[2:]
-    acc = a
-    for b in bits[1:]:
+    """MSB-first square-and-multiply by a fixed public exponent, rolled
+    into ONE lax.scan over the exponent's bit vector (the r5 seed
+    unrolled ~256 sqr + ~230 mul into straight-line HLO — that alone was
+    a ~100k-op graph per call site and XLA-on-CPU never finished
+    compiling the verify kernel; cf. ops/field.py _pow2k, which keeps
+    the parent module's chains small the same way).  The multiply is
+    computed unconditionally and selected per bit — both branches are
+    loose-carried, so the jnp.where is bound-safe — trading ~popcount
+    savings for a compile-sized graph.  Used once per decompress (sqrt)
+    and once per batch affine-ize (invert), amortized across lanes."""
+    import jax
+
+    bits = jnp.asarray([int(b) for b in bin(e)[2:][1:]], dtype=jnp.int32)
+
+    def step(acc, bit):
         acc = sqr(acc)
-        if b == "1":
-            acc = mul(acc, a)
+        acc = jnp.where(bit == 1, mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, a, bits)
     return acc
 
 

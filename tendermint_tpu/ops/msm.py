@@ -44,8 +44,15 @@ Pippenger: scatter-free, static shapes, everything batched on lanes.
                    T ~ M/K + tail margin.  No scatter, no segmented tree.
   bucket->window   weighted suffix scan over the digit axis:
                    sum_b b*S_b = sum_{j>=1} (sum_{b>=j} S_b)
-  window->result   host Horner over the ~26 window sums (Python bignum),
+  window->result   host Horner over the W_A window sums (Python bignum),
                    then the cofactor multiply and identity test.
+
+On a multi-device host the pipeline runs as per-shard partial MSMs under
+shard_map (parallel/sharding.msm_window_sums): each shard bucket-sums its
+own batch rows, the partial window sums are reduced on-mesh (group adds;
+the decode-ok/overflow verdicts via psum) and only the combined W_A sums
+return to the host — verify_batch_rlc picks the route via the plane's
+worth_sharding_msm policy.
 """
 from __future__ import annotations
 
@@ -73,7 +80,8 @@ class Plan:
     """Static shape plan for a batch of n signatures with c-bit windows.
 
     Items: every (scalar, window) pair contributes one bucket member:
-      n * W_A for the [z_i k_i](-A_i) terms (253-bit scalars),
+      n * W_A for the [z_i k_i](-A_i) terms (mod-L-lifted to 256 bits,
+              see _lift_zk),
       n * W_R for the [z_i](-R_i) terms (128-bit z),
       W_A     for the [sum z_i s_i](B) term.
     Key space: window w owns buckets [w * 2^c, (w+1) * 2^c).  R items use
@@ -83,7 +91,7 @@ class Plan:
 
     def __init__(self, n: int, c: int):
         self.n, self.c = n, c
-        self.W_A = -(-253 // c)
+        self.W_A = -(-256 // c)   # zk is lift-randomized over 256 bits
         self.W_R = -(-128 // c)
         self.K = self.W_A << c
         # bucket lanes padded to a full TPU lane tile so the Pallas scan
@@ -91,23 +99,33 @@ class Plan:
         # and are sliced off before aggregation
         self.K_pad = -(-self.K // 256) * 256
         self.M = n * (self.W_A + self.W_R) + self.W_A
-        avg = self.M / self.K
-        # layered-scan depth: mean bucket load plus a Poisson tail margin
-        # sized so P(any bucket overflows) < ~2^-30 for uniform-random
-        # digits (z is secret and uniform, so digits are not adversarially
-        # steerable).  Overflow is detected on device and falls back.
+        # layered-scan depth: sized for the WORST-CASE expected bucket
+        # load, not the all-bucket average.  R items share the low W_R
+        # windows' key space with the A items, so those buckets expect
+        # ~2n/2^c members (A-only windows ~n/2^c); every window's digits
+        # are full-width uniform by construction (c divides 128 for the
+        # z scalars, and zk is lift-randomized across all 256 bits — see
+        # _pick_c/_lift_zk), so a Poisson tail on the worst window's
+        # mean bounds every bucket with P(overflow) < ~2^-30.  The r5
+        # seed sized T on the global mean M/K, which a short partial top
+        # window (z at c=6: 2 meaningful bits -> n/4 items per bucket)
+        # exceeded DETERMINISTICALLY for n >= 128 — the fast path
+        # silently overflowed and fell back at every eligible size.
+        # Overflow is still detected on device and falls back.
         lg = math.log(self.K * (1 << 30))
-        self.T = int(avg + math.sqrt(2.0 * avg * lg) + lg + 4)
+        load = 2.0 * n / (1 << c)
+        self.T = int(load + math.sqrt(2.0 * load * lg) + lg + 4)
 
 
 def _pick_c(n: int) -> int:
-    if n >= 8192:
-        return 10
-    if n >= 1024:
-        return 8
-    if n >= 128:
-        return 6
-    return 4
+    """Window width, restricted to widths that divide 128 so every z
+    (128-bit) window is full-width uniform (a partial top window
+    concentrates n scalars onto 2^(128 mod c) buckets and deterministically
+    overflows the layered scan); the zk top windows are made uniform by
+    the mod-L lift (_lift_zk).  Crossover by the scan-step model
+    (T * K_pad / tile): c = 8's 8x bucket count beats c = 4's shallower
+    scan once n is ~8k."""
+    return 8 if n >= 8192 else 4
 
 
 # ---------------------------------------------------------------------------
@@ -218,16 +236,21 @@ def _build_table(r_bytes, pub_bytes):
     return assemble_table((ypx, ymx, t2d)), jnp.all(ok)
 
 
-@partial(jax.jit, static_argnames=("c", "use_pallas"))
-def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int,
-              use_pallas: bool = False):
+def _msm_pipeline(r_bytes, pub_bytes, zk, z, zs, c: int,
+                  use_pallas: bool = False):
     """The full device pipeline.  Inputs (all uint8, batch-major):
     r_bytes/pub_bytes/zk (n, 32), z (n, 16), zs (32,).  Returns
     (window sums stacked (4, NLIMB, W_A), decode_ok_all, overflow).
 
     use_pallas routes the two arithmetic-dense stages (point
     decompression, layered bucket fill) through the fused Mosaic kernels
-    (ops/pallas_msm.py); digits/sort/gather/aggregation stay XLA."""
+    (ops/pallas_msm.py); digits/sort/gather/aggregation stay XLA.
+
+    Pure jax ops over static shapes: parallel/sharding maps this body
+    per-shard under shard_map (each shard computes the partial MSM of
+    its batch rows), so everything here must stay shard-local — the only
+    cross-shard communication is the partial-sum reduction the plane
+    adds around it."""
     n = r_bytes.shape[0]
     plan = Plan(n, c)
     W_A, W_R, K, M, T = plan.W_A, plan.W_R, plan.K, plan.M, plan.T
@@ -291,6 +314,10 @@ def _msm_core(r_bytes, pub_bytes, zk, z, zs, c: int,
         buckets = C.Ext(*(v[:, :K] for v in buckets))
     wsums = _aggregate(buckets, W_A, c)
     return jnp.stack(list(wsums)), ok_all, overflow
+
+
+_msm_core = partial(jax.jit, static_argnames=("c", "use_pallas"))(
+    _msm_pipeline)
 
 
 # ---------------------------------------------------------------------------
@@ -388,10 +415,13 @@ def _rlc_min() -> int:
 _enabled_override: "bool | None" = None
 
 
-def set_enabled(on: bool):
-    """Config-driven override of the RLC opt-in (wins over the env)."""
+def set_enabled(on: "bool | None"):
+    """Config-driven override of the RLC opt-in (wins over the env).
+    None clears the override (defer to TM_TPU_RLC) — callers that
+    toggle temporarily (benches, dryrun) restore the previous value
+    instead of clobbering it."""
     global _enabled_override
-    _enabled_override = bool(on)
+    _enabled_override = None if on is None else bool(on)
 
 
 def use_rlc(n: int) -> bool:
@@ -413,44 +443,160 @@ def _b_enc_bytes() -> np.ndarray:
 _B_ENC = _b_enc_bytes()
 
 
-def verify_batch_rlc(pubkeys, msgs, sigs) -> bool:
-    """All-or-nothing RLC batch verification.  True: every signature
-    passes (cofactored semantics — see module docstring); False: at least
-    one signature fails OR the batch is ineligible (non-canonical
-    encodings, bucket overflow) — the caller must fall back to the
-    per-signature path for exact attribution."""
+# u * L for u = 0..14 as little-endian rows: zk + 14L < 15L < 2^256, so
+# the lifted scalar always fits 32 bytes
+_L_MULTS = np.stack([
+    np.frombuffer((u * L).to_bytes(32, "little"), dtype=np.uint8)
+    for u in range(15)])
+
+
+def _lift_zk(zk: np.ndarray, u: np.ndarray) -> np.ndarray:
+    """zk + u * L per row (vectorized 256-bit add over uint64 words).
+
+    The MSM digits zk mod L directly would concentrate: zk < L ~ 2^252,
+    so for c = 8 the top window's digits span only bits 248..252 — n
+    scalars onto ~16 of 256 buckets, overflowing the layered scan
+    deterministically for large n.  Adding a per-row uniform multiple of
+    L spreads zk over [0, 15L) ~ [0, 0.94 * 2^256) — every window
+    ~uniform — without changing the verdict: the check multiplies by the
+    cofactor, and [8][uL]A == O for EVERY A (the prime-order component
+    is killed by L, any torsion component by 8 | 8uL)."""
+    a = np.ascontiguousarray(zk).view("<u8")              # (n, 4)
+    b = np.ascontiguousarray(_L_MULTS[u]).view("<u8")     # (n, 4)
+    out = np.empty_like(a)
+    carry = np.zeros(a.shape[0], dtype=np.uint64)
+    for w in range(4):
+        s = a[:, w] + b[:, w]
+        c1 = s < a[:, w]
+        s = s + carry
+        c2 = s < carry
+        out[:, w] = s
+        carry = (c1 | c2).astype(np.uint64)
+    return out.view(np.uint8)
+
+
+def _stage_rlc(pub_m, msgs, sigs, z=None):
+    """Host staging shared by the single-device and mesh-sharded RLC
+    paths: R/s split, canonicity screens, challenge scalars, and the RLC
+    coefficients.  Returns (r_bytes, zk, z, zs), or None when the batch
+    is ineligible (s >= L or non-canonical R — the caller must use the
+    per-signature path).
+
+    `z` is injectable so tests can assert the sharded and single-device
+    paths compute the exact same linear combination; production always
+    samples fresh os.urandom.  The coefficient order is ROW order and is
+    fixed here, before any shard partition — a sharded run combines
+    bitwise-identical (z_i, z_i k_i, sum z_i s_i) scalars, so its verdict
+    can be asserted equal to the unsharded one."""
     from tendermint_tpu.libs import native
 
-    pub_m = ed._to_u8_matrix(pubkeys, 32)
     sig_m = ed._to_u8_matrix(sigs, 64)
     n = pub_m.shape[0]
-    if n == 0:
-        return True
     _, r_bytes, s_bytes, k, host_ok = ed._stage_rows(pub_m, sig_m, msgs)
     if not host_ok.all() or not _r_canonical(r_bytes).all():
-        return False
-    z = np.frombuffer(os.urandom(16 * n), dtype=np.uint8).reshape(n, 16)
+        return None
+    if z is None:
+        z = np.frombuffer(os.urandom(16 * n), dtype=np.uint8).reshape(n, 16)
+        u = np.frombuffer(os.urandom(n), dtype=np.uint8) % 15
+    else:
+        # injected z (tests): derive the lift deterministically from it
+        # so two calls with the same z produce bitwise-identical staged
+        # scalars — the sharded/unsharded equality assertions rely on it
+        u = np.ascontiguousarray(z[:, 0]) % 15
     res = native.rlc_scalars(z, k, s_bytes)
     if res is None:
         res = _rlc_scalars_host(z, k, s_bytes)
     zk, zs = res
-    # pad to the shared shape bucket with zero-scalar basepoint items:
-    # digit 0 everywhere -> bucket 0 -> weight 0, and B decodes fine
-    nb = ed.bucket_size(n)
-    if nb != n:
-        pad = nb - n
-        r_bytes = np.concatenate(
-            [r_bytes, np.broadcast_to(_B_ENC, (pad, 32))])
-        pub_m = np.concatenate([pub_m, np.broadcast_to(_B_ENC, (pad, 32))])
-        zk = np.concatenate([zk, np.zeros((pad, 32), np.uint8)])
-        z = np.concatenate([z, np.zeros((pad, 16), np.uint8)])
-    c = _pick_c(nb)
-    ws, ok_all, overflow = _msm_core(
-        jnp.asarray(r_bytes), jnp.asarray(pub_m), jnp.asarray(zk),
-        jnp.asarray(z), jnp.asarray(zs), c, use_pallas=ed._use_pallas())
+    zk = _lift_zk(zk, u.astype(np.int64))
+    return r_bytes, zk, z, zs
+
+
+def _pad_rows(r_bytes, pub_m, zk, z, nb: int):
+    """Pad the batch to nb rows with zero-scalar basepoint items: digit 0
+    everywhere -> the weight-0 trash bucket, and B decodes fine.  The
+    same masked-coefficient trick covers per-shard remainder lanes when
+    nb is rounded to a shard multiple: every pad row contributes the
+    identity to whichever shard's partial sum it lands in."""
+    n = r_bytes.shape[0]
+    if nb == n:
+        return r_bytes, pub_m, zk, z
+    pad = nb - n
+    r_bytes = np.concatenate([r_bytes, np.broadcast_to(_B_ENC, (pad, 32))])
+    pub_m = np.concatenate([pub_m, np.broadcast_to(_B_ENC, (pad, 32))])
+    zk = np.concatenate([zk, np.zeros((pad, 32), np.uint8)])
+    z = np.concatenate([z, np.zeros((pad, 16), np.uint8)])
+    return r_bytes, pub_m, zk, z
+
+
+# route taken by the most recent verify_batch_rlc call — observability
+# for dryrun_multichip (which must report which path a MULTICHIP capture
+# actually exercised) and for routing tests; not consensus state.
+# Published as ONE reference assignment per call (atomic under the GIL):
+# concurrent verifier threads each replace the whole dict, so a reader
+# never sees the path of one call with the outcome of another.
+_last_route: dict = {"path": None}
+
+
+def last_route() -> dict:
+    return dict(_last_route)
+
+
+def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
+    """All-or-nothing RLC batch verification.  True: every signature
+    passes (cofactored semantics — see module docstring); False: at least
+    one signature fails OR the batch is ineligible (non-canonical
+    encodings, bucket overflow) — the caller must fall back to the
+    per-signature path for exact attribution.
+
+    With `plane` (parallel/sharding._DataPlane) and a shape that passes
+    plane.worth_sharding_msm, the Pippenger bucket accumulation runs as
+    per-shard partial MSMs under shard_map on the mesh batch axis; the
+    partial window sums are reduced on-mesh (all-gather + group adds,
+    with the decode-ok/overflow verdicts psum'd) before the single
+    host-side cofactored identity test.  The partition never changes the
+    combined group element, and the RLC scalars are staged once on the
+    host in row order, so the sharded verdict is identical to the
+    single-device one."""
+    global _last_route
+
+    pub_m = ed._to_u8_matrix(pubkeys, 32)
+    n = pub_m.shape[0]
+    if n == 0:
+        return True
+    staged = _stage_rlc(pub_m, msgs, sigs, z=z)
+    if staged is None:
+        _last_route = {"path": "rlc-ineligible", "n": n, "shards": 0,
+                       "outcome": "ineligible"}
+        return False
+    r_bytes, zk, z, zs = staged
+    use_pallas = ed._use_pallas()
+    if plane is not None and plane.worth_sharding_msm(n):
+        nb = plane.msm_bucket(n)
+        c = _pick_c(nb // plane.nshard)
+        r_bytes, pub_m, zk, z = _pad_rows(r_bytes, pub_m, zk, z, nb)
+        ws, ok_all, overflow = plane.msm_window_sums(
+            r_bytes, pub_m, zk, z, zs, c, use_pallas=use_pallas)
+        route = {"path": "rlc-sharded", "n": n, "shards": plane.nshard,
+                 "c": c}
+    else:
+        nb = ed.bucket_size(n)
+        c = _pick_c(nb)
+        r_bytes, pub_m, zk, z = _pad_rows(r_bytes, pub_m, zk, z, nb)
+        ws, ok_all, overflow = _msm_core(
+            jnp.asarray(r_bytes), jnp.asarray(pub_m), jnp.asarray(zk),
+            jnp.asarray(z), jnp.asarray(zs), c, use_pallas=use_pallas)
+        route = {"path": "rlc-single", "n": n, "shards": 1, "c": c}
+    # the route's OUTCOME distinguishes "the fast path vouched" from
+    # "the fast path was attempted but the caller fell back to per-sig"
+    # — consumers (dryrun_multichip, bench) must check it, or an
+    # overflow/decode bounce would be reported as the fast path
     if not bool(ok_all) or bool(overflow):
+        route["outcome"] = "overflow" if bool(overflow) else "decode-failed"
+        _last_route = route
         return False
     vouched = _combine_windows_host(np.asarray(ws), c)
+    route["outcome"] = "vouched" if vouched else "rejected"
+    _last_route = route
     if vouched:
         # audit line for mixed Go/TPU fleets: the cofactored check stood
         # in for n exact cofactorless verifies — if a chain split is ever
@@ -459,5 +605,6 @@ def verify_batch_rlc(pubkeys, msgs, sigs) -> bool:
         # small-order-component signatures)
         from tendermint_tpu.libs import log as tmlog
         tmlog.logger("crypto").info(
-            "rlc cofactored batch check vouched", sigs=n)
+            "rlc cofactored batch check vouched", sigs=n,
+            shards=route["shards"])
     return vouched

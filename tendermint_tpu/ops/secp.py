@@ -24,6 +24,7 @@ Design notes (vs the ed25519 lane):
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import NamedTuple
 
 import numpy as np
@@ -31,6 +32,31 @@ import jax
 import jax.numpy as jnp
 
 from . import field_secp as FS
+
+
+# explicit opt-in (config [batch_verifier] secp_lane, or
+# TM_TPU_SECP_LANE=1), wired by node assembly via set_lane_enabled().
+# Default OFF: the host C lane (native/ecverify.c) is the measured
+# production path for secp256k1 and this device lane has never run on
+# real TPU hardware — operators flip it on deliberately once a
+# co-located chip makes the per-launch round trip worth it.  Verdicts
+# are per-signature exact either way (BIP-340), pinned against the host
+# oracle in tests/test_secp_lane.py.
+_lane_override: "bool | None" = None
+
+
+def set_lane_enabled(on: "bool | None"):
+    """Config-driven override of the device-lane opt-in (wins over the
+    env, both directions — mirrors msm.set_enabled).  None clears the
+    override so TM_TPU_SECP_LANE governs again."""
+    global _lane_override
+    _lane_override = None if on is None else bool(on)
+
+
+def use_lane() -> bool:
+    if _lane_override is not None:
+        return _lane_override
+    return os.environ.get("TM_TPU_SECP_LANE", "0") == "1"
 
 _i32 = jnp.int32
 
@@ -106,12 +132,12 @@ def add(p: Jac, q: Jac) -> Jac:
 
 
 def _gather16(digit, rows):
-    """Per-lane select of digit in 0..15 from 16 stacked values."""
-    acc = rows[0]
-    for j in range(1, 16):
-        acc = jnp.where(jnp.broadcast_to(digit == j, acc.shape),
-                        rows[j], acc)
-    return acc
+    """Per-lane gather of digit in 0..15 from a (16, NLIMB, B) stacked
+    array (take_along_axis, the ed25519 lane's _gather_cached idiom —
+    the seed's 15-step jnp.where chain per coordinate bloated the ladder
+    body's HLO for no benefit)."""
+    idx = digit[None, None, :]  # (1, 1, B)
+    return jnp.take_along_axis(rows, idx, axis=0)[0]
 
 
 def _g_table_np():
@@ -143,13 +169,25 @@ _G_X, _G_Y, _G_Z = (jnp.asarray(t) for t in _g_table_np())
 
 
 def _p_table(negp: Jac):
-    """Jacobian multiples j*(-P) for j = 0..15, built on device (14
-    complete adds + 1 dbl per batch)."""
+    """Jacobian multiples j*(-P) for j = 0..15 as stacked (16, NLIMB, B)
+    coordinate arrays, built on device: 1 dbl + a 13-step lax.scan of
+    complete adds (the seed unrolled the 13 adds — each one a complete
+    add+dbl+select tree — into straight-line HLO, a major share of the
+    graph that kept this lane from ever compiling)."""
     batch = negp.x.shape[1:]
-    rows = [infinity(batch), negp, dbl(negp)]
-    for j in range(3, 16):
-        rows.append(add(rows[-1], negp))
-    return rows
+    d = dbl(negp)
+
+    def step(acc, _):
+        nxt = add(acc, negp)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, d, None, length=13)  # 3P .. 15P
+    inf = infinity(batch)
+    return Jac(*(
+        jnp.concatenate([jnp.stack([getattr(p, f) for p in (inf, negp, d)],
+                                   axis=0),
+                         getattr(rest, f)], axis=0)
+        for f in ("x", "y", "z")))
 
 
 @jax.jit
@@ -166,22 +204,21 @@ def _verify_core(px_limbs, rx_limbs, s_digits, e_digits):
     # negate for R' = [s]G + [e](-P)
     negp = Jac(px_limbs, FS.carry(-y), FS.one(batch))
     ptab = _p_table(negp)
-    gtab = [Jac(jnp.broadcast_to(_G_X[j][:, None], (FS.NLIMB,) + batch),
-                jnp.broadcast_to(_G_Y[j][:, None], (FS.NLIMB,) + batch),
-                jnp.broadcast_to(_G_Z[j][:, None], (FS.NLIMB,) + batch))
-            for j in range(16)]
+
+    def gather_g(digit):
+        """Fixed-base row: per-lane take from the (16, NLIMB) import-time
+        G table (cf. ed25519 _gather_base_niels)."""
+        return Jac(jnp.take(_G_X, digit, axis=0).T,
+                   jnp.take(_G_Y, digit, axis=0).T,
+                   jnp.take(_G_Z, digit, axis=0).T)
 
     def body(i, acc):
         acc = dbl(dbl(dbl(dbl(acc))))
         ds = jax.lax.dynamic_index_in_dim(s_digits, i, 0, keepdims=False)
         de = jax.lax.dynamic_index_in_dim(e_digits, i, 0, keepdims=False)
-        g = Jac(_gather16(ds, [t.x for t in gtab]),
-                _gather16(ds, [t.y for t in gtab]),
-                _gather16(ds, [t.z for t in gtab]))
-        acc = add(acc, g)
-        q = Jac(_gather16(de, [t.x for t in ptab]),
-                _gather16(de, [t.y for t in ptab]),
-                _gather16(de, [t.z for t in ptab]))
+        acc = add(acc, gather_g(ds))
+        q = Jac(_gather16(de, ptab.x), _gather16(de, ptab.y),
+                _gather16(de, ptab.z))
         return add(acc, q)
 
     rp = jax.lax.fori_loop(0, 64, body, infinity(batch))

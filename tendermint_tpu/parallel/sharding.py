@@ -8,6 +8,12 @@ XLA inserting the collectives — an all-gather of the per-lane bitmap and a
 `psum`-style reduction for the commit-level all-valid bit — over ICI
 (intra-pod) or DCN (multi-host).  This is the analog of the reference's
 blocksync fan-out (blocksync/pool.go:374), but over chips instead of peers.
+
+Two verifier shapes ride the same mesh: the per-signature kernel (batch
+rows split across devices, bitmap all-gathered) and, since round 6, the
+RLC/Pippenger MSM fast path (ops/msm.py) — per-shard partial bucket sums
+with an on-mesh reduction, so the highest-throughput verifier also uses
+every local chip instead of leaving N-1 idle.
 """
 from __future__ import annotations
 
@@ -83,6 +89,123 @@ class _DataPlane:
         if edops._use_pallas():
             return n >= self.nshard * edops.PALLAS_TILE
         return n >= self.nshard
+
+    # -- RLC / Pippenger MSM over the mesh ---------------------------------
+
+    MSM_MIN_PER_SHARD = 32
+
+    def worth_sharding_msm(self, n: int) -> bool:
+        """MSM sharding policy: bucket memory / scan depth, NOT lane
+        count.  The MSM's device wall clock and working set are the
+        layered bucket fill — T unified adds over K_pad bucket lanes,
+        with T * K_pad * 3 coords of niels rows materialized per pass —
+        and sharding splits the M items nshard ways while keeping a full
+        bucket table per shard.  It therefore only wins while the
+        per-shard mean bucket load still dominates the Poisson tail
+        margin baked into T: below that, every shard scans almost as many
+        layers as the single device would and the mesh dispatch is pure
+        overhead.  Shard when the per-shard scan work (T_s * K_pad_s
+        lane-steps, which is also the bucket-memory ratio) models at
+        least a ~1.5x speedup — a 2-shard mesh tops out just under 2x
+        (the tail margin doesn't halve), so demanding 2x would
+        permanently exclude it."""
+        from tendermint_tpu.ops import ed25519 as edops
+        from tendermint_tpu.ops import msm as msmops
+
+        if self.nshard < 2:
+            return False
+        # minimum REAL rows per shard (pad rows are dead weight): below
+        # this the dispatch overhead can't amortize regardless of model
+        if -(-n // self.nshard) < self.MSM_MIN_PER_SHARD:
+            return False
+        # cost model over the plans that would actually EXECUTE — the
+        # bucketed per-shard rows and the c each dispatch would pick —
+        # not the raw n (the two can disagree near bucket boundaries)
+        n_s = self.msm_bucket(n) // self.nshard
+        nb1 = edops.bucket_size(n)
+        shard_plan = msmops.Plan(n_s, msmops._pick_c(n_s))
+        single_plan = msmops.Plan(nb1, msmops._pick_c(nb1))
+        return 3 * shard_plan.T * shard_plan.K_pad <= \
+            2 * single_plan.T * single_plan.K_pad
+
+    def msm_bucket(self, n: int) -> int:
+        """Padded batch size for a sharded MSM: the usual power-of-two
+        compile bucket, rounded up so every shard gets an equal row
+        count (remainder lanes become zero-scalar basepoint pad rows —
+        msm._pad_rows)."""
+        from tendermint_tpu.ops import ed25519 as edops
+
+        nb = max(edops.bucket_size(n), self.nshard)
+        return -(-nb // self.nshard) * self.nshard
+
+    def _msm_fn(self, c: int, use_pallas: bool):
+        """Cached jitted sharded MSM for window width c: each shard runs
+        the full Pippenger pipeline (ops/msm._msm_pipeline) on its batch
+        rows, producing PARTIAL window sums; the cross-shard reduction
+        happens on-mesh before anything returns to the host.  Batch
+        sizes are bucketed by the caller (msm_bucket), so jit's shape
+        cache stays one entry per (c, bucket).
+
+        The window sums are curve points, so their reduction is group
+        addition, not an arithmetic psum: all-gather the nshard partials
+        and tree-add them replicated (nshard-1 unified adds over W
+        lanes — negligible next to the per-shard scan).  The two scalar
+        verdicts (decode-ok, bucket overflow) ARE arithmetic and reduce
+        with a true psum."""
+        key = ("msm", c, use_pallas)
+        with self._lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        from jax.experimental.shard_map import shard_map
+
+        from tendermint_tpu.ops import curve as Cv
+        from tendermint_tpu.ops import msm as msmops
+
+        nshard = self.nshard
+
+        def body(r, pub, zk, z, zs):
+            # per-shard blocks: r/pub/zk (nb/nshard, 32), z (nb/nshard,
+            # 16), zs (1, 32) — only shard 0 carries the real [sum z_i
+            # s_i]B scalar, the rest hold zeros (their B items land in
+            # the weight-0 trash bucket), so the B term enters the total
+            # exactly once
+            ws, ok, ovf = msmops._msm_pipeline(r, pub, zk, z, zs[0], c,
+                                               use_pallas)
+            allw = jax.lax.all_gather(ws, BATCH_AXIS)  # (nshard, 4, ...)
+            total = Cv.Ext(*(allw[0, j] for j in range(4)))
+            for s in range(1, nshard):
+                total = Cv.add_cached(
+                    total,
+                    Cv.to_cached(Cv.Ext(*(allw[s, j] for j in range(4)))))
+            ok_all = jax.lax.psum(ok.astype(jnp.int32),
+                                  BATCH_AXIS) == nshard
+            ovf_any = jax.lax.psum(ovf.astype(jnp.int32), BATCH_AXIS) > 0
+            return jnp.stack(list(total)), ok_all, ovf_any
+
+        f = jax.jit(shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(BATCH_AXIS, None),) * 5,
+            out_specs=(P(), P(), P()), check_rep=False))
+        with self._lock:
+            self._fns.setdefault(key, f)
+            return self._fns[key]
+
+    def msm_window_sums(self, r_bytes, pub_m, zk, z, zs, c: int,
+                        use_pallas: bool = False):
+        """Mesh-sharded equivalent of msm._msm_core: identical combined
+        window sums (as group elements), batch rows split across devices.
+        Inputs are the padded staged arrays (batch divisible by nshard);
+        returns (window sums (4, NLIMB, W), decode_ok_all, overflow)."""
+        import numpy as np
+
+        nb = r_bytes.shape[0]
+        assert nb % self.nshard == 0, (nb, self.nshard)
+        zs_rows = np.zeros((self.nshard, 32), dtype=np.uint8)
+        zs_rows[0] = zs
+        fn = self._msm_fn(c, use_pallas)
+        return fn(jnp.asarray(r_bytes), jnp.asarray(pub_m),
+                  jnp.asarray(zk), jnp.asarray(z), jnp.asarray(zs_rows))
 
     def _packed_fn(self):
         """TPU path: the fused Pallas kernel inside shard_map, packed

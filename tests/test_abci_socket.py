@@ -63,7 +63,7 @@ def test_proxy_four_connections():
         srv.stop()
 
 
-@pytest.mark.slow
+# demoted from @pytest.mark.slow: 4.98 s on CPU (< 5 s bar, pytest.ini)
 def test_external_kvstore_process_backs_a_chain():
     """The VERDICT done-criterion: kvstore as a separate OS process passes
     the consensus e2e (single-validator node commits blocks through the

@@ -158,6 +158,178 @@ def test_combine_windows_host_identity():
     assert msm._combine_windows_host(ws, 4) is True
 
 
+def _np_digits(b, c, W):
+    """Host mirror of msm._digits: (n, NB) uint8 -> (W, n) int64."""
+    bits = np.unpackbits(b, axis=1, bitorder="little")
+    need = W * c
+    if need > bits.shape[1]:
+        bits = np.concatenate(
+            [bits, np.zeros((b.shape[0], need - bits.shape[1]), np.uint8)],
+            axis=1)
+    else:
+        bits = bits[:, :need]
+    w = (1 << np.arange(c, dtype=np.int64))
+    return (bits.reshape(-1, W, c).astype(np.int64) * w).sum(-1).T
+
+
+def test_plan_depth_covers_structural_digit_pileup():
+    """Regression for the r5 seed's silent-overflow bug: T was sized on
+    the global mean bucket load, but scalar classes whose bit-length is
+    not a multiple of c pile their top-window digits onto a handful of
+    buckets (z at c=6: 2 meaningful bits -> ~n/4 items in one bucket),
+    so the fast path deterministically overflowed and fell back for
+    every n >= 128 — the production sizes.  Now: c is restricted to
+    divide 128, zk is mod-L lifted across 256 bits, and T is sized on
+    the worst-window load.  Simulate the staged digit keys host-side
+    and assert the fullest bucket fits the planned depth."""
+    rng = np.random.default_rng(20260803)
+    for n in (128, 1024, 8192, 65536):
+        c = msm._pick_c(n)
+        assert 128 % c == 0, c  # full-width z windows by construction
+        plan = msm.Plan(n, c)
+        z = rng.integers(0, 256, size=(n, 16), dtype=np.uint8)
+        zk_ints = [int.from_bytes(rng.bytes(32), "little") >> 3
+                   for _ in range(n)]
+        zk = np.frombuffer(
+            b"".join((v % msm.L).to_bytes(32, "little") for v in zk_ints),
+            dtype=np.uint8).reshape(n, 32)
+        zk = msm._lift_zk(zk, rng.integers(0, 15, size=n))
+        dA = _np_digits(zk, c, plan.W_A)
+        dR = _np_digits(z, c, plan.W_R)
+        keys = np.concatenate([
+            ((np.arange(plan.W_A)[:, None] << c) + dA)[dA != 0],
+            ((np.arange(plan.W_R)[:, None] << c) + dR)[dR != 0]])
+        fullest = np.bincount(keys, minlength=plan.K).max()
+        assert fullest <= plan.T, (n, c, int(fullest), plan.T)
+
+
+def test_lift_zk_congruent_and_bounded():
+    """zk + u*L stays a 32-byte value, is congruent to zk mod L (the
+    verdict-invariance precondition: [8][uL]A == O for every A), and
+    actually spreads the top window."""
+    rng = np.random.default_rng(7)
+    n = 64
+    ints = [int.from_bytes(rng.bytes(32), "little") % msm.L
+            for _ in range(n)]
+    zk = np.frombuffer(b"".join(v.to_bytes(32, "little") for v in ints),
+                       dtype=np.uint8).reshape(n, 32)
+    u = rng.integers(0, 15, size=n)
+    lifted = msm._lift_zk(zk, u)
+    tops = set()
+    for i in range(n):
+        v = int.from_bytes(lifted[i].tobytes(), "little")
+        assert v == ints[i] + int(u[i]) * msm.L  # fits 256 bits, exact
+        assert v % msm.L == ints[i]
+        tops.add(lifted[i, 31] >> 4)
+    assert len(tops) > 4  # unlifted zk: top nibble always 0 or 1
+
+
+def _order8_point():
+    """An order-8 torsion point on edwards25519 in extended coords.
+
+    The order-4 points are (+-i, 0) (from -x^2 = 1 with y = 0), and the
+    a = -1 doubling map gives y(2T) = (y^2 + x^2)/(1 - d x^2 y^2) — so
+    an order-8 point satisfies y^2 = -x^2.  Substituting into the curve
+    equation: d x^4 - 2 x^2 - 1 = 0, i.e. x^2 = (1 +- sqrt(1 + d))/d
+    and y = +-sqrt(-1) x.  Solve, then pick the candidate whose order
+    is exactly 8 (checked via the reference bignum ladder)."""
+    from tendermint_tpu.crypto import _edref as er
+
+    p = er.P
+
+    def sqrt_mod(a):
+        a %= p
+        x = pow(a, (p + 3) // 8, p)
+        if (x * x - a) % p:
+            x = x * er.SQRT_M1 % p
+        return None if (x * x - a) % p else x
+
+    s1 = sqrt_mod(1 + er.D)
+    assert s1 is not None
+    d_inv = pow(er.D, p - 2, p)
+    ident = er._encode(er.IDENT)
+    for t in ((1 + s1) * d_inv % p, (1 - s1) * d_inv % p):
+        x = sqrt_mod(t)
+        if x is None:
+            continue
+        for xx in (x, p - x):
+            for y in (xx * er.SQRT_M1 % p, p - xx * er.SQRT_M1 % p):
+                # on-curve check for -x^2 + y^2 = 1 + d x^2 y^2
+                if (-xx * xx + y * y - 1
+                        - er.D * xx * xx % p * y * y) % p:
+                    continue
+                T = (xx, y, 1, xx * y % p)
+                if er._encode(er._mul(8, T)) == ident and \
+                        er._encode(er._mul(4, T)) != ident:
+                    return T
+    raise AssertionError("no order-8 point found")
+
+
+def test_rlc_torsion_divergence_vector_and_vouch_audit(monkeypatch):
+    """The documented ADR-009 boundary, witnessed end to end: a
+    signature whose residual is a PURE small-order torsion component is
+    rejected by every cofactorless per-signature path (host, kernel)
+    but accepted by the cofactored RLC batch check — and the vouch
+    audit line fires, so a mixed-fleet operator can find which batches
+    the fast path vouched for.
+
+    Construction: R' = [r]B + T8 with T8 of order 8, k = H(R'||A||M),
+    s = r + k*a.  Then [s]B - [k]A = R' - T8 != R' (cofactorless
+    reject) while [8]([s]B - R' - [k]A) = [8](-T8) = O (cofactored
+    accept)."""
+    import hashlib
+    import logging
+
+    from tendermint_tpu.crypto import _edref as er
+    from tendermint_tpu.crypto import ed25519 as edkeys
+
+    seed = (0xADC9).to_bytes(32, "little")
+    pub = er.pubkey_from_seed(seed)
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    msg = b"adr-009 cofactor boundary"
+    T8 = _order8_point()
+    r_nonce = int.from_bytes(
+        hashlib.sha512(b"torsion nonce").digest(), "little") % er.L
+    r_clean = er._mul(r_nonce, er.BASE)
+    r_enc = er._encode(er._add(r_clean, T8))
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + pub + msg).digest(), "little") % er.L
+    s = (r_nonce + k * a) % er.L
+    sig = r_enc + s.to_bytes(32, "little")
+
+    # every cofactorless per-sig path rejects
+    assert er.verify(pub, msg, sig) is False
+    assert edkeys.PubKey(pub).verify_signature(msg, sig) is False
+
+    # ...including the device kernel through the production seam, which
+    # must attribute exactly the torsion lane (RLC stays opted out)
+    monkeypatch.delenv("TM_TPU_RLC", raising=False)
+    monkeypatch.setattr(msm, "_enabled_override", None)
+    n = 20
+    pubs, msgs, sigs = _batch(n, tag=b"torsion")
+    pubs[4], msgs[4], sigs[4] = pub, msg, sig
+    out = edops.verify_batch(pubs, msgs, sigs)
+    want = np.ones(n, dtype=bool)
+    want[4] = False
+    assert (out == want).all(), out
+
+    # the cofactored RLC batch check accepts the SAME batch (the two
+    # semantics differ exactly here) and logs the vouch audit line
+    records = []
+    lg = logging.getLogger("tm.crypto")
+    handler = logging.Handler()
+    handler.emit = records.append
+    lg.addHandler(handler)
+    try:
+        assert msm.verify_batch_rlc(pubs, msgs, sigs) is True
+    finally:
+        lg.removeHandler(handler)
+    assert any("vouched" in r.getMessage() for r in records), records
+
+
 def test_pallas_msm_kernels_interpret(monkeypatch):
     """The fused Mosaic kernels (decompress-to-niels, layered bucket
     scan) must agree with the XLA path through the pallas interpreter

@@ -54,6 +54,159 @@ def test_sharded_verifier_unaligned_batch():
     assert bitmap.shape == (13,) and bitmap.all()
 
 
+def _rlc_batch(n, tag=b""):
+    """Deterministic valid batch via the pure-Python signer (no RNG)."""
+    from tendermint_tpu.crypto import _edref
+
+    seeds = [(0x7100 + i).to_bytes(32, "little") for i in range(n)]
+    msgs = [b"rlc mesh %d " % i + tag for i in range(n)]
+    pubs = [_edref.pubkey_from_seed(s) for s in seeds]
+    sigs = [_edref.sign(s, m) for s, m in zip(seeds, msgs)]
+    return pubs, msgs, sigs
+
+
+def _fixed_z(n):
+    import numpy as np
+    rng = np.random.default_rng(20260803)
+    return rng.integers(0, 256, size=(n, 16), dtype=np.uint8)
+
+
+def test_msm_sharding_policy_and_bucket():
+    """worth_sharding_msm is a bucket-memory/scan-depth policy, not a
+    lane count: tiny per-shard rows are declined (the Poisson tail
+    dominates T and every shard would scan nearly as many layers as one
+    device), larger ones accepted; msm_bucket always divides evenly."""
+    sys.path.insert(0, REPO)
+    from tendermint_tpu.parallel import sharding
+
+    plane = sharding.data_plane()
+    assert plane is not None and plane.nshard >= 2
+    assert not plane.worth_sharding_msm(8)
+    # below one MSM_MIN_PER_SHARD row block per shard: always declined
+    assert not plane.worth_sharding_msm(
+        plane.MSM_MIN_PER_SHARD * plane.nshard - plane.nshard)
+    assert plane.worth_sharding_msm(1024)
+    assert plane.worth_sharding_msm(100_000)
+    for n in (50, 256, 1000, 4096):
+        nb = plane.msm_bucket(n)
+        assert nb >= n and nb % plane.nshard == 0, (n, nb)
+
+
+def test_rlc_sharded_verdict_matches_single_and_host_oracle(monkeypatch):
+    """The mesh-sharded RLC/MSM (per-shard partial Pippenger sums,
+    on-mesh reduction, psum'd verdict flags) must agree bitwise with the
+    single-device RLC path — same injected z, same coefficient order —
+    and with the per-sig host oracle, on valid AND adversarial batches.
+    Runs at the nb=64 compile bucket (the policy itself is unit-tested
+    above; forcing the shard route here keeps the XLA compile budget to
+    one extra sharded program)."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from tendermint_tpu.crypto import _edref
+    from tendermint_tpu.ops import msm
+    from tendermint_tpu.parallel import sharding
+
+    plane = sharding.data_plane()
+    assert plane is not None and plane.nshard >= 2
+    monkeypatch.setattr(plane, "worth_sharding_msm", lambda n: True)
+
+    n = 50
+    pubs, msgs, sigs = _rlc_batch(n)
+    z = _fixed_z(n)
+    assert msm.verify_batch_rlc(pubs, msgs, sigs, plane=plane, z=z) is True
+    route = msm.last_route()
+    assert route["path"] == "rlc-sharded" and \
+        route["shards"] == plane.nshard, route
+    assert msm.verify_batch_rlc(pubs, msgs, sigs, z=z) is True
+    assert msm.last_route()["path"] == "rlc-single"
+    assert all(_edref.verify(bytes(pubs[i]), msgs[i], sigs[i])
+               for i in range(n))
+
+    # adversarial classes: each must fail BOTH paths (and the host
+    # oracle rejects the touched lane)
+    tampered = [bytearray(s) for s in sigs]
+    tampered[7][3] ^= 1
+    swapped = list(sigs)
+    swapped[1], swapped[2] = swapped[2], swapped[1]
+    variants = [
+        (pubs, msgs, [bytes(b) for b in tampered]),
+        (pubs, [b"evil" if i == 0 else m for i, m in enumerate(msgs)],
+         sigs),
+        ([pubs[1] if i == 3 else p for i, p in enumerate(pubs)], msgs,
+         sigs),
+        (pubs, msgs, swapped),  # valid sigs, wrong lanes
+    ]
+    for vp, vm, vs in variants:
+        assert msm.verify_batch_rlc(vp, vm, vs, plane=plane, z=z) is False
+        assert msm.verify_batch_rlc(vp, vm, vs, z=z) is False
+
+    # window sums: identical GROUP elements (affine compare — the
+    # projective representatives legitimately differ with the addition
+    # order) between one-device and mesh at the same staged scalars
+    from tendermint_tpu.ops import curve as C
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.ops import field as F
+    import jax.numpy as jnp
+
+    pub_m = edops._to_u8_matrix(pubs, 32)
+    r_bytes, zk, z2, zs = msm._stage_rlc(pub_m, msgs, sigs, z=z)
+    nb = plane.msm_bucket(n)
+    r_p, pub_p, zk_p, z_p = msm._pad_rows(r_bytes, pub_m, zk, z2, nb)
+    c = msm._pick_c(nb)
+    ws1, ok1, ov1 = msm._msm_core(
+        jnp.asarray(r_p), jnp.asarray(pub_p), jnp.asarray(zk_p),
+        jnp.asarray(z_p), jnp.asarray(zs), c)
+    ws8, ok8, ov8 = plane.msm_window_sums(r_p, pub_p, zk_p, z_p, zs, c)
+    assert bool(ok1) and bool(ok8) and not bool(ov1) and not bool(ov8)
+    w1, w8 = np.asarray(ws1), np.asarray(ws8)
+
+    def aff(ws, w):
+        X = F.limbs_to_int(ws[0, :, w]) % C.P
+        Y = F.limbs_to_int(ws[1, :, w]) % C.P
+        Z = F.limbs_to_int(ws[2, :, w]) % C.P
+        zi = pow(Z, C.P - 2, C.P)
+        return (X * zi % C.P, Y * zi % C.P)
+
+    for w in range(w1.shape[2]):
+        assert aff(w1, w) == aff(w8, w), w
+
+
+def test_verify_batch_seam_routes_rlc_through_mesh(monkeypatch):
+    """The production ops/ed25519.verify_batch seam: the data plane is
+    consulted FIRST and an opted-in RLC batch dispatches through it
+    (sharded MSM); an invalid batch falls back through the plane's
+    per-sig ladder with an EXACT bitmap."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.ops import msm
+    from tendermint_tpu.parallel import sharding
+
+    plane = sharding.data_plane()
+    assert plane is not None and plane.nshard >= 2
+    monkeypatch.setattr(plane, "worth_sharding_msm", lambda n: True)
+    monkeypatch.setattr(msm, "_enabled_override", None)
+    monkeypatch.setenv("TM_TPU_RLC", "1")
+    monkeypatch.setenv("TM_TPU_RLC_MIN", "16")
+
+    n = 50
+    pubs, msgs, sigs = _rlc_batch(n, tag=b"seam")
+    out = edops.verify_batch(pubs, msgs, sigs)
+    assert out.shape == (n,) and out.all()
+    route = msm.last_route()
+    assert route["path"] == "rlc-sharded" and \
+        route["shards"] == plane.nshard, route
+
+    bad = [bytearray(s) for s in sigs]
+    bad[11][5] ^= 0x40
+    out = edops.verify_batch(pubs, msgs, [bytes(b) for b in bad])
+    want = np.ones(n, dtype=bool)
+    want[11] = False
+    assert (out == want).all(), out
+
+
 @pytest.mark.slow
 def test_dryrun_multichip_subprocess_hermetic():
     """The driver-facing entry must succeed from a hostile parent env
@@ -68,6 +221,9 @@ def test_dryrun_multichip_subprocess_hermetic():
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "sharded verify OK" in r.stdout
+    # the capture must say which verify path ran (per-sig vs RLC) and
+    # that the RLC batch actually took the mesh-sharded MSM
+    assert "path=rlc-sharded" in r.stdout, r.stdout
 
 
 def test_batch_verifier_uses_mesh_data_plane(monkeypatch):

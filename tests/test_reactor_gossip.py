@@ -49,7 +49,7 @@ class _FakePeer:
     try_send = send
 
 
-@pytest.mark.slow
+# demoted from @pytest.mark.slow: 1.2 s on CPU (< 5 s bar, pytest.ini)
 def test_maj23_answered_with_vote_set_bits_and_live_net():
     """Run a live 4-validator in-process net (bit-array gossip active),
     then poke one reactor directly with a VoteSetMaj23 and check the

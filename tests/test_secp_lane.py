@@ -156,3 +156,137 @@ def test_sqrt_non_residue_detectable():
               if pow(x, (P - 1) // 2, P) == P - 1)
     s = FS.sqrt(_col([nr]))
     assert _vals(FS.sqr(s))[0] % P != nr
+
+
+# ---------------------------------------------------------------------------
+# the device lane itself (ops/secp.py) — orphaned in the r5 seed (559 LoC
+# imported by nothing, tested by nothing, and its unrolled pow chains
+# never even finished compiling); now wired into crypto/batch behind
+# TM_TPU_SECP_LANE=1 / [batch_verifier] secp_lane
+# ---------------------------------------------------------------------------
+
+def _secp_adversarial_vectors():
+    """The consensus-relevant structured encodings (mirrors
+    test_native_ec._secp_adversarial_cases): s >= N, r >= P, pubkey
+    x >= P, non-square lift_x, off-curve R_x, plus valid controls."""
+    from tendermint_tpu.crypto import secp256k1 as secp
+
+    k = secp.PrivKey.gen_from_secret(b"\x77" * 32)
+    pub = k.pub_key().bytes()
+    m = b"structured secp lane"
+    good = k.sign(m)
+    r_good, s_good = good[:32], good[32:]
+
+    def be(x):
+        return x.to_bytes(32, "big")
+
+    x = 5
+    while pow((pow(x, 3, secp.P) + 7) % secp.P,
+              (secp.P - 1) // 2, secp.P) == 1:
+        x += 1
+    off_curve_x = be(x)
+
+    k2 = secp.PrivKey.gen_from_secret(b"\x78" * 32)
+    m2 = b"second control"
+    return [
+        (pub, m, r_good + be(secp.N)),           # s == group order
+        (pub, m, r_good + be(secp.N + 1)),       # s > group order
+        (pub, m, be(secp.P) + s_good),           # r == field prime
+        (pub, m, be(secp.P + 1) + s_good),       # r > field prime
+        (pub, m, off_curve_x + s_good),          # R_x: non-square lift_x
+        (b"\x02" + be(secp.P), m, good),         # pubkey x >= p
+        (b"\x02" + off_curve_x, m, good),        # pubkey off curve
+        (pub, m, r_good + be(0)),                # s == 0
+        (pub, m, good),                          # control: valid
+        (k2.pub_key().bytes(), m2, k2.sign(m2)),  # second valid control
+    ]
+
+
+@pytest.mark.slow
+def test_secp_device_lane_bitmap_vs_host_oracles():
+    """Bitmap of the TPU lane pinned against the host oracles on the
+    adversarial vectors + corrupted-signature sweep.  Slow tier: the
+    64-step complete-add ladder costs a multi-minute XLA-on-CPU compile
+    (one per process)."""
+    from tendermint_tpu.crypto import secp256k1 as secp
+    from tendermint_tpu.libs import native
+    from tendermint_tpu.ops import secp as secp_ops
+
+    cases = _secp_adversarial_vectors()
+    # plus a corrupted sweep over fresh keys
+    for i in range(6):
+        k = secp.PrivKey.gen_from_secret((0xE100 + i).to_bytes(32, "big"))
+        m = b"sweep %d" % i
+        s = bytearray(k.sign(m))
+        if i % 2:
+            s[(i * 11) % 64] ^= 1 << (i % 8)
+        cases.append((k.pub_key().bytes(), m, bytes(s)))
+    pubs = [c[0] for c in cases]
+    msgs = [c[1] for c in cases]
+    sigs = [c[2] for c in cases]
+
+    want = [secp.PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)]
+    assert any(want) and not all(want)
+    got = secp_ops.verify_batch_device(pubs, msgs, sigs)
+    assert [bool(b) for b in got] == want
+    cok = native.secp_verify(pubs, msgs, sigs) \
+        if native.get_lib() is not None else None
+    if cok is not None:  # the C oracle, where a toolchain exists
+        assert [bool(b) for b in got] == [bool(b) for b in cok]
+
+
+def test_secp_lane_routing_is_optin(monkeypatch):
+    """crypto/batch routes secp256k1 to the device lane ONLY behind the
+    opt-in (env TM_TPU_SECP_LANE=1 or config secp_lane -> set_lane_enabled,
+    config winning both directions); the bitmap stays exact either way.
+    The heavy kernel is stubbed with the host oracle — compile-free, the
+    lane's own bitmap is pinned in the slow-tier test above."""
+    from tendermint_tpu.crypto import batch as cb
+    from tendermint_tpu.crypto import secp256k1 as secp
+    from tendermint_tpu.ops import secp as secp_ops
+
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.setattr(secp_ops, "_lane_override", None)
+    routed = []
+
+    def spy(pubs_, msgs_, sigs_):
+        routed.append(len(pubs_))
+        return np.array([secp.PubKey(p).verify_signature(m, s)
+                         for p, m, s in zip(pubs_, msgs_, sigs_)])
+
+    monkeypatch.setattr(secp_ops, "verify_batch_device", spy)
+
+    def run_batch():
+        bv = cb.BatchVerifier(tpu_threshold=2)
+        want = []
+        for i in range(6):
+            k = secp.PrivKey.gen_from_secret((0xE200 + i).to_bytes(32,
+                                                                   "big"))
+            m = b"route optin %d" % i
+            s = bytearray(k.sign(m))
+            ok = True
+            if i == 3:
+                s[0] ^= 1
+                ok = False
+            bv.add(k.pub_key(), m, bytes(s))
+            want.append(ok)
+        _, bits = bv.verify()
+        return want, list(bits)
+
+    # default: stays on the host C/python lane
+    monkeypatch.delenv("TM_TPU_SECP_LANE", raising=False)
+    want, bits = run_batch()
+    assert bits == want and routed == []
+    # env opt-in routes to the device lane
+    monkeypatch.setenv("TM_TPU_SECP_LANE", "1")
+    want, bits = run_batch()
+    assert bits == want and routed == [6]
+    # config override wins over the env, both directions
+    secp_ops.set_lane_enabled(False)
+    want, bits = run_batch()
+    assert bits == want and routed == [6]
+    secp_ops.set_lane_enabled(True)
+    monkeypatch.delenv("TM_TPU_SECP_LANE")
+    want, bits = run_batch()
+    assert bits == want and routed == [6, 6]

@@ -66,7 +66,8 @@ class MiniWSClient:
         self.sock.close()
 
 
-@pytest.mark.slow
+# demoted from @pytest.mark.slow: 4.2 s on CPU (< 5 s bar, pytest.ini) —
+# safety tests must not be the least-run tests
 def test_ws_subscribe_new_block_and_tx(tmp_path):
     from tendermint_tpu.abci.kvstore import KVStoreApplication
     from tendermint_tpu.config.config import Config
@@ -148,7 +149,7 @@ def test_ws_subscribe_new_block_and_tx(tmp_path):
         node.stop()
 
 
-@pytest.mark.slow
+# demoted from @pytest.mark.slow: 2.7 s on CPU (< 5 s bar, pytest.ini)
 def test_production_ws_client_and_new_rpc_routes(tmp_path):
     """The shipped WSClient (rpc/client.py) subscribes / receives /
     multiplexes calls over one socket, and the round-3 RPC routes
