@@ -60,6 +60,25 @@ def _make_batch(n):
 RLC_BATCH = 1 << 14  # sharded-RLC config batch (BENCH_RLC_BATCH overrides)
 
 
+def _trace_artifact(tag: str):
+    """Export the flight-recorder buffer (libs/trace.py, enabled at the
+    top of main) as a Chrome-trace artifact next to the bench JSON, so
+    every future BENCH_r*.json capture comes with a timeline of where
+    the batches actually went — including host-fallback runs, where the
+    trace shows WHY the device path was skipped.  Returns the path for
+    the JSON line's "trace" field (None only if the export itself
+    failed; the bench number still stands)."""
+    from tendermint_tpu.libs import trace
+
+    out = os.path.join(os.environ.get("BENCH_TRACE_DIR", "."),
+                       f"BENCH_trace_{tag}.json")
+    try:
+        return trace.export_file(os.path.abspath(out))
+    except Exception as e:  # noqa: BLE001 - artifact is best-effort
+        print(f"# trace artifact export failed: {e}", file=sys.stderr)
+        return None
+
+
 def _make_batch_selfhosted(n):
     """Batch built with the in-repo signer (OpenSSL when available,
     pure-Python otherwise) — the RLC config must degrade cleanly even on
@@ -84,16 +103,19 @@ def _rlc_main():
     (rc=0), per the crypto/degrade.py ladder."""
     t_start = time.time()
     from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.libs import trace
 
     # host baseline: per-signature verify through the same PubKey wrapper
     # the node uses (OpenSSL when present)
     nbase = 400
     bpubs, bmsgs, bsigs = _make_batch_selfhosted(nbase)
     keys = [edkeys.PubKey(p) for p in bpubs]
-    t0 = time.perf_counter()
-    for i in range(nbase):
-        assert keys[i].verify_signature(bmsgs[i], bsigs[i])
-    cpu_rate = nbase / (time.perf_counter() - t0)
+    with trace.span("bench.host_baseline", n=nbase) as sp:
+        t0 = time.perf_counter()
+        for i in range(nbase):
+            assert keys[i].verify_signature(bmsgs[i], bsigs[i])
+        cpu_rate = nbase / (time.perf_counter() - t0)
+        sp.add(sigs_per_s=round(cpu_rate))
 
     try:
         _rlc_device_bench(cpu_rate, t_start)
@@ -106,6 +128,7 @@ def _rlc_main():
             "unit": "sigs/s",
             "vs_baseline": 1.0,
             "note": "device unavailable, host fallback",
+            "trace": _trace_artifact("rlc_host_fallback"),
         }))
         print(f"# rlc bench degraded to host: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -155,6 +178,7 @@ def _rlc_device_bench(cpu_rate, t_start):
             # route is authoritative: it records what actually ran, not
             # what the policy would model
             "note": f"rlc path={route['path']} shards={route['shards']}",
+            "trace": _trace_artifact("rlc"),
         }))
         print(f"# cpu_baseline={cpu_rate:.0f}/s platform="
               f"{jax.devices()[0].platform} route={route} "
@@ -164,6 +188,11 @@ def _rlc_device_bench(cpu_rate, t_start):
 
 
 def main():
+    # flight recorder on for the whole bench: every JSON line carries a
+    # "trace" artifact path so the capture explains itself (which route,
+    # what occupancy, compile vs execute) instead of being one number
+    from tendermint_tpu.libs import trace
+    trace.enable(capacity=1 << 15)
     if os.environ.get("BENCH_RLC") == "1":
         _rlc_main()
         return
@@ -174,10 +203,11 @@ def main():
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
     nbase = 2000
     keys = [Ed25519PublicKey.from_public_bytes(bytes(p)) for p in pubs[:nbase]]
-    t0 = time.perf_counter()
-    for i in range(nbase):
-        keys[i].verify(bytes(sigs[i]), msgs[i])
-    cpu_rate = nbase / (time.perf_counter() - t0)
+    with trace.span("bench.host_baseline", n=nbase):
+        t0 = time.perf_counter()
+        for i in range(nbase):
+            keys[i].verify(bytes(sigs[i]), msgs[i])
+        cpu_rate = nbase / (time.perf_counter() - t0)
 
     # --- TPU batched verify --------------------------------------------
     # Degradation, not rc=1: a missing/unreachable accelerator (tunnel
@@ -201,6 +231,7 @@ def main():
             "median_value": round(cpu_rate, 1),
             "median_vs_baseline": 1.0,
             "note": "device unavailable, host fallback",
+            "trace": _trace_artifact("headline_host_fallback"),
         }))
         print(f"# device bench failed, host fallback: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -306,24 +337,31 @@ def _device_bench(pubs, msgs, sigs, cpu_rate, t_start):
                  and e2e_rate < PLATEAU * resident_rate):
             nsub = schemes[npass % len(schemes)]
             npass += 1
+            from tendermint_tpu.libs import trace
+            sp = trace.span("bench.pass", scheme=str(nsub), rounds=ROUNDS,
+                            batch=BATCH)
             t0 = time.perf_counter()
             outs = []
-            if nsub == "split":
-                # staging happens inside, chunk-interleaved with the
-                # kernels; successive rounds pipeline on the device queue
-                for r in range(ROUNDS):
-                    outs += launch_split()
-            else:
-                fut = pool.submit(prepare, pubs, sigs, msgs)
-                for r in range(ROUNDS):
-                    dev, host_ok = fut.result()
-                    if r + 1 < ROUNDS:
-                        fut = pool.submit(prepare, pubs, sigs, msgs)
-                    outs += launch(dev, nsub)
-            # one device stream executes launches in order: blocking on
-            # the last covers all rounds with a single tunnel round trip
-            outs[-1].block_until_ready()
-            rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+            with sp:
+                if nsub == "split":
+                    # staging happens inside, chunk-interleaved with the
+                    # kernels; successive rounds pipeline on the device
+                    # queue
+                    for r in range(ROUNDS):
+                        outs += launch_split()
+                else:
+                    fut = pool.submit(prepare, pubs, sigs, msgs)
+                    for r in range(ROUNDS):
+                        dev, host_ok = fut.result()
+                        if r + 1 < ROUNDS:
+                            fut = pool.submit(prepare, pubs, sigs, msgs)
+                        outs += launch(dev, nsub)
+                # one device stream executes launches in order: blocking
+                # on the last covers all rounds with a single tunnel
+                # round trip
+                outs[-1].block_until_ready()
+                rate = ROUNDS * BATCH / (time.perf_counter() - t0)
+                sp.add(sigs_per_s=round(rate))
             pass_rates.append((rate, nsub))
             scheme_best[nsub] = max(scheme_best[nsub], rate)
             e2e_rate = max(e2e_rate, rate)
@@ -354,6 +392,7 @@ def _device_bench(pubs, msgs, sigs, cpu_rate, t_start):
         "vs_baseline": round(e2e_rate / cpu_rate, 2),
         "median_value": round(median_rate, 1),
         "median_vs_baseline": round(median_rate / cpu_rate, 2),
+        "trace": _trace_artifact("headline"),
     }))
     print(f"# cpu_baseline={cpu_rate:.0f}/s platform="
           f"{jax.devices()[0].platform} passes={npass} "

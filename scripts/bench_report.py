@@ -29,6 +29,32 @@ sys.path.insert(0, os.path.join(
 import numpy as np  # noqa: E402
 
 
+def _launch_baseline():
+    """Capture the launch record BEFORE a config runs; _launch_cols
+    compares against it so a config whose verifies all resolved on the
+    host doesn't report the PREVIOUS config's route as its own."""
+    from tendermint_tpu.ops import ed25519 as edops
+
+    return edops.last_launch()
+
+
+def _launch_cols(baseline=None):
+    """Route + occupancy columns for the configs that go through the
+    device verify seam (ISSUE 3): which path the LAST launch took and
+    how full its padded lane bucket was — read from the launch record
+    ops/ed25519._record_launch publishes (the same data lands in
+    crypto_msm_route_total / crypto_batch_occupancy_ratio on /metrics)."""
+    from tendermint_tpu.ops import ed25519 as edops
+
+    rec = edops.last_launch()
+    if rec is baseline:  # every launch publishes a fresh snapshot, so
+        # identity means this config dispatched nothing to the device
+        return {"route": None, "occupancy": None}
+    occ = rec.get("occupancy")
+    return {"route": rec.get("path"),
+            "occupancy": round(occ, 3) if occ is not None else None}
+
+
 def _cpu_verify_rate(n=1500):
     """Single-threaded OpenSSL verify rate (the Go-loop stand-in)."""
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -46,6 +72,8 @@ def _cpu_verify_rate(n=1500):
 def config2_commit_150():
     from helpers import build_chain, make_genesis
 
+    base = _launch_baseline()
+
     gdoc, privs = make_genesis(150)
     blocks, commits, states = build_chain(gdoc, privs, 3)
     vset = states[1].last_validators
@@ -61,7 +89,7 @@ def config2_commit_150():
     dt = (time.perf_counter() - t0) / reps
     return {"config": "2: VerifyCommit 150 validators",
             "wall_ms": round(dt * 1e3, 1),
-            "sigs_per_s": round(150 / dt)}
+            "sigs_per_s": round(150 / dt), **_launch_cols(base)}
 
 
 def config3_light_10k():
@@ -117,6 +145,7 @@ def config3_light_10k():
 
 
 def config4_blocksync(n_blocks=60, n_vals=150, window=30):
+    base = _launch_baseline()
     from helpers import build_chain, make_genesis
     from tendermint_tpu.abci.kvstore import KVStoreApplication
     from tendermint_tpu.blocksync.replay import replay_window
@@ -163,10 +192,12 @@ def config4_blocksync(n_blocks=60, n_vals=150, window=30):
             "sigs_per_s": round(n_blocks * n_vals / replay_s),
             "replay_noverify_s": round(noverify_s, 2),
             "verify_share_pct": round(
-                100 * (replay_s - noverify_s) / replay_s, 1)}
+                100 * (replay_s - noverify_s) / replay_s, 1),
+            **_launch_cols(base)}
 
 
 def config5_mixed(n=4096):
+    base = _launch_baseline()
     from tendermint_tpu.crypto import ed25519 as ed
     from tendermint_tpu.crypto import secp256k1 as secp
     from tendermint_tpu.crypto import sr25519 as sr
@@ -203,7 +234,8 @@ def config5_mixed(n=4096):
     dt = time.perf_counter() - t0
     assert ok
     return {"config": f"5: mixed 3-scheme batch ({n}, cold cache)",
-            "wall_s": round(dt, 2), "sigs_per_s": round(n / dt)}
+            "wall_s": round(dt, 2), "sigs_per_s": round(n / dt),
+            **_launch_cols(base)}
 
 
 def _make_commit(n, chain_id, height=9):
@@ -244,6 +276,7 @@ def config6_verify_commit_100k(n=100_000, cpu_sample=4000):
     OpenSSL (serial verify is linear in n: per-sig rate is constant, so
     the subsample extrapolates exactly; measuring all 100k would add
     ~15 s of benchmark time for the same number)."""
+    base = _launch_baseline()
     chain_id = "vc-100k"
     t0 = time.perf_counter()
     vset, commit, bid = _make_commit(n, chain_id)
@@ -293,7 +326,7 @@ def config6_verify_commit_100k(n=100_000, cpu_sample=4000):
             "cpu_serial_s": round(cpu_100k_s, 1),
             "cpu_sigs_per_s": round(cpu_rate),
             "attempts": attempts,
-            "speedup": round(cpu_100k_s / best, 1)}
+            "speedup": round(cpu_100k_s / best, 1), **_launch_cols(base)}
 
 
 def config7_rlc_sharded(n=8192):
@@ -332,12 +365,19 @@ def config7_rlc_sharded(n=8192):
         msm.set_enabled(prev_rlc)  # restore, don't clobber
     plane = data_plane()
     # path is only honest when outcome == "vouched": a dispatch that
-    # overflowed fell back to (and timed) the per-sig ladder
-    path = route.get("path") if route.get("outcome") == "vouched" \
-        else "per-sig"
+    # overflowed fell back to (and timed) the per-sig ladder — then the
+    # occupancy that matters is the per-sig LAUNCH's (last_launch
+    # records it), not the bounced RLC attempt's
+    if route.get("outcome") == "vouched":
+        path, nb, n_real = route.get("path"), route.get("nb"), route["n"]
+    else:
+        path = "per-sig"
+        rec = edops.last_launch()
+        nb, n_real = rec.get("nb"), rec.get("n")
     return {"config": f"7: sharded-RLC MSM ({n} sigs)",
             "wall_s": round(dt, 3), "sigs_per_s": round(n / dt),
             "path": path, "outcome": route.get("outcome"),
+            "occupancy": round(n_real / nb, 3) if nb else None,
             "shards": route.get("shards"),
             "mesh_devices": plane.nshard if plane is not None else 1}
 

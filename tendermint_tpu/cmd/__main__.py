@@ -344,6 +344,36 @@ def cmd_debug_dump(args):
     print(f"wrote debug dump to {out}")
 
 
+def cmd_debug_trace(args):
+    """Snapshot the running node's flight recorder (libs/trace.py) via
+    its pprof listener's GET /debug/trace and print (or write) the
+    Chrome-trace JSON — load the output into chrome://tracing or
+    ui.perfetto.dev to see the vote -> verify -> commit timeline."""
+    import urllib.request
+
+    addr = args.pprof_laddr
+    if not addr:
+        cfg = Config.load(_home(args))
+        cfg.home = _home(args)
+        addr = cfg.rpc.pprof_laddr
+    if not addr:
+        raise SystemExit(
+            "no pprof listener: pass --pprof-laddr or set [rpc] "
+            "pprof_laddr in config.toml (and TM_TPU_TRACE=1 or "
+            "trace.enable() on the node to record spans)")
+    url = f"http://{addr}/debug/trace?since={args.since}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        n = len(json.loads(body).get("traceEvents", []))
+        print(f"wrote {n} trace events to {out}")
+    else:
+        print(body)
+
+
 def cmd_debug_kill(args):
     """Reference cmd debug kill: take a dump, then kill the node."""
     import signal
@@ -625,6 +655,15 @@ def main(argv=None):
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_dump)
+    sp = sub.add_parser("debug-trace",
+                        help="snapshot the node's flight recorder as "
+                             "Chrome-trace JSON")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--since", type=int, default=0,
+                    help="fetch only events after this seq cursor")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_trace)
     sp = sub.add_parser("debug-kill",
                         help="collect a diagnostic tarball, then SIGTERM "
                              "the node")

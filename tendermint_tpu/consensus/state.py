@@ -26,6 +26,7 @@ import traceback
 from typing import Callable, List, Optional
 
 from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.state.execution import BlockExecutor
@@ -255,6 +256,11 @@ class ConsensusState(BaseService):
         votes = [m.vote for m, _ in batch if isinstance(m, VoteMessage)]
         if len(votes) < self.BATCH_MIN_VOTES:
             return
+        with trace.span("consensus.preverify", queued=len(batch),
+                        votes=len(votes)):
+            self._preverify_votes_locked(votes)
+
+    def _preverify_votes_locked(self, votes):
         with self._mtx:
             state = self.state
             if state is None:
@@ -812,6 +818,12 @@ class ConsensusState(BaseService):
         rs = self.rs
         if rs.height != height or rs.step != Step.COMMIT:
             return
+        with trace.span("consensus.finalize_commit", height=height,
+                        round=rs.commit_round):
+            self._finalize_commit_locked(height)
+
+    def _finalize_commit_locked(self, height: int):
+        rs = self.rs
         block_id, _ = rs.votes.precommits(rs.commit_round) \
             .two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
@@ -858,6 +870,13 @@ class ConsensusState(BaseService):
     # -- votes (reference :2003-2293) --------------------------------------
 
     def _try_add_vote(self, vote: Vote, peer_id: str):
+        # vote receipt: the causal start of the vote -> verify -> commit
+        # timeline (the serial apply after the coalesced preverify; a
+        # SigCache hit here means the batched launch already paid the
+        # signature check)
+        trace.instant("consensus.vote", height=vote.height,
+                      round=vote.round, index=vote.validator_index,
+                      peer=bool(peer_id))
         try:
             self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
@@ -1002,6 +1021,11 @@ class ConsensusState(BaseService):
         return now
 
     def _new_step(self):
+        # flight-recorder marker for every consensus step transition —
+        # the timeline's backbone: everything between two step markers
+        # belongs to the earlier step (docs/adr/adr-011)
+        trace.instant("consensus.step", step=self.rs.step.name,
+                      height=self.rs.height, round=self.rs.round)
         if self.event_bus is not None:
             self.event_bus.publish_new_round_step(
                 self.rs.height, self.rs.round, self.rs.step.name)

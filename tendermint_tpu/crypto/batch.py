@@ -23,6 +23,7 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from tendermint_tpu.libs import trace
 from . import PubKey
 from . import degrade
 from . import ed25519 as ed
@@ -150,6 +151,15 @@ class BatchVerifier:
         n = len(self._items)
         if n == 0:
             return True, np.zeros(0, dtype=bool)
+        # flight-recorder root of the coalesce window: the lane spans
+        # (device.launch on the worker, device.collect, verdict
+        # application) all link under this span, so an exported trace
+        # shows where one batch spent its time and which route it took
+        with trace.span("batch.verify", n=n,
+                        threshold=self.tpu_threshold) as sp:
+            return self._verify(n, sp)
+
+    def _verify(self, n: int, sp) -> Tuple[bool, np.ndarray]:
         out = np.zeros(n, dtype=bool)
         # dispatch per key scheme; the device (ed25519) lane runs in a
         # worker thread OVERLAPPED with the host C lanes — the tunnel
@@ -184,9 +194,17 @@ class BatchVerifier:
                 rt.metrics.host_fallbacks.inc(site=f"batch.{tname}",
                                               reason="breaker_open")
             host_lanes.append((tname, idxs, items))
+        if trace.is_enabled():
+            sp.add(schemes=",".join(f"{t}:{len(ix)}"
+                                    for t, ix in by_type.items()),
+                   device_lanes=len(device_lanes),
+                   host_lanes=len(host_lanes),
+                   device_eligible=rt is not None)
         try:
             for tname, idxs, items in host_lanes:
-                out[np.asarray(idxs)] = _host_verify_items(tname, items)
+                with trace.span("batch.host_lane", scheme=tname,
+                                n=len(items)):
+                    out[np.asarray(idxs)] = _host_verify_items(tname, items)
         finally:
             # always settle EVERY device lane: a host-lane exception must
             # not abandon an in-flight device RPC or leave the breaker's
@@ -200,9 +218,12 @@ class BatchVerifier:
                     host_fn=partial(_host_verify_items, tname, items),
                     spot_check=_spot_check_items(items))
         # remember the valid ones so later serial re-checks are cache hits
-        for i, it in enumerate(self._items):
-            if out[i]:
-                verified_sigs.add(it.pub.bytes(), it.msg, it.sig)
+        with trace.span("batch.verdict") as vsp:
+            for i, it in enumerate(self._items):
+                if out[i]:
+                    verified_sigs.add(it.pub.bytes(), it.msg, it.sig)
+            if trace.is_enabled():
+                vsp.add(valid=int(out.sum()), n=n)
         return bool(out.all()), out
 
 

@@ -44,6 +44,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from tendermint_tpu.libs import fail
+from tendermint_tpu.libs import trace
 
 # breaker states (rendered into the tendermint_crypto_breaker_state
 # gauge as 0 / 0.5 / 1)
@@ -141,6 +142,8 @@ class CircuitBreaker:
         if self._metrics is not None:
             self._metrics.breaker_state.set(_STATE_GAUGE[new])
             self._metrics.breaker_transitions.inc(to=new)
+        trace.instant("breaker.transition", to=new, reason=reason,
+                      **{"from": old})
         listeners = list(self._listeners)
         return lambda: [fn(old, new, reason) for fn in listeners]
 
@@ -285,10 +288,15 @@ class DeviceLaneRuntime:
         dispatch failure comes back as a failed future), so an acquired
         breaker grant can always be settled."""
         self.metrics.device_launches.inc(site=site)
+        # the launch runs on the lane worker thread: capture the caller's
+        # span id HERE so the worker's span links into the caller's tree
+        # (the thread-local stack doesn't cross the pool boundary)
+        parent = trace.current_id()
 
         def _launch():
-            fail.inject(site)
-            return fn(*args)
+            with trace.span("device.launch", parent=parent, site=site):
+                fail.inject(site)
+                return fn(*args)
         try:
             return self._get_pool().submit(_launch)
         except Exception as e:  # noqa: BLE001 - e.g. pool at shutdown
@@ -303,43 +311,49 @@ class DeviceLaneRuntime:
         """Settle a launch: bounded wait, integrity check, breaker
         bookkeeping — and on ANY device failure re-verify the batch
         through host_fn so the caller's bitmap is exact regardless."""
-        t0 = self._clock()
-        reason = None
-        try:
-            out = fut.result(timeout=self.cfg.launch_timeout_s)
-            out = fail.corrupt_bitmap(site, out)
-            if spot_check is not None and self.cfg.spot_check \
-                    and not spot_check(np.asarray(out)):
-                raise DeviceLaneError(
-                    f"{site}: device bitmap disagrees with host spot check")
-        except (_cf.TimeoutError, TimeoutError):
-            # on 3.11+ futures.TimeoutError IS builtin TimeoutError, so a
-            # TimeoutError raised by the device fn itself (e.g. a socket
-            # timeout on the tunnel) lands here too: only a future that
-            # is genuinely still running means the WAIT timed out and the
-            # worker may be wedged — anything else is a device raise
-            if fut.done():
-                reason = "raise"
-            else:
-                reason = "timeout"
-                self._quarantine_pool()
-                fut.cancel()
-        except Exception as e:  # noqa: BLE001 - any fault degrades
-            reason = "integrity" if isinstance(e, DeviceLaneError) \
-                else "raise"
-        if reason is None:
-            self.metrics.device_launch_seconds.observe(
-                self._clock() - t0, site=site)
-            self.breaker.record_success()
-            return np.asarray(out)
-        self.metrics.device_failures.inc(site=site, reason=reason)
-        self.breaker.record_failure(f"{site}: {reason}")
-        return self.host_fallback(site, reason, host_fn)
+        with trace.span("device.collect", site=site) as sp:
+            t0 = self._clock()
+            reason = None
+            try:
+                out = fut.result(timeout=self.cfg.launch_timeout_s)
+                out = fail.corrupt_bitmap(site, out)
+                if spot_check is not None and self.cfg.spot_check \
+                        and not spot_check(np.asarray(out)):
+                    raise DeviceLaneError(
+                        f"{site}: device bitmap disagrees with host "
+                        f"spot check")
+            except (_cf.TimeoutError, TimeoutError):
+                # on 3.11+ futures.TimeoutError IS builtin TimeoutError,
+                # so a TimeoutError raised by the device fn itself (e.g.
+                # a socket timeout on the tunnel) lands here too: only a
+                # future that is genuinely still running means the WAIT
+                # timed out and the worker may be wedged — anything else
+                # is a device raise
+                if fut.done():
+                    reason = "raise"
+                else:
+                    reason = "timeout"
+                    self._quarantine_pool()
+                    fut.cancel()
+            except Exception as e:  # noqa: BLE001 - any fault degrades
+                reason = "integrity" if isinstance(e, DeviceLaneError) \
+                    else "raise"
+            if reason is None:
+                self.metrics.device_launch_seconds.observe(
+                    self._clock() - t0, site=site)
+                self.breaker.record_success()
+                sp.add(outcome="ok")
+                return np.asarray(out)
+            self.metrics.device_failures.inc(site=site, reason=reason)
+            self.breaker.record_failure(f"{site}: {reason}")
+            sp.add(outcome=reason)
+            return self.host_fallback(site, reason, host_fn)
 
     def host_fallback(self, site: str, reason: str,
                       host_fn: Callable[[], np.ndarray]) -> np.ndarray:
         self.metrics.host_fallbacks.inc(site=site, reason=reason)
-        return host_fn()
+        with trace.span("device.host_fallback", site=site, reason=reason):
+            return host_fn()
 
     def run(self, site: str, device_fn: Callable[[], np.ndarray],
             host_fn: Callable[[], np.ndarray],
@@ -388,3 +402,21 @@ def reset():
     global _runtime
     with _runtime_lock:
         _runtime = None
+
+
+def publish_route(path, outcome, n=None, nb=None, compile_s=None):
+    """The ONE bridge from a dispatch-route decision (ops/ed25519
+    _record_launch, ops/msm _set_route) into CryptoMetrics: route
+    counter at set time (labeled by outcome, so a bounced RLC attempt
+    is never mistaken for the fast path engaging), lane occupancy, and
+    the first-launch compile split.  Swallows everything —
+    observability must never break verification."""
+    try:
+        m = runtime().metrics
+        m.msm_route.inc(path=str(path), outcome=str(outcome))
+        if nb and n is not None:  # never fabricate a perfect ratio
+            m.batch_occupancy.set(n / nb)
+        if compile_s is not None:
+            m.device_compile_seconds.observe(compile_s, site=str(path))
+    except Exception:  # noqa: BLE001 - metrics are best-effort here
+        pass

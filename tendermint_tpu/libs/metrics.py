@@ -15,6 +15,20 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or a value like `ch="0x20"` (or a
+    reason string carrying a traceback line) corrupts the whole
+    exposition for every scraper."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """# HELP lines escape backslash and newline (not quotes)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
         self.name = name
@@ -28,7 +42,8 @@ class _Metric:
         return tuple(labels[k] for k in self.label_names)
 
     def _fmt_labels(self, key: Tuple[str, ...], extra: str = "") -> str:
-        pairs = [f'{n}="{v}"' for n, v in zip(self.label_names, key)]
+        pairs = [f'{n}="{_escape_label_value(v)}"'
+                 for n, v in zip(self.label_names, key)]
         if extra:
             pairs.append(extra)
         return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -180,7 +195,7 @@ class Registry:
             metrics = sorted(self._metrics.items())
         for name, m in metrics:
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
@@ -274,6 +289,29 @@ class CryptoMetrics:
             "crypto", "device_launch_seconds",
             "Wall-clock of successful device verify launches.",
             labels=("site",), buckets=exp_buckets(0.001, 4, 10))
+        # promoted from ad-hoc module globals (ops/msm.last_route and
+        # friends) so /metrics alone answers "did the sharded RLC path
+        # actually engage in production" without polling test hooks
+        self.msm_route = reg.counter(
+            "crypto", "msm_route_total",
+            "Verify dispatch routes taken, by path "
+            "(rlc-sharded/rlc-single/mesh-sharded/pallas/xla/...) and "
+            "outcome — only outcome=\"vouched\" means an RLC route "
+            "actually stood in for per-signature verification; "
+            "overflow/decode-failed/rejected bounced to the per-sig "
+            "ladder, and plain kernel launches count as "
+            "outcome=\"executed\".",
+            labels=("path", "outcome"))
+        self.batch_occupancy = reg.gauge(
+            "crypto", "batch_occupancy_ratio",
+            "Real rows / padded device lanes of the most recent "
+            "device batch (pad lanes are pure overhead).")
+        self.device_compile_seconds = reg.histogram(
+            "crypto", "device_compile_seconds",
+            "Wall-clock of FIRST launches per (path, lane bucket) — "
+            "dominated by jit compile; steady-state launches land in "
+            "crypto_device_launch_seconds instead.",
+            labels=("site",), buckets=exp_buckets(0.01, 4, 10))
 
 
 class P2PMetrics:

@@ -13,6 +13,10 @@ image) and with near-zero overhead when idle:
                                "folded" stacks — feed straight into any
                                flamegraph tool
   GET /debug/gc                gc generation counts + uncollectable total
+  GET /debug/trace?since=<seq> flight-recorder snapshot (libs/trace.py)
+                               as Chrome-trace / Perfetto JSON; `since`
+                               fetches incrementally from a previous
+                               response's last_seq cursor
 
 SIGUSR1 installs the same stack dump onto the process logger, so a hung
 node can be inspected with plain `kill -USR1` even when the HTTP
@@ -25,6 +29,7 @@ Wired by node.py when `[rpc] pprof_laddr` is set in config.toml.
 from __future__ import annotations
 
 import gc
+import json
 import signal
 import sys
 import threading
@@ -101,10 +106,11 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route http.server noise to tmlog
         _logger.debug("pprof http", line=fmt % args)
 
-    def _send(self, code: int, body: str):
+    def _send(self, code: int, body: str,
+              ctype: str = "text/plain; charset=utf-8"):
         data = body.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -132,10 +138,19 @@ class _Handler(BaseHTTPRequestHandler):
                                 f"{len(gc.garbage)}\n"
                                 f"tracked objects: "
                                 f"{len(gc.get_objects())}\n")
+            elif url.path == "/debug/trace":
+                from tendermint_tpu.libs import trace
+                q = parse_qs(url.query)
+                since = int(q.get("since", ["0"])[0])
+                # default=str: span attrs are arbitrary values; an odd
+                # one must never make the debug surface 500
+                self._send(200, json.dumps(trace.chrome_trace(since),
+                                           default=str),
+                           ctype="application/json")
             else:
                 self._send(404, "pprof routes: /debug/stacks "
                                 "/debug/threads /debug/profile?seconds=N "
-                                "/debug/gc\n")
+                                "/debug/gc /debug/trace?since=N\n")
         except Exception as e:  # noqa: BLE001 - debug surface never fatal
             self._send(500, f"error: {e}\n")
 

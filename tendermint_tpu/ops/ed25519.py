@@ -26,12 +26,15 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from functools import partial
+from types import MappingProxyType
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tendermint_tpu.libs import trace
 from . import field as F
 from . import curve as C
 
@@ -431,6 +434,58 @@ def _use_pallas() -> bool:
 MIN_BUCKET = 64
 
 
+# ---------------------------------------------------------------------------
+# launch observability: every device dispatch (this module AND the mesh
+# plane in parallel/sharding.py) funnels through _record_launch, which
+# publishes route + lane occupancy + the first-launch compile split into
+# CryptoMetrics and onto the enclosing trace span.  The first launch of
+# a (path, lane-bucket) pair in a process pays the jit/Mosaic compile —
+# tens of seconds on a cold cache — while steady-state launches are
+# milliseconds; conflating them is how round 5's perf numbers went
+# unmeasured, so the split is recorded explicitly.
+# ---------------------------------------------------------------------------
+
+_launch_lock = threading.Lock()
+_seen_buckets: set = set()
+_last_launch = MappingProxyType({"path": None})
+
+
+def last_launch():
+    """Immutable snapshot of the most recent device-launch record:
+    path / n / nb (padded lanes) / occupancy / shards / first_launch /
+    wall_s.  Aggregate history lives in crypto_msm_route_total and
+    crypto_device_compile_seconds on /metrics."""
+    with _launch_lock:
+        return _last_launch
+
+
+def _set_last_launch(rec: dict):
+    """Publish a fresh immutable launch snapshot (ops/msm routes call
+    this too, so last_launch() covers the RLC fast path — a bench row
+    must never claim the device was idle when RLC vouched)."""
+    global _last_launch
+    with _launch_lock:
+        _last_launch = MappingProxyType(dict(rec))
+
+
+def _record_launch(path: str, n: int, nb: int, wall_s: float,
+                   shards: int = 1):
+    occupancy = n / nb if nb else 1.0
+    key = (path, nb, shards)
+    with _launch_lock:
+        first = key not in _seen_buckets
+        _seen_buckets.add(key)
+    _set_last_launch({
+        "path": path, "n": n, "nb": nb, "occupancy": occupancy,
+        "shards": shards, "first_launch": first, "wall_s": wall_s})
+    from tendermint_tpu.crypto import degrade
+    degrade.publish_route(path, "executed", n=n, nb=nb,
+                          compile_s=wall_s if first else None)
+    trace.current().add(path=path, n=n, nb=nb,
+                        occupancy=round(occupancy, 4), shards=shards,
+                        first_launch=first)
+
+
 def bucket_size(n: int) -> int:
     """Round a batch size up to the next power of two (>= MIN_BUCKET) so the
     jitted kernel sees few distinct shapes (one compile per bucket)."""
@@ -592,12 +647,6 @@ def split_chunked_launch(pubkeys, msgs, sigs):
     return outs, host_ok[:n], n
 
 
-def _verify_split_chunked(pubkeys, msgs, sigs) -> np.ndarray:
-    outs, host_ok, n = split_chunked_launch(pubkeys, msgs, sigs)
-    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-    return np.asarray(out)[:n] & host_ok
-
-
 def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     """End-to-end batched verify (host staging + device kernel).
     Returns a (B,) bool validity bitmap.
@@ -623,43 +672,56 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
 
     from . import msm
 
-    # the mesh data plane is consulted FIRST, and the RLC fast path
-    # dispatches THROUGH it: on a multi-chip host the Pippenger bucket
-    # accumulation runs as per-shard partial MSMs with an on-mesh
-    # reduction (parallel/sharding.msm_window_sums), so the
-    # highest-throughput verifier uses every local chip instead of
-    # leaving N-1 idle.  RLC-ineligible batches (non-canonical
-    # encodings, failed combination, MSM shapes the plane policy
-    # declines) fall through to the sharded per-signature ladder for
-    # check-all attribution (docs/adr/009).
-    plane = data_plane()
-    if msm.use_rlc(len(pubkeys)):
-        if msm.verify_batch_rlc(pubkeys, msgs, sigs, plane=plane):
-            return np.ones(len(pubkeys), dtype=bool)
-    if plane is not None and plane.worth_sharding(len(pubkeys)):
-        return plane.verify_batch(pubkeys, msgs, sigs)
-    if _use_pallas():
-        from . import pallas_ed25519 as pe
-        if cache_pubs and len(pubkeys) >= PUB_CACHE_MIN:
-            return _verify_split_chunked(pubkeys, msgs, sigs)
-        packed, host_ok = prepare_batch_packed(pubkeys, sigs, msgs)
-        n = host_ok.shape[0]
-        nb = max(PALLAS_TILE, bucket_size(n))
-        if nb != n:  # pad the trailing (lane) axis
-            packed = np.pad(packed, [(0, 0), (0, nb - n)])
-        if nb > MAX_CHUNK:
-            # huge batches (100k-validator VerifyCommit) run as MAX_CHUNK
-            # sub-batches with transfer/compute pipelining — same lane
-            # buckets the headline path uses, and the tunnel DMA of chunk
-            # j+1 overlaps the kernel of chunk j
-            outs = verify_packed_pipelined(packed, nsub=nb // MAX_CHUNK)
-            out = jnp.concatenate(outs)
+    with trace.span("ops.ed25519.verify_batch", n=len(pubkeys)) as sp:
+        # the mesh data plane is consulted FIRST, and the RLC fast path
+        # dispatches THROUGH it: on a multi-chip host the Pippenger
+        # bucket accumulation runs as per-shard partial MSMs with an
+        # on-mesh reduction (parallel/sharding.msm_window_sums), so the
+        # highest-throughput verifier uses every local chip instead of
+        # leaving N-1 idle.  RLC-ineligible batches (non-canonical
+        # encodings, failed combination, MSM shapes the plane policy
+        # declines) fall through to the sharded per-signature ladder for
+        # check-all attribution (docs/adr/009).
+        plane = data_plane()
+        if msm.use_rlc(len(pubkeys)):
+            if msm.verify_batch_rlc(pubkeys, msgs, sigs, plane=plane):
+                return np.ones(len(pubkeys), dtype=bool)
+            sp.add(rlc_fallback=True)
+        if plane is not None and plane.worth_sharding(len(pubkeys)):
+            return plane.verify_batch(pubkeys, msgs, sigs)
+        t0 = time.perf_counter()
+        if _use_pallas():
+            from . import pallas_ed25519 as pe
+            if cache_pubs and len(pubkeys) >= PUB_CACHE_MIN:
+                outs, host_ok, n = split_chunked_launch(pubkeys, msgs, sigs)
+                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+                path = "pallas-split"
+            else:
+                packed, host_ok = prepare_batch_packed(pubkeys, sigs, msgs)
+                n = host_ok.shape[0]
+                nb = max(PALLAS_TILE, bucket_size(n))
+                if nb != n:  # pad the trailing (lane) axis
+                    packed = np.pad(packed, [(0, 0), (0, nb - n)])
+                if nb > MAX_CHUNK:
+                    # huge batches (100k-validator VerifyCommit) run as
+                    # MAX_CHUNK sub-batches with transfer/compute
+                    # pipelining — same lane buckets the headline path
+                    # uses, and the tunnel DMA of chunk j+1 overlaps the
+                    # kernel of chunk j
+                    outs = verify_packed_pipelined(packed,
+                                                   nsub=nb // MAX_CHUNK)
+                    out = jnp.concatenate(outs)
+                else:
+                    out = pe.verify_packed_pallas(jnp.asarray(packed),
+                                                  tile=min(PALLAS_TILE, nb))
+                path = "pallas"
         else:
-            out = pe.verify_packed_pallas(jnp.asarray(packed),
-                                          tile=min(PALLAS_TILE, nb))
-    else:
-        dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
-        n = host_ok.shape[0]
-        dev = _pad_dev(dev, n, bucket_size(n))
-        out = verify_kernel(**{k: jnp.asarray(v) for k, v in dev.items()})
-    return np.asarray(out)[:n] & host_ok
+            dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
+            n = host_ok.shape[0]
+            dev = _pad_dev(dev, n, bucket_size(n))
+            out = verify_kernel(
+                **{k: jnp.asarray(v) for k, v in dev.items()})
+            path = "xla"
+        res = np.asarray(out)  # blocks: wall below includes execution
+        _record_launch(path, n, res.shape[0], time.perf_counter() - t0)
+        return res[:n] & host_ok

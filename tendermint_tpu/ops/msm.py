@@ -58,11 +58,14 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 from functools import partial
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from tendermint_tpu.libs import trace
 
 from . import curve as C
 from . import ed25519 as ed
@@ -530,15 +533,52 @@ def _pad_rows(r_bytes, pub_m, zk, z, nb: int):
 
 # route taken by the most recent verify_batch_rlc call — observability
 # for dryrun_multichip (which must report which path a MULTICHIP capture
-# actually exercised) and for routing tests; not consensus state.
-# Published as ONE reference assignment per call (atomic under the GIL):
-# concurrent verifier threads each replace the whole dict, so a reader
-# never sees the path of one call with the outcome of another.
-_last_route: dict = {"path": None}
+# actually exercised) and for routing tests; not consensus state.  The
+# seed relied on "one reference assignment is atomic under the GIL",
+# which held for the swap but NOT for callers that read the dict while
+# another thread built its replacement from partial state, and it left
+# route history unobservable (a poller only ever sees the last call).
+# Now: writes go through _set_route under a lock, readers get an
+# immutable snapshot, and every set increments the
+# crypto_msm_route_total{path=} counter so /metrics carries the full
+# route history without polling.
+from types import MappingProxyType
+
+_route_lock = threading.Lock()
+_last_route = MappingProxyType({"path": None})
 
 
-def last_route() -> dict:
-    return dict(_last_route)
+def last_route():
+    """Immutable snapshot of the most recent route decision (a
+    MappingProxyType — read it, don't mutate it).  For aggregate route
+    history use the crypto_msm_route_total counter instead."""
+    with _route_lock:
+        return _last_route
+
+
+def _set_route(route: dict):
+    """Publish a route decision: swap the snapshot under the lock and
+    count it into CryptoMetrics at set time (ISSUE 3 satellite — callers
+    no longer need to poll last_route to learn which path ran)."""
+    global _last_route
+    snap = MappingProxyType(dict(route))
+    with _route_lock:
+        _last_route = snap
+    from tendermint_tpu.crypto import degrade
+    degrade.publish_route(route.get("path"), route.get("outcome"),
+                          n=route.get("n"), nb=route.get("nb"))
+    nb = route.get("nb")
+    if nb:  # an MSM actually launched (ineligible batches never do):
+        # mirror it into the launch record so last_launch() and the
+        # bench route/occupancy columns cover the RLC fast path too
+        ed._set_last_launch({
+            "path": route["path"], "n": route["n"], "nb": nb,
+            "occupancy": route["n"] / nb,
+            "shards": route.get("shards", 1),
+            "outcome": route.get("outcome")})
+    trace.instant("msm.route", **route)
+    cur = trace.current()
+    cur.add(path=route.get("path"), outcome=route.get("outcome"))
 
 
 def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
@@ -557,16 +597,14 @@ def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
     combined group element, and the RLC scalars are staged once on the
     host in row order, so the sharded verdict is identical to the
     single-device one."""
-    global _last_route
-
     pub_m = ed._to_u8_matrix(pubkeys, 32)
     n = pub_m.shape[0]
     if n == 0:
         return True
     staged = _stage_rlc(pub_m, msgs, sigs, z=z)
     if staged is None:
-        _last_route = {"path": "rlc-ineligible", "n": n, "shards": 0,
-                       "outcome": "ineligible"}
+        _set_route({"path": "rlc-ineligible", "n": n, "shards": 0,
+                    "outcome": "ineligible"})
         return False
     r_bytes, zk, z, zs = staged
     use_pallas = ed._use_pallas()
@@ -576,8 +614,8 @@ def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
         r_bytes, pub_m, zk, z = _pad_rows(r_bytes, pub_m, zk, z, nb)
         ws, ok_all, overflow = plane.msm_window_sums(
             r_bytes, pub_m, zk, z, zs, c, use_pallas=use_pallas)
-        route = {"path": "rlc-sharded", "n": n, "shards": plane.nshard,
-                 "c": c}
+        route = {"path": "rlc-sharded", "n": n, "nb": nb,
+                 "shards": plane.nshard, "c": c}
     else:
         nb = ed.bucket_size(n)
         c = _pick_c(nb)
@@ -585,18 +623,19 @@ def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
         ws, ok_all, overflow = _msm_core(
             jnp.asarray(r_bytes), jnp.asarray(pub_m), jnp.asarray(zk),
             jnp.asarray(z), jnp.asarray(zs), c, use_pallas=use_pallas)
-        route = {"path": "rlc-single", "n": n, "shards": 1, "c": c}
+        route = {"path": "rlc-single", "n": n, "nb": nb, "shards": 1,
+                 "c": c}
     # the route's OUTCOME distinguishes "the fast path vouched" from
     # "the fast path was attempted but the caller fell back to per-sig"
     # — consumers (dryrun_multichip, bench) must check it, or an
     # overflow/decode bounce would be reported as the fast path
     if not bool(ok_all) or bool(overflow):
         route["outcome"] = "overflow" if bool(overflow) else "decode-failed"
-        _last_route = route
+        _set_route(route)
         return False
     vouched = _combine_windows_host(np.asarray(ws), c)
     route["outcome"] = "vouched" if vouched else "rejected"
-    _last_route = route
+    _set_route(route)
     if vouched:
         # audit line for mixed Go/TPU fleets: the cofactored check stood
         # in for n exact cofactorless verifies — if a chain split is ever

@@ -17,6 +17,7 @@ every local chip instead of leaving N-1 idle.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -243,6 +244,7 @@ class _DataPlane:
         from tendermint_tpu.ops import ed25519 as edops
 
         if edops._use_pallas():
+            t0 = time.perf_counter()
             packed, host_ok = edops.prepare_batch_packed(pubkeys, sigs, msgs)
             n = host_ok.shape[0]
             unit = self.nshard * edops.PALLAS_TILE
@@ -274,8 +276,12 @@ class _DataPlane:
         else:
             dev, host_ok = edops.prepare_batch(pubkeys, sigs, msgs)
             n = host_ok.shape[0]
-            return self._compact()(dev, bucket=True) & host_ok
-        return np.asarray(out)[:n] & host_ok
+            return self._compact()(dev, bucket=True,
+                                   shards=self.nshard) & host_ok
+        res = np.asarray(out)
+        edops._record_launch("mesh-pallas", n, nb,
+                             time.perf_counter() - t0, shards=self.nshard)
+        return res[:n] & host_ok
 
 
 def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
@@ -298,10 +304,11 @@ def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
         out_shardings=(batch_sharded, NamedSharding(mesh, P())),
     )
 
-    def run(dev_arrays: dict, bucket: bool = False):
+    def run(dev_arrays: dict, bucket: bool = False, shards: int = 0):
         """bucket=True rounds the padded size up to a power-of-two bucket
         (ops/ed25519.bucket_size) so long-lived processes compile one
         sharded kernel per bucket instead of one per batch size."""
+        t0 = time.perf_counter()
         n = dev_arrays["pub"].shape[0]
         nshard = mesh.devices.size
         base = edops.bucket_size(n) if bucket else n
@@ -310,6 +317,10 @@ def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
         bitmap, _ = jitted(padded["pub"], padded["r"],
                            padded["s_digits"], padded["k_digits"])
         import numpy as np
-        return np.asarray(bitmap)[:n]
+        res = np.asarray(bitmap)
+        edops._record_launch("mesh-sharded", n, nb,
+                             time.perf_counter() - t0,
+                             shards=shards or int(nshard))
+        return res[:n]
 
     return jitted, run

@@ -15,6 +15,7 @@ from typing import List, Optional, Tuple
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import merkle, tmhash
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.types.basic import BlockID, Timestamp
 from tendermint_tpu.types.block import Block
@@ -176,8 +177,17 @@ class BlockExecutor:
 
     def apply_block(self, state: State, block_id: BlockID,
                     block: Block) -> Tuple[State, ABCIResponses]:
+        with trace.span("state.apply_block",
+                        height=block.header.height,
+                        txs=len(block.data.txs)):
+            return self._apply_block(state, block_id, block)
+
+    def _apply_block(self, state: State, block_id: BlockID,
+                     block: Block) -> Tuple[State, ABCIResponses]:
         _t0 = time.perf_counter()
-        self.validate_block(state, block)
+        with trace.span("state.validate_block",
+                        height=block.header.height):
+            self.validate_block(state, block)
 
         responses = self._exec_block_on_app(state, block)
         fail_point(1)

@@ -1,9 +1,16 @@
 """Metrics registry + node integration (reference consensus/metrics.go,
-libs go-kit/prometheus, node/node.go:959-962 prometheus listener)."""
+libs go-kit/prometheus, node/node.go:959-962 prometheus listener), the
+Prometheus text-format conformance of the real GET /metrics output, and
+the metricsgen docs/lint gates (reference scripts/metricsgen)."""
+import importlib.util
+import os
+import re
 import urllib.request
 
 from tendermint_tpu.libs.metrics import (Counter, Gauge, Histogram,
                                          Registry, exp_buckets)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_counter_gauge_histogram_render():
@@ -48,6 +55,157 @@ def test_registry_reuse_is_idempotent():
 def test_exp_buckets():
     b = exp_buckets(0.1, 10, 4)
     assert b == [0.1, 1.0, 10.0, 100.0]
+
+
+# ---------------------------------------------------------------------------
+# text-format escaping + scrape-and-parse conformance (ISSUE 3 satellite:
+# a label value carrying ", \ or a newline used to corrupt the whole
+# exposition — e.g. a degrade fallback reason built from an exception)
+# ---------------------------------------------------------------------------
+
+NASTY = 'quote " backslash \\ newline \n tab\tend'
+
+# one full sample line: name, optional {labels}, value
+_SAMPLE = re.compile(
+    r'^([a-z_:][a-z0-9_:]*)(?:\{(.*)\})? (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?'
+    r'|Inf)|NaN|[+-]Inf)$')
+# one label pair inside the braces; values may contain escaped chars
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _parse_exposition(text: str):
+    """Strict line-by-line parse of the Prometheus text format; raises
+    AssertionError on any malformed line.  Returns
+    {(name, (label pairs...)): value}."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-z_:][a-z0-9_:]*( .*)?$",
+                            ln), f"malformed comment line: {ln!r}"
+            continue
+        m = _SAMPLE.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        name, blob, value = m.groups()
+        pairs = []
+        if blob is not None:
+            # the label blob must be exactly comma-joined label pairs —
+            # any unescaped quote/newline breaks this reconstruction
+            matches = list(_LABEL.finditer(blob))
+            rebuilt = ",".join(mm.group(0) for mm in matches)
+            assert rebuilt == blob, f"malformed label blob: {blob!r}"
+            pairs = [(mm.group(1), _unescape(mm.group(2)))
+                     for mm in matches]
+        out[(name, tuple(pairs))] = float(value)
+    return out
+
+
+def test_label_value_escaping_unit():
+    reg = Registry("tm_esc")
+    c = reg.counter("x", "weird_total", "Help with \\ and\nnewline.",
+                    labels=("v",))
+    c.inc(3, v=NASTY)
+    text = reg.render_text()
+    # no raw newline may survive inside any sample line
+    for ln in text.splitlines():
+        assert "\n" not in ln
+    parsed = _parse_exposition(text)
+    key = ("tm_esc_x_weird_total", (("v", NASTY),))
+    assert parsed[key] == 3.0
+    # HELP line escapes backslash + newline per the spec
+    assert "# HELP tm_esc_x_weird_total Help with \\\\ and\\nnewline." \
+        in text.splitlines()
+
+
+def test_metrics_endpoint_scrape_and_parse_conformance():
+    """Register nasty label values into the DEFAULT registry, scrape the
+    REAL GET /metrics route (rpc/server.py renders DEFAULT), and strict-
+    parse the whole exposition — the corruption the seed had would fail
+    the blob reconstruction."""
+    from tendermint_tpu.libs.metrics import DEFAULT
+    from tendermint_tpu.rpc.server import RPCServer
+
+    c = DEFAULT.counter("conformance", "nasty_total",
+                        "Scrape conformance probe.", labels=("v",))
+    c.inc(7, v=NASTY)
+    h = DEFAULT.histogram("conformance", "nasty_seconds",
+                          "Histogram with labeled series.",
+                          labels=("site",), buckets=[0.1, 1])
+    h.observe(0.5, site='weird "site"\n')
+
+    class _StubNode:  # /metrics never touches the node
+        config = None
+
+    srv = RPCServer(_StubNode(), "127.0.0.1:0")
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+    finally:
+        srv.stop()
+        # drop the probes: DEFAULT is process-global and later tests
+        # must not see conformance leftovers on /metrics
+        with DEFAULT._lock:
+            DEFAULT._metrics.pop(c.name, None)
+            DEFAULT._metrics.pop(h.name, None)
+    parsed = _parse_exposition(body)
+    assert parsed[("tendermint_conformance_nasty_total",
+                   (("v", NASTY),))] == 7.0
+    assert parsed[("tendermint_conformance_nasty_seconds_bucket",
+                   (("site", 'weird "site"\n'), ("le", "1")))] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# metricsgen parity: docs/metrics.md regenerates cleanly + metrics lint
+# (the Go reference catches these classes at compile time)
+# ---------------------------------------------------------------------------
+
+def _metricsgen():
+    spec = importlib.util.spec_from_file_location(
+        "metricsgen", os.path.join(_ROOT, "scripts", "metricsgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metricsgen_docs_not_stale():
+    """docs/metrics.md must match what scripts/metricsgen.py generates
+    from the registered bundles — regenerate and commit when this
+    fails."""
+    mg = _metricsgen()
+    with open(os.path.join(_ROOT, "docs", "metrics.md")) as f:
+        current = f.read()
+    assert current == mg.generate(), (
+        "docs/metrics.md is stale; run: python scripts/metricsgen.py")
+
+
+def test_metrics_lint():
+    """Every registered metric name is legal, every histogram declares
+    sorted buckets, and no two bundles register colliding names."""
+    mg = _metricsgen()
+    name_re = re.compile(r"[a-z_:][a-z0-9_:]*$")
+    owner = {}
+    for title, cls in mg.BUNDLES:
+        for name, m in mg.bundle_metrics(cls):
+            assert name_re.fullmatch(name), (title, name)
+            for ln in m.label_names:
+                assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", ln), (
+                    name, ln)
+            assert m.help, f"{name}: missing help text"
+            if isinstance(m, Histogram):
+                assert m.buckets, f"{name}: histogram without buckets"
+                assert m.buckets == sorted(m.buckets), name
+                assert len(set(m.buckets)) == len(m.buckets), name
+            prev = owner.setdefault(name, cls.__name__)
+            assert prev == cls.__name__, (
+                f"{name} registered by both {prev} and {cls.__name__}")
 
 
 def test_node_records_and_serves_metrics():
