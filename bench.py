@@ -187,6 +187,91 @@ def _rlc_device_bench(cpu_rate, t_start):
         msm.set_enabled(prev_rlc)  # restore, don't clobber
 
 
+def _sched_main():
+    """Scheduler config (BENCH_SCHED=1, bench_report config8): many
+    concurrent consumers, each holding a small fragmented batch —
+    pipelined through the VerifyScheduler's coalescing window versus
+    the per-consumer synchronous BatchVerifier loop the node used to
+    run.  One JSON line; without an accelerator both paths verify on
+    the host (rc=0, explicit note) and the number measures coalescing
+    plus the stage/execute overlap alone."""
+    import threading
+
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto import scheduler as vsched
+    from tendermint_tpu.ops import ed25519 as edops
+
+    n_subs = int(os.environ.get("BENCH_SCHED_SUBS", "16"))
+    per_sub = int(os.environ.get("BENCH_SCHED_N", "64"))
+    pubs, msgs, sigs = _make_batch_selfhosted(n_subs * per_sub)
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    keys = [edkeys.PubKey(p) for p in pubs]
+    subs = [[(keys[i], msgs[i], sigs[i])
+             for i in range(k * per_sub, (k + 1) * per_sub)]
+            for k in range(n_subs)]
+
+    import jax
+    device = jax.default_backend() != "cpu"
+
+    # sync baseline: each consumer verifies its own fragment serially
+    # (fresh caches so neither path gets free SigCache hits)
+    cbatch.verified_sigs = cbatch.SigCache()
+    t0 = time.perf_counter()
+    for sub in subs:
+        bv = cbatch.BatchVerifier()
+        for pub, m, s in sub:
+            bv.add(pub, m, s)
+        ok, _ = bv.verify()
+        assert ok
+    sync_s = time.perf_counter() - t0
+
+    # pipelined: all consumers submit concurrently, the scheduler
+    # coalesces them into shared launches
+    cbatch.verified_sigs = cbatch.SigCache()
+    sched = vsched.install(vsched.VerifyScheduler(window_s=0.002))
+    sched.start()
+    try:
+        futs = [None] * n_subs
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=lambda k=k: futs.__setitem__(
+                k, sched.submit(subs[k], vsched.Priority.BLOCKSYNC)))
+            for k in range(n_subs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            assert f.result(timeout=600).all()
+        piped_s = time.perf_counter() - t0
+        st = sched.stats()
+    finally:
+        sched.stop()
+        vsched.uninstall(sched)
+
+    n = n_subs * per_sub
+    rec = edops.last_launch()
+    line = {
+        "metric": "ed25519_sched_pipelined_vs_sync",
+        "value": round(n / piped_s, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(sync_s / piped_s, 2),
+        "sync_sigs_per_s": round(n / sync_s, 1),
+        "coalesce_mean_batch": round(st["mean_batch"], 1),
+        "launches": st["launches"],
+        "overlap_ratio": round(st["overlap_ratio"], 3),
+        "occupancy": rec.get("occupancy"),
+        "trace": _trace_artifact("sched"),
+    }
+    if not device:
+        line["note"] = "device unavailable, host fallback"
+    print(json.dumps(line))
+    brief = {k: st[k] for k in ("launches", "lanes", "dedup", "cache_hits")}
+    print(f"# sched bench: subs={n_subs} per_sub={per_sub} "
+          f"sync_s={sync_s:.2f} piped_s={piped_s:.2f} stats={brief}",
+          file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
@@ -195,6 +280,9 @@ def main():
     trace.enable(capacity=1 << 15)
     if os.environ.get("BENCH_RLC") == "1":
         _rlc_main()
+        return
+    if os.environ.get("BENCH_SCHED") == "1":
+        _sched_main()
         return
     t_start = time.time()
     pubs, msgs, sigs = _make_batch(BATCH)

@@ -382,14 +382,84 @@ def config7_rlc_sharded(n=8192):
             "mesh_devices": plane.nshard if plane is not None else 1}
 
 
+def config8_scheduler(n_subs=16, per_sub=64):
+    """VerifyScheduler pipelined-vs-sync (crypto/scheduler.py): n_subs
+    concurrent consumers each holding a per_sub-signature fragment —
+    the per-consumer synchronous BatchVerifier loop versus the shared
+    coalescing scheduler.  Columns mirror the BENCH_SCHED=1 bench.py
+    line: coalesced batch size, launch count, occupancy of the shared
+    lane bucket, and the stage/execute overlap ratio."""
+    import threading
+
+    from bench import _make_batch_selfhosted
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.crypto import scheduler as vsched
+
+    base = _launch_baseline()
+    pubs, msgs, sigs = _make_batch_selfhosted(n_subs * per_sub)
+    keys = [edkeys.PubKey(p) for p in pubs]
+    subs = [[(keys[i], msgs[i], sigs[i])
+             for i in range(k * per_sub, (k + 1) * per_sub)]
+            for k in range(n_subs)]
+
+    cbatch.verified_sigs = cbatch.SigCache()  # no free cache hits
+    t0 = time.perf_counter()
+    for sub in subs:
+        bv = cbatch.BatchVerifier()
+        for pub, m, s in sub:
+            bv.add(pub, m, s)
+        assert bv.verify()[0]
+    sync_s = time.perf_counter() - t0
+
+    cbatch.verified_sigs = cbatch.SigCache()
+    sched = vsched.install(vsched.VerifyScheduler(window_s=0.002))
+    sched.start()
+    try:
+        futs = [None] * n_subs
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=lambda k=k: futs.__setitem__(
+                k, sched.submit(subs[k], vsched.Priority.BLOCKSYNC)))
+            for k in range(n_subs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            assert f.result(timeout=600).all()
+        piped_s = time.perf_counter() - t0
+        st = sched.stats()
+    finally:
+        sched.stop()
+        vsched.uninstall(sched)
+
+    n = n_subs * per_sub
+    return {"config": f"8: verify scheduler {n_subs}x{per_sub} "
+                      f"pipelined vs sync",
+            "sync_s": round(sync_s, 2),
+            "pipelined_s": round(piped_s, 2),
+            "sigs_per_s": round(n / piped_s),
+            "sync_sigs_per_s": round(n / sync_s),
+            "speedup": round(sync_s / piped_s, 2),
+            "coalesce_mean_batch": round(st["mean_batch"], 1),
+            "launches": st["launches"],
+            "overlap_ratio": round(st["overlap_ratio"], 3),
+            **_launch_cols(base)}
+
+
 def main():
     import json
 
     import jax
-    print(f"# platform={jax.devices()[0].platform} "
-          f"cpu_openssl={_cpu_verify_rate():.0f}/s", flush=True)
+    try:
+        cpu_line = f"cpu_openssl={_cpu_verify_rate():.0f}/s"
+    except ImportError:  # no `cryptography` on this host: degrade
+        cpu_line = "cpu_openssl=unavailable (no cryptography package)"
+    print(f"# platform={jax.devices()[0].platform} {cpu_line}", flush=True)
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
-           config5_mixed, config6_verify_commit_100k, config7_rlc_sharded)
+           config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
+           config8_scheduler)
     only = os.environ.get("BENCH_ONLY", "")
     for fn in fns:
         if only and only not in fn.__name__:

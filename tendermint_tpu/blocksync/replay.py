@@ -9,9 +9,11 @@ Here the unit of work is a *window* of consecutive blocks.  While the
 validator set is stable (the common case — epochs of thousands of blocks),
 every signature the window needs — the >2/3 light prefixes certifying each
 block AND the full LastCommit sets required by validate_block — is collected
-into ONE BatchVerifier flush: W blocks x ~1.7N sigs ride a single TPU kernel
-launch instead of 2W host loops.  Verified commits are recorded in the
-executor's pre-verified cache so apply_block does not re-verify.
+into ONE coalesced verify — the shared VerifyScheduler (crypto/scheduler.py,
+BLOCKSYNC class) when it is running, a private BatchVerifier otherwise: W
+blocks x ~1.7N sigs ride a single TPU kernel launch instead of 2W host
+loops.  Verified commits are recorded in the executor's pre-verified cache
+so apply_block does not re-verify.
 
 Correctness does not rest on the optimistic batch: any batch failure (or a
 window where the stable-set condition does not hold) falls back to the
@@ -22,7 +24,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.crypto import scheduler as vsched
 from tendermint_tpu.types.block import Block
 from tendermint_tpu.types.basic import BlockID
 from tendermint_tpu.types.part_set import PartSet, BLOCK_PART_SIZE_BYTES
@@ -129,19 +131,21 @@ def replay_window(executor, store, state, blocks: List[Block],
         # phase 2: one batch.  When cert_i IS block i+1's LastCommit (the
         # reactor flow) and block i+1 is in the window, its full set
         # already covers the prefix — skip the duplicate ~2N/3 lanes.
-        bv = BatchVerifier()
+        items = []
         ids = []
         for i, (bid, parts, prefix_items, lc_items) in enumerate(plan):
             covered = (i + 1 < collected
                        and certifiers[i] is blocks[i + 1].last_commit)
             if not covered:
-                for pub, msg, sig in prefix_items:
-                    bv.add(pub, msg, sig)
-            for pub, msg, sig in lc_items:
-                bv.add(pub, msg, sig)
+                items.extend(prefix_items)
+            items.extend(lc_items)
             ids.append((bid, parts))
         if collected >= 1:
-            all_ok, _bits = bv.verify()
+            # replay class on the shared verify scheduler (coalesces
+            # with whatever consensus/light work is in flight, below
+            # their priority); exact BatchVerifier semantics either way
+            all_ok, _bits = vsched.verify_items(
+                items, vsched.Priority.BLOCKSYNC)
             if all_ok:
                 for i in range(collected):
                     b, cert = blocks[i], certifiers[i]

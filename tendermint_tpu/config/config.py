@@ -133,6 +133,29 @@ class BatchVerifierConfig:
 
 
 @dataclass
+class VerifySchedulerConfig:
+    """Process-global cross-consumer verification scheduler
+    (crypto/scheduler.py, docs/adr/adr-012-verify-scheduler.md).  When
+    enabled the node installs + starts one VerifyScheduler and every
+    verify consumer (vote preverify, commit/light checks, blocksync
+    replay, bulk) coalesces through it; disabled, all call sites keep
+    their direct BatchVerifier paths."""
+    enable: bool = True
+    window_ms: float = 2.0      # coalescing window (deadlines shorten it)
+    max_batch: int = 8192       # lanes per coalesced launch / direct-path
+    #                             cutover for verify_sigs_bulk
+    max_pending: int = 65536    # bounded queue: beyond this the mempool
+    #                             class is shed
+
+    def validate_basic(self):
+        if self.window_ms < 0:
+            raise ValueError("verify_scheduler.window_ms must be >= 0")
+        if self.max_batch <= 0 or self.max_pending <= 0:
+            raise ValueError(
+                "verify_scheduler.max_batch/max_pending must be positive")
+
+
+@dataclass
 class Config:
     home: str = ""
     moniker: str = "node"
@@ -153,11 +176,14 @@ class Config:
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     batch_verifier: BatchVerifierConfig = field(
         default_factory=BatchVerifierConfig)
+    verify_scheduler: VerifySchedulerConfig = field(
+        default_factory=VerifySchedulerConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
-        for name in ("p2p", "mempool", "rpc", "consensus"):
+        for name in ("p2p", "mempool", "rpc", "consensus",
+                     "verify_scheduler"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -266,6 +292,12 @@ enable = {str(self.batch_verifier.enable).lower()}
 rlc = {str(self.batch_verifier.rlc).lower()}
 secp_lane = {str(self.batch_verifier.secp_lane).lower()}
 
+[verify_scheduler]
+enable = {str(self.verify_scheduler.enable).lower()}
+window_ms = {self.verify_scheduler.window_ms}
+max_batch = {self.verify_scheduler.max_batch}
+max_pending = {self.verify_scheduler.max_pending}
+
 [consensus]
 timeout_propose = {c.timeout_propose}
 timeout_propose_delta = {c.timeout_propose_delta}
@@ -339,6 +371,12 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             enable=bv.get("enable", True),
             rlc=bool(bv.get("rlc", False)),
             secp_lane=bool(bv.get("secp_lane", False)))
+        vs = d.get("verify_scheduler", {})
+        cfg.verify_scheduler = VerifySchedulerConfig(
+            enable=bool(vs.get("enable", True)),
+            window_ms=float(vs.get("window_ms", 2.0)),
+            max_batch=int(vs.get("max_batch", 8192)),
+            max_pending=int(vs.get("max_pending", 65536)))
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

@@ -25,7 +25,6 @@ import time
 import traceback
 from typing import Callable, List, Optional
 
-from tendermint_tpu.crypto.batch import BatchVerifier
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.libs.service import BaseService
@@ -260,6 +259,12 @@ class ConsensusState(BaseService):
                         votes=len(votes)):
             self._preverify_votes_locked(votes)
 
+    # how long a preverify submission may sit in the VerifyScheduler's
+    # coalescing window before the deadline forces a flush: long enough
+    # to coalesce with a concurrent light/blocksync batch, far below any
+    # consensus timeout
+    PREVERIFY_DEADLINE_S = 0.005
+
     def _preverify_votes_locked(self, votes):
         with self._mtx:
             state = self.state
@@ -269,7 +274,7 @@ class ConsensusState(BaseService):
             vals_last = state.last_validators
             height = self.rs.height
             cur_votes = self.rs.votes
-        bv = BatchVerifier()
+        items = []
         chain_id = state.chain_id
         seen = set()
         for v in votes:
@@ -306,12 +311,22 @@ class ConsensusState(BaseService):
                 if key in seen:
                     continue
                 seen.add(key)
-                bv.add(val.pub_key, v.sign_bytes(chain_id), v.signature)
+                items.append((val.pub_key, v.sign_bytes(chain_id),
+                              v.signature))
             except Exception:
                 continue
-        if len(bv):
+        if items:
             try:
-                bv.verify()  # populates crypto.batch.verified_sigs
+                # highest-priority class on the shared verify scheduler
+                # (coalesces with concurrent light/blocksync batches in
+                # one device launch); identical direct BatchVerifier
+                # path when no scheduler is running.  Either way the
+                # valid triples land in crypto.batch.verified_sigs and
+                # the serial apply below hits the cache.
+                from tendermint_tpu.crypto import scheduler as vsched
+                vsched.verify_items(
+                    items, vsched.Priority.CONSENSUS,
+                    deadline=time.monotonic() + self.PREVERIFY_DEADLINE_S)
             except Exception:
                 pass
 

@@ -78,7 +78,7 @@ class _Item:
 
 
 class SigCache:
-    """Bounded FIFO cache of signatures that ALREADY verified valid.
+    """Bounded LRU cache of signatures that ALREADY verified valid.
 
     This is the seam between the consensus live-vote coalescing window and
     VoteSet's serial add path (SURVEY §7 hard part 2): the receive loop
@@ -86,7 +86,13 @@ class SigCache:
     (populating this cache), then applies the votes in arrival order —
     VoteSet's per-vote verify becomes a cache hit instead of a host
     signature check.  Only valid triples are ever inserted, so a hit is
-    exactly as strong as a fresh verification."""
+    exactly as strong as a fresh verification.
+
+    Shared mutable state across the consensus receive loop, the
+    VerifyScheduler's stage/execute workers, and every reactor thread
+    that re-checks serially: add/hit are lock-guarded, and eviction is
+    true LRU (a hit refreshes recency), so the hot live-vote window
+    survives a background bulk insert of the same capacity."""
 
     def __init__(self, capacity: int = 1 << 16):
         import collections
@@ -107,21 +113,34 @@ class SigCache:
         return h.digest()
 
     def add(self, pub_bytes: bytes, msg: bytes, sig: bytes) -> None:
-        k = self.key(pub_bytes, msg, sig)
+        self.add_key(self.key(pub_bytes, msg, sig))
+
+    def add_key(self, k: bytes) -> None:
+        """Insert by precomputed key (the scheduler hashes each triple
+        once at staging and reuses the digest for dedupe, the hit check,
+        and this insert)."""
         with self._lock:
             self._set[k] = None
+            self._set.move_to_end(k)  # re-insert refreshes recency too
             while len(self._set) > self.capacity:
                 self._set.popitem(last=False)
 
     def hit(self, pub_bytes: bytes, msg: bytes, sig: bytes) -> bool:
-        k = self.key(pub_bytes, msg, sig)
+        return self.hit_key(self.key(pub_bytes, msg, sig))
+
+    def hit_key(self, k: bytes) -> bool:
         with self._lock:
             ok = k in self._set
             if ok:
+                self._set.move_to_end(k)  # LRU: a hit is a use
                 self.hits += 1
             else:
                 self.misses += 1
             return ok
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._set)
 
 
 verified_sigs = SigCache()
@@ -250,22 +269,29 @@ def _device_verifier(tname: str):
     return None
 
 
-def _host_verify_items(tname: str, items) -> np.ndarray:
+def _host_verify_items(tname: str, items, assume_miss: bool = False) \
+        -> np.ndarray:
     """Host lane: SigCache hits first; cache misses batch through the
     native C verifiers for secp256k1/sr25519 (native/ecverify.c — the
     pure-Python bignum path costs ~5 ms/sig, the C lanes ~0.1-0.2 ms);
     per-item Python remains the no-toolchain fallback and handles
-    malformed-length inputs."""
+    malformed-length inputs.  `assume_miss` skips the cache pre-pass
+    when the caller already filtered hits (the scheduler's stager hashed
+    every triple once and resolved hits without lanes — re-hashing here
+    could only re-prove misses)."""
     from tendermint_tpu.libs import native
 
     n = len(items)
     bits = np.zeros(n, dtype=bool)
-    miss = []
-    for i, it in enumerate(items):
-        if verified_sigs.hit(it.pub.bytes(), it.msg, it.sig):
-            bits[i] = True
-        else:
-            miss.append(i)
+    if assume_miss:
+        miss = list(range(n))
+    else:
+        miss = []
+        for i, it in enumerate(items):
+            if verified_sigs.hit(it.pub.bytes(), it.msg, it.sig):
+                bits[i] = True
+            else:
+                miss.append(i)
     if not miss:
         return bits
     sub = None
@@ -294,8 +320,32 @@ def verify_sigs_bulk(pubs: Sequence[PubKey], msgs, sigs: Sequence[bytes],
     Routing matches BatchVerifier: device kernel for big all-ed25519
     batches, per-item host verify otherwise.  Skips the SigCache (a 100k
     commit would evict the live-vote window; callers that need cache
-    population use BatchVerifier)."""
+    population use BatchVerifier).
+
+    When the process-global VerifyScheduler is running, list-input
+    batches up to its max_batch route through it instead (at the
+    caller's priority context, default COMMIT) so concurrent consumers
+    coalesce into shared device launches.  Two shapes keep the direct
+    path: batches above max_batch (a window that size saturates the
+    device alone), and the (n, 32) raw-pubkey-matrix input — that is
+    the validator-set per-block hot path whose device-resident pubkey
+    cache ships 96 B/sig with zero per-key objects (ADR-008), and
+    coalescing could only add copies and restage resident keys."""
     n = len(pubs)
+    sch = None
+    if n and not isinstance(pubs, np.ndarray):
+        from tendermint_tpu.crypto import scheduler as vsched
+        sch = vsched.running()
+    if sch is not None and n <= sch.max_batch:
+        try:
+            items = [(pubs[i], msgs[i], sigs[i]) for i in range(n)]
+            prio, deadline = vsched.context_priority(
+                vsched.Priority.COMMIT)
+            return sch.submit(items, prio, deadline=deadline,
+                              populate_cache=False).result(
+                                  timeout=sch.sync_timeout())
+        except (vsched.SchedulerError, TimeoutError):
+            pass  # fall through to the direct path below
     rt = degrade.runtime()
     if isinstance(pubs, np.ndarray):
         # (n, 32) raw ed25519 pubkey matrix — the validator-set fast

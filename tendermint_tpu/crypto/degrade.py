@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import concurrent.futures as _cf
 import os
+import queue as _queue
 import random
 import threading
 import time
@@ -196,6 +197,51 @@ class CircuitBreaker:
             notify()
 
 
+class _LaneWorker:
+    """Single-thread task runner for device launches — the
+    ThreadPoolExecutor(max_workers=1) shape, but with a DAEMON thread.
+    Python 3.9+ executor threads are non-daemon and an idle lane worker
+    would outlive every test (and show up in the conftest thread-leak
+    guard) and block interpreter shutdown behind a wedged device call;
+    the lane worker must never keep the process alive."""
+
+    def __init__(self, name: str = "batch-device-lane"):
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, fn: Callable) -> _cf.Future:
+        if self._closed:
+            raise RuntimeError("lane worker is shut down")
+        f: _cf.Future = _cf.Future()
+        self._q.put((fn, f))
+        return f
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, f = item
+            if not f.set_running_or_notify_cancel():
+                continue
+            try:
+                f.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 - future carries it
+                f.set_exception(e)
+
+    def shutdown(self, wait: bool = False):
+        """Same contract as executor.shutdown(wait=False): stop accepting
+        work, wake the worker.  A wedged in-flight call keeps its (daemon)
+        thread; quarantine relies on exactly that — abandon, don't join."""
+        self._closed = True
+        self._q.put(None)
+        if wait:
+            self._thread.join(timeout=2.0)
+
+
 class DeviceLaneRuntime:
     """Owns the device-lane worker pool, the circuit breaker, and the
     backend probe.  crypto/batch.py routes every device dispatch through
@@ -212,7 +258,7 @@ class DeviceLaneRuntime:
                                       metrics=self.metrics)
         self._clock = clock
         self._pool_lock = threading.Lock()
-        self._pool: Optional[_cf.ThreadPoolExecutor] = None
+        self._pool: Optional[_LaneWorker] = None
         # backend probe state: None = never probed, True = accelerator,
         # False-stable = plain-CPU backend (a fixed property of the
         # process), False-transient = init raised, re-probe after backoff
@@ -224,11 +270,10 @@ class DeviceLaneRuntime:
 
     # -- worker pool -------------------------------------------------------
 
-    def _get_pool(self) -> _cf.ThreadPoolExecutor:
+    def _get_pool(self) -> _LaneWorker:
         with self._pool_lock:
             if self._pool is None:
-                self._pool = _cf.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="batch-device-lane")
+                self._pool = _LaneWorker()
             return self._pool
 
     def _quarantine_pool(self):
@@ -240,6 +285,15 @@ class DeviceLaneRuntime:
             pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+
+    def close(self):
+        """Shut down the lane worker (configure()/reset() call this on
+        the runtime they replace so tests don't accumulate idle lane
+        threads)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
     # -- backend probing (replaces batch.py's one-shot _backend_ok) --------
 
@@ -392,16 +446,24 @@ def configure(cfg: Optional[DegradeConfig] = None,
     """Install a fresh runtime (tests: deterministic clock / private
     metrics registry; node assembly: config-derived thresholds)."""
     global _runtime
+    new = DeviceLaneRuntime(cfg, clock=clock, registry=registry)
     with _runtime_lock:
-        _runtime = DeviceLaneRuntime(cfg, clock=clock, registry=registry)
-        return _runtime
+        old, _runtime = _runtime, new
+    if old is not None:
+        old.close()
+    # return the runtime THIS call installed — re-reading the global
+    # here could hand back None (concurrent reset) or another call's
+    # runtime (concurrent configure)
+    return new
 
 
 def reset():
     """Drop the global runtime (next access rebuilds from env)."""
     global _runtime
     with _runtime_lock:
-        _runtime = None
+        old, _runtime = _runtime, None
+    if old is not None:
+        old.close()
 
 
 def publish_route(path, outcome, n=None, nb=None, compile_s=None):

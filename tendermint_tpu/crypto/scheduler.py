@@ -1,0 +1,689 @@
+"""VerifyScheduler — the process-global signature-verification service.
+
+Every consumer of the TPU verify plane used to own a private
+crypto.batch.BatchVerifier and block synchronously on verify(): the
+consensus receive loop preverifying its vote window, the light client
+checking commits, blocksync replaying windows, the whole-commit bulk
+path.  Under concurrent load those consumers launch tiny fragmented
+device batches back to back — the device idles while each caller's host
+thread stages its own next batch, and no batch reaches the occupancy
+the padded lane buckets are priced for.
+
+This module gives the verify plane the classic inference-serving shape
+(docs/adr/adr-012-verify-scheduler.md):
+
+  * one process-global scheduler with a futures API —
+    ``submit(items, priority, deadline) -> VerifyFuture`` resolving to
+    the exact per-triple validity bitmap, plus ``verify_items`` as a
+    drop-in synchronous wrapper with BatchVerifier's (all_ok, bitmap)
+    contract;
+  * continuous coalescing: submissions from all consumers merge into
+    shared launches under a time/size window.  The launch path is the
+    SAME per-scheme lane machinery BatchVerifier uses (host C lanes +
+    the device kernel via crypto/degrade.py), so the padded nb=64 lane
+    buckets are reused and no new XLA shapes are compiled;
+  * a double-buffered pipeline: a stager thread hashes/dedupes/groups
+    batch N+1 while the executor thread has batch N in flight on the
+    device lane — host staging hides under device execution instead of
+    serializing with it;
+  * dedupe: identical (pub, msg, sig) triples submitted concurrently
+    collapse into one lane, and triples already proven by SigCache
+    resolve without any lane at all;
+  * priority classes (consensus votes > commit/light > blocksync replay
+    > mempool pre-check) with a bounded queue: the lowest class is shed
+    when the queue is full, and queued lowest-class work is evicted to
+    admit higher classes;
+  * deadline flush: a submission may carry a monotonic deadline and the
+    window closes early to honor it — consensus never waits out a
+    coalescing window sized for throughput.
+
+Degradation inherits crypto/degrade.py wholesale: a device raise,
+timeout, corrupt bitmap, or open breaker re-verifies the SAME lanes on
+the host, so callers observe byte-identical bitmaps through every
+failure class.  When the scheduler is not installed/running, every
+call site falls back to its original direct BatchVerifier path — the
+scheduler is an accelerant, never a dependency.
+"""
+from __future__ import annotations
+
+import enum
+import queue as _queue
+import threading
+import time
+from contextlib import contextmanager
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.service import BaseService
+from . import PubKey
+from . import batch as _batch
+from . import degrade
+from . import ed25519 as _ed
+
+
+class Priority(enum.IntEnum):
+    """Lower value = more urgent.  MEMPOOL is the shed class."""
+    CONSENSUS = 0   # live vote preverify: blocks the consensus loop
+    COMMIT = 1      # commit / light-client checks (finalize, verifier)
+    BLOCKSYNC = 2   # replay windows: throughput-bound, deadline-free
+    MEMPOOL = 3     # pre-checks: best-effort, shed under pressure
+
+
+class SchedulerError(RuntimeError):
+    """Base class: the sync wrapper treats any of these as 'use the
+    direct BatchVerifier path instead'."""
+
+
+class SchedulerShedError(SchedulerError):
+    """The submission was load-shed (queue full, lowest class)."""
+
+
+class SchedulerStoppedError(SchedulerError):
+    """The scheduler stopped before the submission resolved."""
+
+
+class VerifyFuture:
+    """Resolves to the per-item bool bitmap, in submission order.
+    First resolution wins — a late executor settling after stop() can
+    never clobber the stop error the waiter already observed (or vice
+    versa)."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._ev = threading.Event()
+        self._bits: Optional[np.ndarray] = None
+        self._exc: Optional[BaseException] = None
+
+    def _set(self, bits: np.ndarray):
+        if not self._ev.is_set():
+            self._bits = bits
+            self._ev.set()
+
+    def _set_exception(self, exc: BaseException):
+        if not self._ev.is_set():
+            self._exc = exc
+            self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"verify future ({self._n} items) not resolved "
+                f"within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._bits
+
+
+class _Submission:
+    __slots__ = ("items", "prio", "deadline", "populate_cache", "future",
+                 "bits", "remaining", "enq_t", "n")
+
+    def __init__(self, items, prio, deadline, populate_cache):
+        self.items = items          # List[_batch._Item]
+        self.prio = prio
+        self.deadline = deadline    # monotonic or None
+        self.populate_cache = populate_cache
+        self.n = len(items)
+        self.future = VerifyFuture(self.n)
+        self.bits = np.zeros(self.n, dtype=bool)
+        self.remaining = self.n
+        self.enq_t = 0.0
+
+
+class _Launch:
+    __slots__ = ("lanes", "keys", "waiters", "by_scheme", "subs",
+                 "parent_span", "cache_hits", "dedup")
+
+    def __init__(self, lanes, keys, waiters, by_scheme, subs, parent_span,
+                 cache_hits, dedup):
+        self.lanes = lanes          # List[_batch._Item], one per lane
+        self.keys = keys            # SigCache digests, lane-aligned
+        self.waiters = waiters      # lane -> [(submission, item_idx)]
+        self.by_scheme = by_scheme  # type_name -> [lane idx]
+        self.subs = subs
+        self.parent_span = parent_span
+        self.cache_hits = cache_hits
+        self.dedup = dedup
+
+
+def _as_item(triple) -> _batch._Item:
+    """Normalize a (pub, msg, sig) triple: pub may be a PubKey or raw
+    32-byte ed25519 key bytes (the validator-set matrix rows)."""
+    pub, msg, sig = triple
+    if not isinstance(pub, PubKey):
+        pub = _ed.PubKey(bytes(pub))
+    return _batch._Item(pub, bytes(msg), bytes(sig))
+
+
+class VerifyScheduler(BaseService):
+    """See the module docstring.  One instance per process (install());
+    tests may run private instances."""
+
+    def __init__(self, window_s: float = 0.002, max_batch: int = 8192,
+                 max_pending: int = 65536,
+                 tpu_threshold: Optional[int] = None,
+                 name: str = "verify-scheduler"):
+        super().__init__(name=name)
+        self.window_s = max(0.0, float(window_s))
+        self.max_batch = max(1, int(max_batch))
+        self.max_pending = max(1, int(max_pending))
+        self.tpu_threshold = (tpu_threshold if tpu_threshold is not None
+                              else _batch.BatchVerifier().tpu_threshold)
+        self._cond = threading.Condition()
+        self._queues: Dict[int, List[_Submission]] = \
+            {int(p): [] for p in Priority}
+        self._pending_items = 0
+        self._flush_req = False
+        # maxsize=1 IS the double buffer: one launch executing, one
+        # staged, the stager blocked on a third until a slot frees
+        self._staged: "_queue.Queue[_Launch]" = _queue.Queue(maxsize=1)
+        self._res_lock = threading.Lock()
+        # pipeline-overlap accounting (all under _stats_lock)
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "submissions": 0, "items": 0, "launches": 0, "lanes": 0,
+            "cache_hits": 0, "dedup": 0, "shed": 0, "evicted": 0,
+            "stage_s": 0.0, "stage_overlap_s": 0.0, "exec_busy_s": 0.0,
+        }
+        self._exec_since: Optional[float] = None
+
+    # -- metrics -----------------------------------------------------------
+
+    @staticmethod
+    def _metrics():
+        """The CryptoMetrics bundle of the CURRENT degradation runtime —
+        resolved per use so a test that reconfigures degrade mid-life
+        sees scheduler metrics land in its private registry too."""
+        return degrade.runtime().metrics
+
+    def _gauge_depth(self):
+        try:
+            self._metrics().sched_queue_depth.set(self._pending_items)
+        except Exception:  # noqa: BLE001 - observability must not break
+            pass
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, items: Sequence, prio: Priority = Priority.COMMIT,
+               deadline: Optional[float] = None,
+               populate_cache: bool = True) -> VerifyFuture:
+        """Queue (pub, msg, sig) triples; the future resolves to their
+        bool bitmap in submission order.  `deadline` is a monotonic
+        timestamp: the coalescing window closes early to meet it.
+        Raises nothing — shed/stopped/malformed land on the future.
+
+        max_pending is a hard bound only for the MEMPOOL shed class;
+        higher classes are always admitted (dropping consensus-critical
+        work would change semantics, and every in-repo consumer blocks
+        on the future through the sync wrapper, so each consumer thread
+        holds at most one submission in flight — the queue is naturally
+        bounded by consumer count x batch size)."""
+        try:
+            norm = [_as_item(t) for t in items]
+        except Exception as exc:  # noqa: BLE001 - malformed pub bytes
+            f = VerifyFuture(0)
+            f._set_exception(exc)
+            return f
+        sub = _Submission(norm, Priority(prio), deadline, populate_cache)
+        if sub.n == 0:
+            sub.future._set(sub.bits)
+            return sub.future
+        with self._cond:
+            if not self.is_running():
+                sub.future._set_exception(SchedulerStoppedError(
+                    f"{self.name} is not running"))
+                return sub.future
+            if self._pending_items + sub.n > self.max_pending:
+                if sub.prio == Priority.MEMPOOL:
+                    self._shed_locked(sub, "queue_full")
+                    return sub.future
+                # admit the higher class by evicting queued shed-class
+                # work, newest first (oldest mempool work is closest to
+                # its launch; the newest waited least)
+                self._evict_mempool_locked(sub.n)
+            sub.enq_t = time.monotonic()
+            self._queues[int(sub.prio)].append(sub)
+            self._pending_items += sub.n
+            with self._stats_lock:
+                self._stats["submissions"] += 1
+                self._stats["items"] += sub.n
+            self._gauge_depth()
+            depth = self._pending_items
+            self._cond.notify_all()
+        trace.instant("sched.submit", priority=sub.prio.name.lower(),
+                      n=sub.n, queue_depth=depth)
+        return sub.future
+
+    def _shed_locked(self, sub: _Submission, reason: str):
+        with self._stats_lock:
+            self._stats["shed"] += 1
+        try:
+            self._metrics().sched_shed_total.inc(
+                priority=sub.prio.name.lower())
+        except Exception:  # noqa: BLE001
+            pass
+        trace.instant("sched.shed", priority=sub.prio.name.lower(),
+                      n=sub.n, reason=reason)
+        sub.future._set_exception(SchedulerShedError(
+            f"queue full ({self._pending_items} items pending): "
+            f"{sub.prio.name} submission of {sub.n} shed"))
+
+    def _evict_mempool_locked(self, needed: int):
+        q = self._queues[int(Priority.MEMPOOL)]
+        while q and self._pending_items + needed > self.max_pending:
+            victim = q.pop()  # newest first
+            self._pending_items -= victim.n
+            with self._stats_lock:
+                self._stats["evicted"] += 1
+            self._shed_locked(victim, "evicted_for_higher_class")
+        self._gauge_depth()
+
+    def flush(self):
+        """Close the current window immediately (tests, shutdown paths)."""
+        with self._cond:
+            self._flush_req = True
+            self._cond.notify_all()
+
+    # -- service lifecycle -------------------------------------------------
+
+    def on_start(self):
+        self.spawn(self._stage_loop, name=f"{self.name}-stage")
+        self.spawn(self._exec_loop, name=f"{self.name}-exec")
+
+    def stop(self):
+        BaseService.stop(self)   # sets quitting, joins the two workers
+        self._fail_outstanding(SchedulerStoppedError(
+            f"{self.name} stopped"))
+
+    def on_stop(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    def _fail_outstanding(self, exc: SchedulerError):
+        subs: List[_Submission] = []
+        with self._cond:
+            for q in self._queues.values():
+                subs.extend(q)
+                q.clear()
+            self._pending_items = 0
+            self._gauge_depth()
+        for sub in subs:
+            sub.future._set_exception(exc)
+        self._drain_staged(exc)
+
+    def _drain_staged(self, exc: SchedulerError):
+        while True:
+            try:
+                launch = self._staged.get_nowait()
+            except _queue.Empty:
+                return
+            for sub in launch.subs:
+                sub.future._set_exception(exc)
+
+    # -- stage side of the pipeline ---------------------------------------
+
+    def _stage_loop(self):
+        while not self.quitting.is_set():
+            subs = self._collect_window()
+            if not subs:
+                continue
+            try:
+                launch = self._stage(subs)
+            except Exception as exc:  # noqa: BLE001 - the loop must
+                # survive (like _exec_loop): one poisoned window must not
+                # kill the stager while running() keeps routing consumers
+                # here.  Failing the futures sends sync wrappers to their
+                # direct BatchVerifier path.
+                for sub in subs:
+                    sub.future._set_exception(SchedulerError(
+                        f"staging failed: {exc!r}"))
+                continue
+            if launch is None:
+                continue  # everything resolved from cache
+            # blocking put = the third batch waits for a buffer slot
+            while not self.quitting.is_set():
+                try:
+                    self._staged.put(launch, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
+            else:
+                for sub in launch.subs:
+                    sub.future._set_exception(SchedulerStoppedError(
+                        f"{self.name} stopped while staging"))
+                continue
+            if self.quitting.is_set():
+                # stop() may have drained _staged (_fail_outstanding)
+                # before our put landed; the exec loop is gone, so drain
+                # again ourselves — double-settling is safe (first
+                # resolution wins on the future)
+                self._drain_staged(SchedulerStoppedError(
+                    f"{self.name} stopped while staging"))
+
+    def _collect_window(self) -> List[_Submission]:
+        """Block until the window closes (time/size/deadline/flush),
+        then drain submissions in priority order up to max_batch items
+        (whole submissions; always at least one)."""
+        with self._cond:
+            while not self.quitting.is_set():
+                if self._pending_items == 0:
+                    self._flush_req = False
+                    self._cond.wait(0.1)
+                    continue
+                now = time.monotonic()
+                close_at = self._oldest_enq_locked() + self.window_s
+                dl = self._min_deadline_locked()
+                if dl is not None:
+                    close_at = min(close_at, dl)
+                if (self._flush_req or now >= close_at
+                        or self._pending_items >= self.max_batch):
+                    self._flush_req = False
+                    return self._drain_locked()
+                self._cond.wait(min(max(close_at - now, 0.0005), 0.05))
+        return []
+
+    def _oldest_enq_locked(self) -> float:
+        return min(q[0].enq_t for q in self._queues.values() if q)
+
+    def _min_deadline_locked(self) -> Optional[float]:
+        dls = [s.deadline for q in self._queues.values() for s in q
+               if s.deadline is not None]
+        return min(dls) if dls else None
+
+    def _drain_locked(self) -> List[_Submission]:
+        out: List[_Submission] = []
+        taken = 0
+        for p in sorted(self._queues):
+            q = self._queues[p]
+            while q and (taken < self.max_batch or not out):
+                sub = q.pop(0)
+                out.append(sub)
+                taken += sub.n
+            if taken >= self.max_batch and out:
+                break
+        self._pending_items -= taken
+        self._gauge_depth()
+        return out
+
+    def _stage(self, subs: List[_Submission]) -> Optional[_Launch]:
+        """Host staging: hash every triple once, dedupe within the
+        launch, resolve SigCache hits immediately, group survivors per
+        key scheme.  Runs on the stager thread — overlapped with the
+        executor's in-flight launch (the double buffer)."""
+        t0 = time.monotonic()
+        overlap0 = self._exec_since is not None
+        lanes: List[_batch._Item] = []
+        keys: List[bytes] = []
+        waiters: List[List[Tuple[_Submission, int]]] = []
+        lane_of: Dict[bytes, int] = {}
+        cache_hits = dedup = 0
+        with trace.span("sched.coalesce", submissions=len(subs),
+                        items=sum(s.n for s in subs)) as sp:
+            for sub in subs:
+                for i, it in enumerate(sub.items):
+                    k = _batch.SigCache.key(it.pub.bytes(), it.msg, it.sig)
+                    j = lane_of.get(k)
+                    if j is not None:
+                        dedup += 1
+                        waiters[j].append((sub, i))
+                        continue
+                    if _batch.verified_sigs.hit_key(k):
+                        cache_hits += 1
+                        self._resolve(sub, i, True, None)
+                        continue
+                    lane_of[k] = len(lanes)
+                    lanes.append(it)
+                    keys.append(k)
+                    waiters.append([(sub, i)])
+            by_scheme: Dict[str, List[int]] = {}
+            for j, it in enumerate(lanes):
+                by_scheme.setdefault(it.pub.type_name, []).append(j)
+            if trace.is_enabled():
+                sp.add(lanes=len(lanes), dedup=dedup,
+                       cache_hits=cache_hits,
+                       priorities=",".join(sorted(
+                           {s.prio.name.lower() for s in subs})))
+            parent = sp.span_id
+        dt = time.monotonic() - t0
+        overlap1 = self._exec_since is not None
+        with self._stats_lock:
+            self._stats["cache_hits"] += cache_hits
+            self._stats["dedup"] += dedup
+            self._stats["stage_s"] += dt
+            # endpoint sampling: both ends busy -> fully overlapped, one
+            # end -> half; a gauge, not an invoice
+            self._stats["stage_overlap_s"] += \
+                dt * (0.5 * (overlap0 + overlap1))
+        if not lanes:
+            return None
+        return _Launch(lanes, keys, waiters, by_scheme, subs, parent,
+                       cache_hits, dedup)
+
+    # -- execute side of the pipeline -------------------------------------
+
+    def _exec_loop(self):
+        while not self.quitting.is_set():
+            try:
+                launch = self._staged.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            t0 = time.monotonic()
+            self._exec_since = t0
+            try:
+                self._execute(launch)
+            except Exception:  # noqa: BLE001 - the loop must survive
+                self._resolve_by_host(launch)
+            finally:
+                self._exec_since = None
+                dt = time.monotonic() - t0
+                with self._stats_lock:
+                    self._stats["exec_busy_s"] += dt
+                    self._stats["launches"] += 1
+                    self._stats["lanes"] += len(launch.lanes)
+                self._publish_overlap()
+
+    def _publish_overlap(self):
+        with self._stats_lock:
+            staged = self._stats["stage_s"]
+            ratio = (self._stats["stage_overlap_s"] / staged) if staged \
+                else 0.0
+        try:
+            self._metrics().sched_overlap_ratio.set(min(ratio, 1.0))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _execute(self, launch: _Launch):
+        """One coalesced launch through the SAME lane machinery as
+        BatchVerifier._verify: host C lanes inline, device lanes via the
+        degradation runtime (site "sched.<scheme>"), every fallback
+        preserving exact bitmaps."""
+        lanes, by_scheme = launch.lanes, launch.by_scheme
+        n = len(lanes)
+        out = np.zeros(n, dtype=bool)
+        with trace.span("sched.launch", parent=launch.parent_span, n=n,
+                        schemes=",".join(f"{t}:{len(ix)}"
+                                         for t, ix in by_scheme.items()),
+                        dedup=launch.dedup,
+                        cache_hits=launch.cache_hits) as sp:
+            rt = degrade.runtime() \
+                if n >= self.tpu_threshold else None
+            device_lanes = []
+            host_lanes = []
+            for tname, idxs in by_scheme.items():
+                items = [lanes[j] for j in idxs]
+                verifier = (_batch._device_verifier(tname)
+                            if rt is not None else None)
+                if (verifier is not None and _batch._use_device()
+                        and len(items) >= self.tpu_threshold):
+                    if rt.try_acquire():
+                        fut = rt.submit(
+                            f"sched.{tname}", verifier,
+                            [it.pub.bytes() for it in items],
+                            [it.msg for it in items],
+                            [it.sig for it in items])
+                        device_lanes.append((tname, idxs, items, fut))
+                        continue
+                    rt.metrics.host_fallbacks.inc(
+                        site=f"sched.{tname}", reason="breaker_open")
+                host_lanes.append((tname, idxs, items))
+            if trace.is_enabled():
+                sp.add(device_lanes=len(device_lanes),
+                       host_lanes=len(host_lanes))
+            try:
+                # assume_miss: the stager already hashed every lane and
+                # resolved all SigCache hits without lanes, so the host
+                # path's cache pre-pass could only re-prove misses
+                for tname, idxs, items in host_lanes:
+                    with trace.span("sched.host_lane", scheme=tname,
+                                    n=len(items)):
+                        out[np.asarray(idxs)] = _batch._host_verify_items(
+                            tname, items, assume_miss=True)
+            finally:
+                # settle EVERY device lane (same contract as
+                # BatchVerifier): collect() never raises — any failure
+                # re-verifies through host_fn with the exact bitmap
+                for tname, idxs, items, fut in device_lanes:
+                    out[np.asarray(idxs)] = rt.collect(
+                        f"sched.{tname}", fut,
+                        host_fn=partial(_batch._host_verify_items,
+                                        tname, items, assume_miss=True),
+                        spot_check=_batch._spot_check_items(items))
+        try:
+            self._metrics().sched_batch_size.observe(float(n))
+        except Exception:  # noqa: BLE001
+            pass
+        for j in range(n):
+            bit = bool(out[j])
+            key = launch.keys[j] if bit else None
+            for sub, i in launch.waiters[j]:
+                self._resolve(sub, i, bit, key)
+
+    def _resolve_by_host(self, launch: _Launch):
+        """Last-ditch settlement when _execute itself raised: per-item
+        host verification, identical semantics (malformed = invalid)."""
+        for j, it in enumerate(launch.lanes):
+            try:
+                bit = bool(it.pub.verify_signature(it.msg, it.sig))
+            except Exception:  # noqa: BLE001 - malformed input = invalid
+                bit = False
+            for sub, i in launch.waiters[j]:
+                self._resolve(sub, i, bit,
+                              launch.keys[j] if bit else None)
+
+    def _resolve(self, sub: _Submission, i: int, bit: bool,
+                 key: Optional[bytes]):
+        if bit and sub.populate_cache and key is not None:
+            _batch.verified_sigs.add_key(key)
+        with self._res_lock:
+            sub.bits[i] = bit
+            sub.remaining -= 1
+            done = sub.remaining == 0
+        if done:
+            trace.instant("sched.resolve", priority=sub.prio.name.lower(),
+                          n=sub.n, valid=int(sub.bits.sum()))
+            sub.future._set(sub.bits)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            s = dict(self._stats)
+        s["pending_items"] = self._pending_items
+        s["mean_batch"] = (s["lanes"] / s["launches"]) if s["launches"] \
+            else 0.0
+        s["overlap_ratio"] = (s["stage_overlap_s"] / s["stage_s"]) \
+            if s["stage_s"] else 0.0
+        return s
+
+    def sync_timeout(self) -> float:
+        """Bound for sync wrappers: worst case is a full window plus a
+        device launch that times out and re-verifies on the host."""
+        return 2 * degrade.runtime().cfg.launch_timeout_s + \
+            self.window_s + 30.0
+
+
+# ---------------------------------------------------------------------------
+# process-global instance + the consumer-facing convenience API
+# ---------------------------------------------------------------------------
+
+_global: Optional[VerifyScheduler] = None
+_global_lock = threading.Lock()
+_prio_ctx = threading.local()
+
+
+def install(s: VerifyScheduler) -> VerifyScheduler:
+    """Install `s` as the process-global scheduler (node assembly /
+    tests).  Returns it for chaining."""
+    global _global
+    with _global_lock:
+        _global = s
+        return s
+
+
+def uninstall(s: Optional[VerifyScheduler] = None):
+    """Remove the global scheduler (only if it is `s`, when given)."""
+    global _global
+    with _global_lock:
+        if s is None or _global is s:
+            _global = None
+
+
+def installed() -> Optional[VerifyScheduler]:
+    with _global_lock:
+        return _global
+
+
+def running() -> Optional[VerifyScheduler]:
+    """The global scheduler iff it is started — call sites route through
+    it exactly when this is non-None."""
+    s = installed()
+    return s if s is not None and s.is_running() else None
+
+
+@contextmanager
+def priority_context(prio: Priority, deadline: Optional[float] = None):
+    """Tag verify work issued on this thread (deep call stacks —
+    light/verifier -> validator_set -> verify_sigs_bulk — where passing
+    a priority argument through would ripple every signature)."""
+    prev = getattr(_prio_ctx, "val", None)
+    _prio_ctx.val = (Priority(prio), deadline)
+    try:
+        yield
+    finally:
+        _prio_ctx.val = prev
+
+
+def context_priority(default: Priority) -> Tuple[Priority, Optional[float]]:
+    val = getattr(_prio_ctx, "val", None)
+    return val if val is not None else (Priority(default), None)
+
+
+def verify_items(items: Sequence, prio: Priority = Priority.COMMIT,
+                 deadline: Optional[float] = None,
+                 populate_cache: bool = True) -> Tuple[bool, np.ndarray]:
+    """Drop-in synchronous wrapper with BatchVerifier.verify()'s exact
+    (all_valid, bitmap) contract.  Routes through the global scheduler
+    when it is running; otherwise — or if the scheduler sheds, stops, or
+    times out mid-flight — verifies directly through a private
+    BatchVerifier, so callers never observe a behavior change."""
+    s = running()
+    if s is not None:
+        try:
+            fut = s.submit(items, prio, deadline=deadline,
+                           populate_cache=populate_cache)
+            bits = fut.result(timeout=s.sync_timeout())
+            return bool(bits.all()), bits
+        except (SchedulerError, TimeoutError):
+            pass
+    bv = _batch.BatchVerifier()
+    for pub, msg, sig in items:
+        if not isinstance(pub, PubKey):
+            pub = _ed.PubKey(bytes(pub))
+        bv.add(pub, msg, sig)
+    return bv.verify()

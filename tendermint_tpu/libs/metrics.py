@@ -312,6 +312,29 @@ class CryptoMetrics:
             "dominated by jit compile; steady-state launches land in "
             "crypto_device_launch_seconds instead.",
             labels=("site",), buckets=exp_buckets(0.01, 4, 10))
+        # VerifyScheduler (crypto/scheduler.py): the cross-consumer
+        # coalescing service — is the queue backing up, how full are the
+        # coalesced launches, is the shed class actually being shed, and
+        # is host staging hiding under device execution
+        self.sched_queue_depth = reg.gauge(
+            "crypto", "sched_queue_depth",
+            "Triples pending in the VerifyScheduler queue, all "
+            "priority classes.")
+        self.sched_batch_size = reg.histogram(
+            "crypto", "sched_batch_size",
+            "Deduped lanes per coalesced VerifyScheduler launch.",
+            buckets=[1, 4, 16, 64, 256, 1024, 4096, 16384, 65536])
+        self.sched_shed_total = reg.counter(
+            "crypto", "sched_shed_total",
+            "Submissions load-shed by the VerifyScheduler (bounded "
+            "queue: lowest class rejected when full, queued lowest-"
+            "class work evicted for higher classes).",
+            labels=("priority",))
+        self.sched_overlap_ratio = reg.gauge(
+            "crypto", "sched_overlap_ratio",
+            "Fraction of VerifyScheduler host-staging time that "
+            "overlapped an in-flight device launch (the double-"
+            "buffered pipeline's effectiveness; 0 when idle).")
 
 
 class P2PMetrics:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from fractions import Fraction
 
+from tendermint_tpu.crypto import scheduler as vsched
 from tendermint_tpu.types.basic import Timestamp
 from tendermint_tpu.types.light_block import LightValidationError, SignedHeader
 from tendermint_tpu.types.validator_set import (CommitVerifyError,
@@ -110,9 +111,13 @@ def verify_adjacent(trusted: SignedHeader, untrusted: SignedHeader,
             f"({trusted.header.next_validators_hash.hex()}) to match those "
             f"from new header ({untrusted.header.validators_hash.hex()})")
     try:
-        untrusted_vals.verify_commit_light(
-            trusted.header.chain_id, untrusted.commit.block_id,
-            untrusted.height, untrusted.commit)
+        # commit/light class on the shared verify scheduler: the batched
+        # check (validator_set -> crypto/batch.verify_sigs_bulk) rides
+        # the cross-consumer coalescing window at COMMIT priority
+        with vsched.priority_context(vsched.Priority.COMMIT):
+            untrusted_vals.verify_commit_light(
+                trusted.header.chain_id, untrusted.commit.block_id,
+                untrusted.height, untrusted.commit)
     except CommitVerifyError as e:
         raise InvalidHeaderError(str(e))
 
@@ -134,17 +139,19 @@ def verify_non_adjacent(trusted: SignedHeader, trusted_vals: ValidatorSet,
     _verify_new_header_and_vals(untrusted, untrusted_vals, trusted, now,
                                 max_clock_drift_s)
     try:
-        trusted_vals.verify_commit_light_trusting(
-            trusted.header.chain_id, untrusted.commit, trust_level)
+        with vsched.priority_context(vsched.Priority.COMMIT):
+            trusted_vals.verify_commit_light_trusting(
+                trusted.header.chain_id, untrusted.commit, trust_level)
     except NotEnoughVotingPowerError as e:
         raise NewValSetCantBeTrustedError(str(e))
     except CommitVerifyError as e:
         raise LightError(str(e))
     # last check on purpose: untrusted_vals can be made large to DoS
     try:
-        untrusted_vals.verify_commit_light(
-            trusted.header.chain_id, untrusted.commit.block_id,
-            untrusted.height, untrusted.commit)
+        with vsched.priority_context(vsched.Priority.COMMIT):
+            untrusted_vals.verify_commit_light(
+                trusted.header.chain_id, untrusted.commit.block_id,
+                untrusted.height, untrusted.commit)
     except CommitVerifyError as e:
         raise InvalidHeaderError(str(e))
 

@@ -413,6 +413,23 @@ class Node(BaseService):
         from tendermint_tpu.crypto import degrade
         self._breaker_unsub = degrade.runtime().breaker.add_listener(
             self._on_breaker_transition)
+        # process-global verify scheduler (crypto/scheduler.py): the
+        # first node in the process installs + starts it; every verify
+        # consumer then coalesces through it.  A later node (multi-node
+        # tests) shares the installed one; when the owning node stops,
+        # the others' call sites fall back to their direct paths.
+        self._verify_sched = None
+        from tendermint_tpu.crypto import scheduler as vsched
+        vs = self.config.verify_scheduler
+        if vs.enable and vsched.installed() is None:
+            self._verify_sched = vsched.install(vsched.VerifyScheduler(
+                window_s=vs.window_ms / 1000.0,
+                max_batch=vs.max_batch, max_pending=vs.max_pending,
+                tpu_threshold=self.config.batch_verifier.tpu_threshold))
+            self._verify_sched.start()
+            self.log.info("verify scheduler started",
+                          window_ms=vs.window_ms, max_batch=vs.max_batch,
+                          max_pending=vs.max_pending)
         # the node's config decides the cofactored RLC fast path in BOTH
         # directions: a stale TM_TPU_RLC=1 env must not override an
         # operator's rlc=false (the env remains the knob only for
@@ -509,6 +526,11 @@ class Node(BaseService):
         if getattr(self, "_breaker_unsub", None) is not None:
             self._breaker_unsub()
             self._breaker_unsub = None
+        if getattr(self, "_verify_sched", None) is not None:
+            from tendermint_tpu.crypto import scheduler as vsched
+            self._verify_sched.stop()
+            vsched.uninstall(self._verify_sched)
+            self._verify_sched = None
         self.indexer_service.stop()
         if self.grpc_server is not None:
             self.grpc_server.stop()

@@ -21,3 +21,34 @@ import tendermint_tpu  # noqa: E402  (sets compilation-cache env defaults)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks():
+    """Every worker thread in this codebase must either be a daemon
+    (service.spawn, the degrade lane worker) or be joined by the test
+    that started it.  A NON-daemon thread that survives a test is a
+    leak: it blocks interpreter shutdown behind whatever it is wedged
+    on and accumulates across the tier-1 run (the VerifyScheduler /
+    degradation-runtime workers in particular must stop cleanly)."""
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon
+                and t is not threading.main_thread() and t not in before]
+
+    # grace for executors/servers that are mid-shutdown at teardown
+    deadline = time.monotonic() + 5.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    rest = leaked()
+    assert not rest, (
+        f"non-daemon threads leaked by this test: "
+        f"{[t.name for t in rest]}")

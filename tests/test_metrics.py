@@ -74,8 +74,12 @@ _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
 
 
 def _unescape(v: str) -> str:
-    return (v.replace("\\n", "\n").replace('\\"', '"')
-            .replace("\\\\", "\\"))
+    # single-pass: sequential str.replace would mis-decode a literal
+    # backslash followed by 'n' ("dir\\name" -> "dir\name" is correct;
+    # replace("\\n", "\n") first would yield "dir<newline>ame")
+    return re.sub(r'\\(\\|n|")',
+                  lambda m: {"\\": "\\", "n": "\n", '"': '"'}[m.group(1)],
+                  v)
 
 
 def _parse_exposition(text: str):

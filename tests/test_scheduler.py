@@ -1,0 +1,468 @@
+"""VerifyScheduler (crypto/scheduler.py): cross-consumer coalescing,
+priority/shed policy, deadline flush, dedupe, sync-wrapper bitmap
+identity, and chaos through the pipelined device path (ISSUE 4).
+
+The device lane is the XLA kernel forced onto CPU (TM_TPU_FORCE_BATCH=1,
+same trick as the chaos matrix): everything the scheduler adds sits
+strictly above the kernel, and the nb=64 padded lane bucket is shared
+with the rest of tier-1 so no new kernel shapes are compiled here."""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from helpers import Node, build_chain, make_genesis
+from tendermint_tpu.crypto import batch as cb
+from tendermint_tpu.crypto import degrade
+from tendermint_tpu.crypto import ed25519 as edkeys
+from tendermint_tpu.crypto import scheduler as vs
+from tendermint_tpu.libs import fail, trace
+from tendermint_tpu.libs.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fail.reset()
+    yield
+    fail.reset()
+    vs.uninstall()
+    degrade.reset()
+
+
+@pytest.fixture
+def sched():
+    """Factory: build + install + start a scheduler; stopped at
+    teardown (the conftest thread-leak guard checks the workers die)."""
+    created = []
+
+    def make(**kw):
+        s = vs.VerifyScheduler(**kw)
+        created.append(s)
+        vs.install(s)
+        s.start()
+        return s
+
+    yield make
+    for s in created:
+        s.stop()
+    vs.uninstall()
+
+
+def _signed(n, tag=b"sched", bad=()):
+    privs = [edkeys.PrivKey(bytes([(i * 7 + 3) % 255 + 1]) * 32)
+             for i in range(n)]
+    msgs = [tag + b" item %d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    for i in bad:
+        sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]
+    return [(p.pub_key(), m, s) for p, m, s in zip(privs, msgs, sigs)]
+
+
+def _direct_bits(items):
+    bv = cb.BatchVerifier()
+    for pub, m, s in items:
+        bv.add(pub, m, s)
+    return bv.verify()[1]
+
+
+# ---------------------------------------------------------------------------
+# sync wrapper / fallback semantics
+# ---------------------------------------------------------------------------
+
+def test_sync_wrapper_bitmap_identity(sched):
+    """verify_items through a running scheduler returns the exact
+    (all_ok, bitmap) the direct BatchVerifier path returns — including
+    invalid and malformed-length lanes."""
+    items = _signed(24, tag=b"identity", bad=(2, 9, 17))
+    pub, m, s = items[5]
+    items[5] = (pub, m, s[:40])  # truncated = invalid, never an error
+    expect = _direct_bits(items)
+
+    sched(window_s=0.0)
+    ok, bits = vs.verify_items(items)
+    assert bits.tolist() == expect.tolist()
+    assert ok == bool(expect.all()) is False
+
+
+def test_wrapper_and_bulk_fall_back_when_not_running():
+    items = _signed(8, tag=b"fallback")
+    assert vs.running() is None
+    ok, bits = vs.verify_items(items)
+    assert ok and bits.all() and len(bits) == 8
+
+    s = vs.install(vs.VerifyScheduler(window_s=0.0))
+    s.start()
+    s.stop()  # stopped-but-installed: submit resolves with the error
+    fut = s.submit(items)
+    with pytest.raises(vs.SchedulerError):
+        fut.result(timeout=5)
+    ok, bits = vs.verify_items(items)  # wrapper silently degrades
+    assert ok and bits.all()
+
+
+def test_bulk_routes_through_scheduler(sched):
+    s = sched(window_s=0.0)
+    items = _signed(9, tag=b"bulk", bad=(4,))
+    pubs = [p for p, _, _ in items]
+    msgs = [m for _, m, _ in items]
+    sigs = [sg for _, _, sg in items]
+    bits = cb.verify_sigs_bulk(pubs, msgs, sigs)
+    assert bits.tolist() == [True] * 4 + [False] + [True] * 4
+    assert s.stats()["submissions"] == 1
+
+    # the raw (n, 32) pubkey-matrix input is the validator-set per-block
+    # fast path (device-resident key cache): it must KEEP the direct
+    # route, identical bitmap, no scheduler submission
+    mat = np.frombuffer(b"".join(p.bytes() for p in pubs),
+                        dtype=np.uint8).reshape(-1, 32)
+    bits2 = cb.verify_sigs_bulk(mat, msgs, sigs)
+    assert bits2.tolist() == bits.tolist()
+    assert s.stats()["submissions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# queueing policy
+# ---------------------------------------------------------------------------
+
+def test_priority_ordering_under_full_queue(sched):
+    """With more pending than one launch can take, the drain is strictly
+    by class: a CONSENSUS submission entering last still rides the next
+    launch while queued MEMPOOL work waits."""
+    s = sched(window_s=30.0, max_batch=16)
+    mp = [s.submit(_signed(5, tag=b"mp%d" % i), vs.Priority.MEMPOOL)
+          for i in range(3)]
+    hi = s.submit(_signed(8, tag=b"consensus"), vs.Priority.CONSENSUS)
+
+    s.flush()
+    assert hi.result(timeout=30).all()
+    # the launch that carried consensus topped up with older mempool
+    # work; the newest mempool submission (5 items, below every flush
+    # trigger) is still queued behind the 30 s window
+    assert not mp[-1].done()
+    deadline = time.monotonic() + 30
+    while not all(f.done() for f in mp):
+        s.flush()
+        time.sleep(0.02)
+        assert time.monotonic() < deadline
+    for f in mp:
+        assert f.result(timeout=1).all()
+
+
+def test_shed_policy_accounting(sched):
+    reg = Registry("shed")
+    degrade.configure(registry=reg)
+    s = sched(window_s=30.0, max_pending=16)
+    m = degrade.runtime().metrics
+
+    keep = s.submit(_signed(10, tag=b"keep"), vs.Priority.MEMPOOL)
+    shed = s.submit(_signed(10, tag=b"shed"), vs.Priority.MEMPOOL)
+    with pytest.raises(vs.SchedulerShedError):
+        shed.result(timeout=1)
+    assert m.sched_shed_total.value(priority="mempool") == 1
+
+    # a higher class over the bound evicts QUEUED mempool work instead
+    hi = s.submit(_signed(10, tag=b"hi"), vs.Priority.CONSENSUS)
+    with pytest.raises(vs.SchedulerShedError):
+        keep.result(timeout=1)
+    assert m.sched_shed_total.value(priority="mempool") == 2
+    st = s.stats()
+    assert st["shed"] == 2 and st["evicted"] == 1
+    s.flush()
+    assert hi.result(timeout=30).all()
+
+
+def test_deadline_flushes_before_window(sched):
+    s = sched(window_s=30.0)
+    t0 = time.monotonic()
+    fut = s.submit(_signed(6, tag=b"deadline"), vs.Priority.CONSENSUS,
+                   deadline=time.monotonic() + 0.05)
+    assert fut.result(timeout=10).all()
+    assert time.monotonic() - t0 < 5.0  # window alone would be 30 s
+
+
+def test_dedupe_of_concurrent_identical_triples(sched):
+    s = sched(window_s=0.3)
+    items = _signed(8, tag=b"dup", bad=(3,))
+    barrier = threading.Barrier(2)
+    outs = [None, None]
+
+    def worker(k):
+        barrier.wait()
+        outs[k] = s.submit(items, vs.Priority.BLOCKSYNC).result(timeout=30)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert outs[0].tolist() == outs[1].tolist() \
+        == [True, True, True, False, True, True, True, True]
+    st = s.stats()
+    # 16 items in, 8 lanes verified once, 8 collapsed onto them
+    assert st["launches"] == 1 and st["lanes"] == 8 and st["dedup"] == 8
+
+
+def test_sigcache_hits_skip_lanes(sched):
+    s = sched(window_s=0.0)
+    items = _signed(8, tag=b"cached")
+    assert s.submit(items).result(timeout=30).all()
+    assert s.submit(items).result(timeout=30).all()
+    st = s.stats()
+    assert st["cache_hits"] == 8 and st["lanes"] == 8
+
+
+def test_stager_survives_a_poisoned_window(sched, monkeypatch):
+    """One staging exception must fail THAT window's futures (sending
+    sync wrappers to the direct path) without killing the stager — the
+    next submission still coalesces and resolves normally."""
+    s = sched(window_s=0.0)
+    real_stage = s._stage
+    boom = {"armed": True}
+
+    def stage(subs):
+        if boom.pop("armed", False):
+            raise RuntimeError("injected staging fault")
+        return real_stage(subs)
+
+    monkeypatch.setattr(s, "_stage", stage)
+    items = _signed(6, tag=b"poison", bad=(1,))
+    with pytest.raises(vs.SchedulerError, match="staging failed"):
+        s.submit(items, vs.Priority.BLOCKSYNC).result(timeout=30)
+    # the wrapper's contract: same call falls back to the direct path
+    ok, bits = vs.verify_items(items, vs.Priority.BLOCKSYNC)
+    assert bits.tolist() == _direct_bits(items).tolist() and not ok
+    assert s.stats()["launches"] == 1  # the retry window launched
+
+
+def test_submit_malformed_pub_lands_on_future(sched):
+    """submit() raises nothing: a raw pub of the wrong length surfaces
+    at result(), not synchronously out of submit()."""
+    s = sched(window_s=0.0)
+    fut = s.submit([(b"\x01" * 31, b"msg", b"\x02" * 64)])
+    assert fut.done()
+    with pytest.raises(ValueError):
+        fut.result(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# chaos through the pipelined device path
+# ---------------------------------------------------------------------------
+
+def _chaos_runtime(clk):
+    cfg = degrade.DegradeConfig(
+        failure_threshold=2, launch_timeout_s=120.0,
+        backoff_base_s=10.0, backoff_max_s=100.0, backoff_jitter=0.0)
+    return degrade.configure(cfg, clock=lambda: clk[0],
+                             registry=Registry("schedchaos"))
+
+
+@pytest.mark.parametrize("mode,reason", [
+    ("raise", "raise"),
+    ("corrupt-bitmap", "integrity"),
+])
+def test_chaos_pipelined_path_preserves_bitmaps(sched, monkeypatch,
+                                                mode, reason):
+    """An injected device fault inside the scheduler's coalesced launch
+    degrades through crypto/degrade.py: exact bitmap from the host
+    re-verify, failure counted at the sched site, breaker opens after
+    the threshold and subsequent launches fall back without the device.
+    """
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.delenv("TM_TPU_DISABLE_BATCH", raising=False)
+    clk = [0.0]
+    rt = _chaos_runtime(clk)
+    s = sched(window_s=0.0, tpu_threshold=4)
+    items = _signed(40, tag=b"chaos " + mode.encode(), bad=(1, 13, 37))
+    expect = [i not in (1, 13, 37) for i in range(40)]
+
+    fail.set_mode("sched.ed25519", mode)
+    try:
+        for k in range(2):  # failure_threshold=2 -> breaker opens
+            # generous timeout: the FIRST device dispatch in a fresh
+            # process pays the nb=64 kernel compile (40-300 s on a cold
+            # XLA cache) before the injected fault even fires
+            bits = s.submit(items, vs.Priority.BLOCKSYNC,
+                            populate_cache=False).result(timeout=420)
+            assert bits.tolist() == expect, f"launch {k} bitmap drifted"
+        assert rt.breaker.state == degrade.OPEN
+        assert rt.metrics.device_failures.value(
+            site="sched.ed25519", reason=reason) == 2
+        assert rt.metrics.host_fallbacks.value(
+            site="sched.ed25519", reason=reason) == 2
+        # breaker open: the next coalesced launch never touches the lane
+        bits = s.submit(items, vs.Priority.BLOCKSYNC,
+                        populate_cache=False).result(timeout=420)
+        assert bits.tolist() == expect
+        assert rt.metrics.host_fallbacks.value(
+            site="sched.ed25519", reason="breaker_open") == 1
+    finally:
+        fail.clear()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: three real consumers, one coalesced launch
+# ---------------------------------------------------------------------------
+
+def _replay_fixture(n_vals=8, n_blocks=3):
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.libs.kvdb import MemDB
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    gdoc, privs = make_genesis(n_vals)
+    blocks, commits, _states = build_chain(gdoc, privs, n_blocks)
+    ex = BlockExecutor(StateStore(MemDB()), KVStoreApplication())
+    store = BlockStore(MemDB())
+    state = state_from_genesis(gdoc)
+    return ex, store, state, blocks, commits
+
+
+def _light_fixture(n_vals=6, n_blocks=5):
+    from tendermint_tpu.types.light_block import SignedHeader
+
+    gdoc, privs = make_genesis(n_vals)
+    blocks, commits, states = build_chain(gdoc, privs, n_blocks)
+    shs = {b.header.height: SignedHeader(b.header, commits[i])
+           for i, b in enumerate(blocks)}
+    vals = {b.header.height: states[i].validators
+            for i, b in enumerate(blocks)}
+    return shs, vals
+
+
+def _prevote_batch(gdoc, privs, cs):
+    from tendermint_tpu.consensus.round_types import VoteMessage
+    from tendermint_tpu.types.basic import (BlockID, PartSetHeader,
+                                            SignedMsgType, Timestamp)
+    from tendermint_tpu.types.vote import Vote
+
+    bid = BlockID(hash=bytes([5] * 32),
+                  part_set_header=PartSetHeader(1, bytes([6] * 32)))
+    vals = cs.state.validators
+    by_addr = {p.pub_key().address(): p for p in privs}
+    out = []
+    for idx in range(vals.size()):
+        addr, _val = vals.get_by_index(idx)
+        v = Vote(type=SignedMsgType.PREVOTE, height=cs.rs.height, round=0,
+                 block_id=bid, timestamp=Timestamp(1700000100, idx),
+                 validator_address=addr, validator_index=idx)
+        v.signature = by_addr[addr].sign(v.sign_bytes(gdoc.chain_id))
+        out.append((VoteMessage(v), f"peer{idx}"))
+    return out
+
+
+def test_three_consumers_one_coalesced_launch(sched, monkeypatch):
+    """ISSUE 4 acceptance: consensus vote preverify, a light-client
+    commit check, and a blocksync replay window submit concurrently and
+    resolve from a SINGLE coalesced device launch (observed via the
+    flight recorder and ops/ed25519.last_launch()), inside the shared
+    padded nb=64 lane bucket with no new compile (first_launch False),
+    with every consumer observing its synchronous-path outcome."""
+    from tendermint_tpu.blocksync.replay import replay_window
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.light import verifier as light_verifier
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.types.basic import Timestamp
+
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.delenv("TM_TPU_DISABLE_BATCH", raising=False)
+    degrade.configure(registry=Registry("coalesce"))
+
+    # consumers (built BEFORE the clock starts: only verification runs
+    # inside the window).  Distinct validator-set sizes keep the three
+    # consumers' triples distinct, so lane counts are meaningful.
+    gdoc_a, privs_a = make_genesis(14)
+    node_a = Node(gdoc_a, privs_a[0])
+    batch_a = _prevote_batch(gdoc_a, privs_a, node_a.cs)
+    shs, lvals = _light_fixture(n_vals=6)
+    ex, store, st0, blocks, commits = _replay_fixture(n_vals=8, n_blocks=3)
+
+    # warm the shared nb=64 bucket through the plain BatchVerifier path
+    # so the coalesced launch below must REUSE it (first_launch False =
+    # the compile-split attr proves no new XLA shape)
+    warm = _signed(40, tag=b"warmup")
+    assert _direct_bits(warm).all()
+
+    # building the chains above pre-verified (and cached) many of the
+    # fixtures' triples; drop them so every consumer's work below needs
+    # real lanes — otherwise the scheduler's SigCache dedupe resolves
+    # most of the batch without the device (correct, but not this test)
+    with cb.verified_sigs._lock:
+        cb.verified_sigs._set.clear()
+
+    trace.enable(capacity=1 << 14)
+    seq0 = trace.last_seq()
+    # a long window + matching preverify deadline: all three consumers
+    # submit well inside it, deterministically coalescing
+    monkeypatch.setattr(ConsensusState, "PREVERIFY_DEADLINE_S", 1.0)
+    s = sched(window_s=1.0)
+
+    results = {}
+    errors = []
+    barrier = threading.Barrier(3)
+
+    def consumer(name, fn):
+        def run():
+            barrier.wait()
+            try:
+                results[name] = fn()
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append((name, e))
+        return threading.Thread(target=run, name=f"consumer-{name}")
+
+    threads = [
+        consumer("preverify",
+                 lambda: node_a.cs._preverify_votes(batch_a)),
+        consumer("light", lambda: light_verifier.verify_adjacent(
+            shs[3], shs[4], lvals[4], 3600.0 * 24 * 14,
+            Timestamp(1700005000, 0), 10.0)),
+        consumer("replay", lambda: replay_window(
+            ex, store, st0, blocks, commits)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    trace.disable()
+    assert not errors, errors
+
+    # every consumer got its synchronous-path outcome
+    _state, applied = results["replay"]
+    assert applied == 3
+    for msg, _peer in batch_a:  # preverify populated the SigCache
+        v = msg.vote
+        _addr, val = node_a.cs.state.validators.get_by_index(
+            v.validator_index)
+        assert cb.verified_sigs.hit(val.pub_key.bytes(),
+                                    v.sign_bytes(gdoc_a.chain_id),
+                                    v.signature)
+
+    # ONE device launch carried all three consumers
+    spans = trace.snapshot(since=seq0)
+    launches = [r for r in spans if r["name"] == "device.launch"]
+    assert len(launches) == 1, [r["attrs"] for r in launches]
+    assert launches[0]["attrs"]["site"] == "sched.ed25519"
+    sched_launches = [r for r in spans if r["name"] == "sched.launch"]
+    assert len(sched_launches) == 1
+    n_lanes = sched_launches[0]["attrs"]["n"]
+    assert 32 <= n_lanes <= 64, n_lanes
+
+    rec = edops.last_launch()
+    assert rec["nb"] == 64, rec            # shared padded lane bucket
+    assert rec["first_launch"] is False, rec  # no new XLA compile shape
+    assert s.stats()["launches"] == 1
+
+    # and the consumers behave identically on the direct sync path
+    s.stop()
+    vs.uninstall()
+    light_verifier.verify_adjacent(
+        shs[3], shs[4], lvals[4], 3600.0 * 24 * 14,
+        Timestamp(1700005000, 0), 10.0)
+    ex2, store2, st2, blocks2, commits2 = _replay_fixture(
+        n_vals=8, n_blocks=3)
+    _state2, applied2 = replay_window(ex2, store2, st2, blocks2, commits2)
+    assert applied2 == 3
