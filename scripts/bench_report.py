@@ -448,18 +448,79 @@ def config8_scheduler(n_subs=16, per_sub=64):
             **_launch_cols(base)}
 
 
+def config9_comb(n=8192):
+    """Fixed-base comb verify (ops/ed25519, ADR-013) against the Straus
+    ladder on the SAME known-validator-set batch, both through the
+    production verify_batch seam.  Reports which path actually ran (the
+    comb only counts when the launch record says so) plus the per-lane
+    group-op inventory — the honest "3x fewer group ops, zero doublings"
+    evidence, or its absence."""
+    import jax
+
+    from bench import _make_batch_selfhosted
+    from tendermint_tpu.ops import ed25519 as edops
+
+    if jax.default_backend() == "cpu":
+        return {"config": f"9: fixed-base comb ({n} sigs)",
+                "note": "device unavailable (cpu backend), skipped"}
+
+    pubs, msgs, sigs = _make_batch_selfhosted(n)
+    prev = edops._comb_enabled_override
+    edops.set_comb_config(enabled=True)
+    try:
+        # warm: builds the table set + compiles the comb bucket
+        assert edops.verify_batch(pubs, msgs, sigs, cache_pubs=True).all()
+        rec = edops.last_launch()
+        engaged = str(rec.get("path", "")).endswith("comb")
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            assert edops.verify_batch(pubs, msgs, sigs,
+                                      cache_pubs=True).all()
+        comb_dt = (time.perf_counter() - t0) / reps
+        rec = edops.last_launch()
+
+        edops._comb_enabled_override = False
+        assert edops.verify_batch(pubs, msgs, sigs,
+                                  cache_pubs=True).all()  # warm ladder
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            assert edops.verify_batch(pubs, msgs, sigs,
+                                      cache_pubs=True).all()
+        ladder_dt = (time.perf_counter() - t0) / reps
+    finally:
+        edops._comb_enabled_override = prev
+    return {"config": f"9: fixed-base comb ({n} sigs)",
+            "comb_s": round(comb_dt, 3),
+            "sigs_per_s": round(n / comb_dt),
+            "ladder_s": round(ladder_dt, 3),
+            "speedup_vs_ladder": round(ladder_dt / comb_dt, 2),
+            "engaged": engaged,
+            "path": rec.get("path"), "shards": rec.get("shards"),
+            "occupancy": rec.get("occupancy"),
+            "group_ops": rec.get("group_ops")}
+
+
 def main():
     import json
 
-    import jax
+    # bounded-time probe shared with bench.py: a wedged tunnel can HANG
+    # backend init (not just raise), and the report must degrade either
+    # way instead of stalling before its first line of output
+    from bench import _probe_backend
+    platform, probe_err = _probe_backend()
+    if probe_err is not None:
+        print(f"# platform=unavailable ({probe_err}) — "
+              f"device configs skipped", flush=True)
+        return
     try:
         cpu_line = f"cpu_openssl={_cpu_verify_rate():.0f}/s"
     except ImportError:  # no `cryptography` on this host: degrade
         cpu_line = "cpu_openssl=unavailable (no cryptography package)"
-    print(f"# platform={jax.devices()[0].platform} {cpu_line}", flush=True)
+    print(f"# platform={platform} {cpu_line}", flush=True)
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
-           config8_scheduler)
+           config8_scheduler, config9_comb)
     only = os.environ.get("BENCH_ONLY", "")
     for fn in fns:
         if only and only not in fn.__name__:

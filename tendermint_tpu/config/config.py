@@ -130,6 +130,25 @@ class BatchVerifierConfig:
     # production path and the device lane only pays off with a
     # co-located chip.
     secp_lane: bool = False
+    # fixed-base comb verify path (ops/ed25519, ADR-013): per-validator
+    # window tables kept device-resident so known-set batches verify
+    # with zero doublings.  ON by default — the verdict is the exact
+    # cofactorless check either way; `comb = false` forces the ladder.
+    comb: bool = True
+    # HBM budget for the comb table cache, MB (LRU by validator-set
+    # content hash; one padded key costs ~198 KB, so 256 MB holds ~1.3k
+    # validator keys).  0 disables table builds entirely.
+    table_cache_mb: int = 256
+
+    def validate_basic(self):
+        # 0 is meaningful (every batch routes to the device lane); only
+        # negatives are nonsense
+        if self.tpu_threshold < 0:
+            raise ValueError("batch_verifier.tpu_threshold must be "
+                             ">= 0")
+        if self.table_cache_mb < 0:
+            raise ValueError("batch_verifier.table_cache_mb must be "
+                             ">= 0")
 
 
 @dataclass
@@ -183,7 +202,7 @@ class Config:
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
-                     "verify_scheduler"):
+                     "batch_verifier", "verify_scheduler"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -291,6 +310,8 @@ tpu_threshold = {self.batch_verifier.tpu_threshold}
 enable = {str(self.batch_verifier.enable).lower()}
 rlc = {str(self.batch_verifier.rlc).lower()}
 secp_lane = {str(self.batch_verifier.secp_lane).lower()}
+comb = {str(self.batch_verifier.comb).lower()}
+table_cache_mb = {self.batch_verifier.table_cache_mb}
 
 [verify_scheduler]
 enable = {str(self.verify_scheduler.enable).lower()}
@@ -370,7 +391,9 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             tpu_threshold=bv.get("tpu_threshold", 32),
             enable=bv.get("enable", True),
             rlc=bool(bv.get("rlc", False)),
-            secp_lane=bool(bv.get("secp_lane", False)))
+            secp_lane=bool(bv.get("secp_lane", False)),
+            comb=bool(bv.get("comb", True)),
+            table_cache_mb=int(bv.get("table_cache_mb", 256)))
         vs = d.get("verify_scheduler", {})
         cfg.verify_scheduler = VerifySchedulerConfig(
             enable=bool(vs.get("enable", True)),

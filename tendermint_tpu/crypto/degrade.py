@@ -482,3 +482,22 @@ def publish_route(path, outcome, n=None, nb=None, compile_s=None):
             m.device_compile_seconds.observe(compile_s, site=str(path))
     except Exception:  # noqa: BLE001 - metrics are best-effort here
         pass
+
+
+def publish_table_cache(bytes_=None, hit=None, evicted=None):
+    """Bridge from the comb table cache (ops/ed25519, ADR-013) into
+    CryptoMetrics: resident bytes gauge, hit/eviction counters.  Comb
+    LAUNCHES need no bridge of their own — they dispatch through the
+    same _record_launch/publish_route seam (path=comb), under the same
+    breaker/timeout/host-fallback lane as every other device launch.
+    Swallows everything, same contract as publish_route."""
+    try:
+        m = runtime().metrics
+        if bytes_ is not None:
+            m.table_cache_bytes.set(float(bytes_))
+        if hit:
+            m.table_hits.inc()
+        if evicted:
+            m.table_evictions.inc()
+    except Exception:  # noqa: BLE001 - metrics are best-effort here
+        pass

@@ -513,6 +513,12 @@ class VerifyScheduler(BaseService):
                         cache_hits=launch.cache_hits) as sp:
             rt = degrade.runtime() \
                 if n >= self.tpu_threshold else None
+            # latch the flag once: trace.enable() mid-launch must not
+            # make the post-collect bracket dereference an unbound seq0
+            tracing = trace.is_enabled()
+            if tracing:
+                from tendermint_tpu.ops import ed25519 as _edops
+                seq0 = _edops.last_launch().get("seq", 0)
             device_lanes = []
             host_lanes = []
             for tname, idxs in by_scheme.items():
@@ -532,7 +538,7 @@ class VerifyScheduler(BaseService):
                     rt.metrics.host_fallbacks.inc(
                         site=f"sched.{tname}", reason="breaker_open")
                 host_lanes.append((tname, idxs, items))
-            if trace.is_enabled():
+            if tracing:
                 sp.add(device_lanes=len(device_lanes),
                        host_lanes=len(host_lanes))
             try:
@@ -554,6 +560,16 @@ class VerifyScheduler(BaseService):
                         host_fn=partial(_batch._host_verify_items,
                                         tname, items, assume_miss=True),
                         spot_check=_batch._spot_check_items(items))
+            if tracing and len(device_lanes) == 1:
+                # which kernel family the window's device lane actually
+                # took (comb when it resolved to a cached validator set,
+                # ladder otherwise).  last_launch() is process-global,
+                # so only annotate when exactly OUR launch landed since
+                # the bracket started (seq advanced by 1) — a concurrent
+                # verifier's record must not mislabel this window
+                rec = _edops.last_launch()
+                if rec.get("seq", 0) == seq0 + 1:
+                    sp.add(route=rec.get("path"))
         try:
             self._metrics().sched_batch_size.observe(float(n))
         except Exception:  # noqa: BLE001

@@ -440,6 +440,12 @@ class Node(BaseService):
         # config wins over any stale env in BOTH directions
         from tendermint_tpu.ops import secp as secp_ops
         secp_ops.set_lane_enabled(self.config.batch_verifier.secp_lane)
+        # fixed-base comb path + its HBM budget (ops/ed25519, ADR-013):
+        # config wins over env, either way
+        from tendermint_tpu.ops import ed25519 as edops
+        edops.set_comb_config(
+            enabled=self.config.batch_verifier.comb,
+            table_cache_mb=self.config.batch_verifier.table_cache_mb)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,

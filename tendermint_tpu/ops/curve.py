@@ -31,6 +31,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from . import field as F
@@ -203,6 +204,56 @@ def cond_neg_niels(q: Niels, neg) -> Niels:
         F.select(neg, q.ypx, q.ymx),
         F.select(neg, -q.t2d, q.t2d),
     )
+
+
+# ---------------------------------------------------------------------------
+# window tables
+# ---------------------------------------------------------------------------
+
+def cached_window(p: Ext):
+    """Cached multiples j*p for j = 0..8 stacked on axis 0 (each field
+    (9, NLIMB, *batch)), plus 8*p in extended form.
+
+    This is the signed-radix-16 window unit shared by the per-launch
+    variable-base table of the Straus ladder (ops/ed25519._build_var_table)
+    and the fixed-base comb table scan below: 4 doublings + 3 additions
+    per window, identity at j = 0 so a digit gather needs no masking."""
+    a1 = p
+    a2 = dbl(a1)
+    c1 = to_cached(a1)
+    a3 = add_cached(a2, c1)
+    a4 = dbl(a2)
+    a5 = add_cached(a4, c1)
+    a6 = dbl(a3)
+    a7 = add_cached(a6, c1)
+    a8 = dbl(a4)
+    batch = p.x.shape[1:]
+    ident = Cached(F.one(batch), F.one(batch), F.one(batch), F.zero(batch))
+    entries = [ident, c1] + [to_cached(q) for q in (a2, a3, a4, a5, a6, a7)]
+    entries.append(to_cached(a8))
+    tab = Cached(*(jnp.stack([getattr(e, f) for e in entries], axis=0)
+                   for f in ("ypx", "ymx", "z", "t2d")))
+    return tab, a8
+
+
+def comb_table_scan(p: Ext, windows: int = 64):
+    """Fixed-base comb tables for a batch of base points: for each window
+    i in 0..windows-1 and digit j in 0..8, entry [i, j] = [j * 16^i] * p
+    in cached form — each field (windows, 9, NLIMB, *batch).
+
+    One lax.scan whose carry is the running base [16^i] * p: each step
+    emits cached_window(carry) and advances the carry by one doubling of
+    the 8x entry (16^{i+1} = 2 * 8 * 16^i).  This is the one-time,
+    on-device table build of the comb verify path (ADR-013): after it, a
+    full double-scalar multiply against this base costs `windows` gathers
+    + additions and ZERO doublings."""
+
+    def step(g, _):
+        tab, a8 = cached_window(g)
+        return dbl(a8), tab
+
+    _, rows = jax.lax.scan(step, p, None, length=windows)
+    return rows  # Cached, fields stacked (windows, 9, NLIMB, *batch)
 
 
 # ---------------------------------------------------------------------------

@@ -48,25 +48,75 @@ L = (1 << 252) + 27742317777372353535851937790883648493
 def _affine_niels_ints(x: int, y: int):
     return ((y + x) % C.P, (y - x) % C.P, 2 * C.D_INT * x % C.P * y % C.P)
 
-def _base_table_np():
-    # python bignum point arithmetic for the static table
-    def edwards_add(p, q):
-        x1, y1 = p; x2, y2 = q
-        x3 = (x1 * y2 + x2 * y1) * pow(1 + C.D_INT * x1 * x2 * y1 * y2, C.P - 2, C.P)
-        y3 = (y1 * y2 + x1 * x2) * pow(1 - C.D_INT * x1 * x2 * y1 * y2, C.P - 2, C.P)
-        return (x3 % C.P, y3 % C.P)
-    bpt = (C.BX_INT, C.BY_INT)
+def _edwards_add_int(p, q):
+    """Affine edwards addition in Python bignum (import-time/lazy static
+    table construction only)."""
+    x1, y1 = p
+    x2, y2 = q
+    den = C.D_INT * x1 * x2 % C.P * y1 % C.P * y2 % C.P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + den, C.P - 2, C.P)
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - den, C.P - 2, C.P)
+    return (x3 % C.P, y3 % C.P)
+
+def _niels_rows(pts):
+    """[(x, y)] -> ((len, NLIMB) ypx, ymx, t2d) numpy niels limb rows."""
+    ypx = np.stack([F.int_to_limbs((y + x) % C.P) for x, y in pts])
+    ymx = np.stack([F.int_to_limbs((y - x) % C.P) for x, y in pts])
+    t2d = np.stack([F.int_to_limbs(C.D2_INT * x % C.P * y % C.P)
+                    for x, y in pts])
+    return ypx, ymx, t2d
+
+def _window_pts(base):
+    """[j * base] for j = 0..8 — the signed-radix-16 window points of
+    one table row, shared by the static ladder table and the comb."""
     pts = [(0, 1)]
     acc = (0, 1)
     for _ in range(8):
-        acc = edwards_add(acc, bpt)
+        acc = _edwards_add_int(acc, base)
         pts.append(acc)
-    ypx = np.stack([F.int_to_limbs((y + x) % C.P) for x, y in pts])
-    ymx = np.stack([F.int_to_limbs((y - x) % C.P) for x, y in pts])
-    t2d = np.stack([F.int_to_limbs(C.D2_INT * x % C.P * y % C.P) for x, y in pts])
-    return ypx, ymx, t2d  # each (9, NLIMB)
+    return pts
+
+
+def _base_table_np():
+    # python bignum point arithmetic for the static table
+    return _niels_rows(_window_pts((C.BX_INT, C.BY_INT)))  # each (9, NLIMB)
 
 _BASE_YPX, _BASE_YMX, _BASE_T2D = (jnp.asarray(t) for t in _base_table_np())
+
+
+# ---------------------------------------------------------------------------
+# fixed-base comb tables for B: [j * 16^i] B for i = 0..63, j = 0..8, in
+# niels form — the basepoint half of the comb verify path (ADR-013).
+# Built lazily on first comb use (~512 bignum adds, tens of ms): the
+# ladder path, which most test processes are, never pays for it.
+# ---------------------------------------------------------------------------
+
+COMB_WINDOWS = 64
+
+_base_comb_lock = threading.Lock()
+_base_comb_cache = None
+
+
+def _base_comb_np():
+    ypx = np.zeros((COMB_WINDOWS, 9, F.NLIMB), dtype=np.int32)
+    ymx = np.zeros_like(ypx)
+    t2d = np.zeros_like(ypx)
+    base = (C.BX_INT, C.BY_INT)
+    for i in range(COMB_WINDOWS):
+        pts = _window_pts(base)
+        ypx[i], ymx[i], t2d[i] = _niels_rows(pts)
+        # 16^{i+1} B = 2 * (8 * 16^i B)
+        base = _edwards_add_int(pts[8], pts[8])
+    return ypx, ymx, t2d
+
+
+def _base_comb():
+    """The (64, 9, NLIMB) jnp comb tables of B, built once per process."""
+    global _base_comb_cache
+    with _base_comb_lock:
+        if _base_comb_cache is None:
+            _base_comb_cache = tuple(jnp.asarray(t) for t in _base_comb_np())
+        return _base_comb_cache
 
 
 # ---------------------------------------------------------------------------
@@ -310,21 +360,11 @@ def _gather_base_niels(digit):
 
 
 def _build_var_table(a: C.Ext):
-    """Cached multiples j*a for j = 0..8, stacked on axis 0: (9, NLIMB, B)."""
-    a1 = a
-    a2 = C.dbl(a1)
-    c1 = C.to_cached(a1)
-    a3 = C.add_cached(a2, c1)
-    a4 = C.dbl(a2)
-    a5 = C.add_cached(a4, c1)
-    a6 = C.dbl(a3)
-    a7 = C.add_cached(a6, c1)
-    a8 = C.dbl(a4)
-    batch = a.x.shape[1:]
-    ident = C.Cached(F.one(batch), F.one(batch), F.one(batch), F.zero(batch))
-    entries = [ident, c1] + [C.to_cached(p) for p in (a2, a3, a4, a5, a6, a7, a8)]
-    return C.Cached(*(jnp.stack([getattr(e, f) for e in entries], axis=0)
-                      for f in ("ypx", "ymx", "z", "t2d")))
+    """Cached multiples j*a for j = 0..8, stacked on axis 0: (9, NLIMB, B).
+    One signed-radix-16 window unit (ops/curve.cached_window) — the comb
+    table scan builds 64 of these per validator, once, instead of one per
+    signature per launch."""
+    return C.cached_window(a)[0]
 
 
 def _gather_cached(tab: C.Cached, digit):
@@ -418,6 +458,121 @@ def verify_staged(pub, r, s_digits, k_digits):
 verify_kernel = jax.jit(verify_staged)
 
 
+# ---------------------------------------------------------------------------
+# fixed-base comb verify (ADR-013): when the batch's pubkeys all belong
+# to a known validator set, [s]B + [k](-A) is 64 iterations of two table
+# gathers + two unified additions — ZERO doublings — against the static
+# basepoint comb (_base_comb) and a per-validator device-resident window
+# table built once per set (comb_build_kernel).  ~3x fewer group ops per
+# verify than the Straus ladder, no per-launch table build, and the wire
+# payload is the cache path's 96 B/sig.
+# ---------------------------------------------------------------------------
+
+# group-op inventory per lane, published in last_launch(): the ladder
+# pays the per-launch variable-base window (4 dbl + 3 add) plus 64
+# iterations of 4 doublings + 2 additions; the comb pays 2 additions per
+# window and nothing else.  tests/test_comb.py re-counts these by tracing
+# the kernels with instrumented group ops, so the constants can't drift.
+LADDER_GROUP_OPS = {"doublings": 4 * 64 + 4, "adds": 2 * 64 + 3}
+COMB_GROUP_OPS = {"doublings": 0, "adds": 2 * COMB_WINDOWS}
+_GROUP_OPS_BY_PATH = {
+    "xla": LADDER_GROUP_OPS, "mesh-sharded": LADDER_GROUP_OPS,
+    "pallas": LADDER_GROUP_OPS, "pallas-split": LADDER_GROUP_OPS,
+    "mesh-pallas": LADDER_GROUP_OPS,
+    "comb": COMB_GROUP_OPS, "mesh-comb": COMB_GROUP_OPS,
+}
+
+
+def comb_build_kernel_impl(pub):
+    """Device-side comb table build for a (K, 32) uint8 pubkey matrix:
+    decompress each A, negate, and scan out the 64 signed-radix-16
+    window tables of -A (ops/curve.comb_table_scan).  Returns
+    (Cached tables, fields (64, 9, NLIMB, K); decode_ok (K,) bool).
+    All group math runs under jit with the same C.dbl/C.add_cached
+    kernels the ladder uses — no host bignum."""
+    a_y, a_sign = bytes256_to_limbs(pub, mask_sign=True)
+    a, ok = C.decompress(a_y, a_sign)
+    neg_a = C.Ext(F.carry_lazy(-a.x), a.y, a.z, F.carry_lazy(-a.t))
+    return C.comb_table_scan(neg_a, windows=COMB_WINDOWS), ok
+
+
+comb_build_kernel = jax.jit(comb_build_kernel_impl)
+
+
+def _gather_comb_cached(tab: "C.Cached", i, digit, vidx):
+    """Two-level gather from the per-validator comb tables: window i
+    (loop-carried scalar), then tables[window, |digit|, :, vidx[lane]]
+    per lane, with conditional negation for negative digits.  Pure
+    gathers — this is the entire per-iteration cost of the A term."""
+    j = jnp.abs(digit)
+    idx = j[None, None, :]  # (1, 1, B) for the digit take_along_axis
+
+    def sel(t):
+        row = jax.lax.dynamic_index_in_dim(t, i, 0, keepdims=False)
+        lane = jnp.take(row, vidx, axis=2)        # (9, NLIMB, B)
+        return jnp.take_along_axis(lane, idx, axis=0)[0]
+
+    q = C.Cached(sel(tab.ypx), sel(tab.ymx), sel(tab.z), sel(tab.t2d))
+    return C.cond_neg_cached(q, digit < 0)
+
+
+def _gather_base_comb(base, i, digit):
+    """Niels gather from the static basepoint comb (window i, per-lane
+    digit) — _gather_base_niels generalized to 64 windows."""
+    by, bm, bt = base
+    j = jnp.abs(digit)
+    ypx = jnp.take(jax.lax.dynamic_index_in_dim(by, i, 0, keepdims=False),
+                   j, axis=0).T
+    ymx = jnp.take(jax.lax.dynamic_index_in_dim(bm, i, 0, keepdims=False),
+                   j, axis=0).T
+    t2d = jnp.take(jax.lax.dynamic_index_in_dim(bt, i, 0, keepdims=False),
+                   j, axis=0).T
+    return C.cond_neg_niels(C.Niels(ypx, ymx, t2d), digit < 0)
+
+
+def comb_verify_staged(r, s_digits, k_digits, vidx,
+                       tab_ypx, tab_ymx, tab_z, tab_t2d, dec_ok,
+                       base_ypx, base_ymx, base_t2d):
+    """Comb variant of verify_staged: same cofactorless verdict, zero
+    doublings.  All per-signature inputs batch-major:
+
+    r: (B, 32) uint8     s_digits, k_digits: (B, 64) int8
+    vidx: (B,) int32 row index into the validator table axis
+    tab_*: (64, 9, NLIMB, K) cached window tables of -A per validator
+    dec_ok: (K,) bool precomputed decode verdict per validator
+    base_*: (64, 9, NLIMB) static comb of B
+    Returns (B,) bool.
+
+    Addition order differs from the ladder (per-window instead of
+    Horner), but the group is commutative and encode_bits normalizes by
+    1/Z, so the encoded bits — and therefore the bitmap — are bitwise
+    identical to the ladder's on every input class (asserted across the
+    sweep in tests/test_comb.py)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    r_bits = ((r[:, :, None] >> shifts) & 1).reshape(r.shape[0], 256)
+    r_bits = r_bits.astype(jnp.int32).T
+    sd = s_digits.astype(jnp.int32).T   # (64, B)
+    kd = k_digits.astype(jnp.int32).T
+    ok_lane = jnp.take(dec_ok, vidx)
+    tab = C.Cached(tab_ypx, tab_ymx, tab_z, tab_t2d)
+    base = (base_ypx, base_ymx, base_t2d)
+    p0 = C.identity((r.shape[0],))
+
+    def body(i, p):
+        db = jax.lax.dynamic_index_in_dim(sd, i, 0, keepdims=False)
+        p = C.madd_niels(p, _gather_base_comb(base, i, db))
+        da = jax.lax.dynamic_index_in_dim(kd, i, 0, keepdims=False)
+        p = C.add_cached(p, _gather_comb_cached(tab, i, da, vidx))
+        return p
+
+    p = jax.lax.fori_loop(0, COMB_WINDOWS, body, p0)
+    bits = C.encode_bits(p)
+    return jnp.all(bits == r_bits, axis=0) & ok_lane
+
+
+comb_kernel = jax.jit(comb_verify_staged)
+
+
 PALLAS_TILE = 256  # best-measured batch tile for the fused TPU kernel
 MAX_CHUNK = 1 << 16  # biggest single-launch lane count (verify_batch)
 
@@ -447,7 +602,8 @@ MIN_BUCKET = 64
 
 _launch_lock = threading.Lock()
 _seen_buckets: set = set()
-_last_launch = MappingProxyType({"path": None})
+_launch_seq = 0
+_last_launch = MappingProxyType({"path": None, "seq": 0})
 
 
 def last_launch():
@@ -462,22 +618,36 @@ def last_launch():
 def _set_last_launch(rec: dict):
     """Publish a fresh immutable launch snapshot (ops/msm routes call
     this too, so last_launch() covers the RLC fast path — a bench row
-    must never claim the device was idle when RLC vouched)."""
-    global _last_launch
+    must never claim the device was idle when RLC vouched).  Each
+    snapshot carries a monotonically increasing "seq" so a reader that
+    bracketed its own dispatch can tell whether the record it sees is
+    its launch or a concurrent verifier's (crypto/scheduler's route
+    span attr)."""
+    global _last_launch, _launch_seq
     with _launch_lock:
-        _last_launch = MappingProxyType(dict(rec))
+        _launch_seq += 1
+        _last_launch = MappingProxyType(dict(rec, seq=_launch_seq))
 
 
 def _record_launch(path: str, n: int, nb: int, wall_s: float,
-                   shards: int = 1):
+                   shards: int = 1, extra: dict = None):
     occupancy = n / nb if nb else 1.0
     key = (path, nb, shards)
     with _launch_lock:
         first = key not in _seen_buckets
         _seen_buckets.add(key)
-    _set_last_launch({
+    rec = {
         "path": path, "n": n, "nb": nb, "occupancy": occupancy,
-        "shards": shards, "first_launch": first, "wall_s": wall_s})
+        "shards": shards, "first_launch": first, "wall_s": wall_s}
+    # per-lane group-op inventory of the dispatched kernel family, so a
+    # bench row (and the comb acceptance guard) can assert "no doublings"
+    # from the launch record instead of re-deriving it from the code
+    ops = _GROUP_OPS_BY_PATH.get(path)
+    if ops is not None:
+        rec["group_ops"] = dict(ops)
+    if extra:
+        rec.update(extra)
+    _set_last_launch(rec)
     from tendermint_tpu.crypto import degrade
     degrade.publish_route(path, "executed", n=n, nb=nb,
                           compile_s=wall_s if first else None)
@@ -536,44 +706,419 @@ def verify_packed_pipelined(packed: np.ndarray, nsub: int = 4,
 
 
 # ---------------------------------------------------------------------------
-# device-resident pubkey cache (validator-set path): a chain's validator
+# device-resident caches.  One bounded LRU implementation backs both the
+# pubkey-row cache (the 96 B/sig split path) and the comb table cache:
+# the old _pub_cache hand-rolled its bound at the insert site only, and
+# a hit's pop/re-insert raced a concurrent filler into one-over-bound
+# (ISSUE 5 small fix) — here every mutation enforces the bound inside
+# the same critical section.
+# ---------------------------------------------------------------------------
+
+
+class DeviceLRU:
+    """Bounded, thread-safe LRU of device-resident uploads.
+
+    Bounds: `max_entries` (count) and/or `max_bytes` (sum of the nbytes
+    passed to put) — whichever is set; eviction is oldest-first and never
+    evicts the entry just inserted (a single set larger than the budget
+    is kept rather than thrashed; callers budget-check before building).
+    put() is first-wins: when two threads race the same key, the loser's
+    upload is dropped and both use the winner's arrays, so a double
+    upload can't leave two resident copies.  `on_evict(key, value)`
+    fires outside the lock."""
+
+    def __init__(self, max_entries: int = None, max_bytes: int = None,
+                 on_evict=None):
+        import collections
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._on_evict = on_evict
+        self._od: "collections.OrderedDict" = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            ent = self._od.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def put(self, key, value, nbytes: int = 0):
+        evicted = []
+        with self._lock:
+            ent = self._od.get(key)
+            if ent is not None:  # racing upload lost: first wins
+                self._od.move_to_end(key)
+                return ent[0]
+            self._od[key] = (value, nbytes)
+            self._bytes += nbytes
+            while len(self._od) > 1 and self._over_locked():
+                k, (v, b) = self._od.popitem(last=False)
+                self._bytes -= b
+                self.evictions += 1
+                evicted.append((k, v))
+        if self._on_evict is not None:
+            for k, v in evicted:
+                self._on_evict(k, v)
+        return value
+
+    def _over_locked(self) -> bool:
+        if self.max_entries is not None and \
+                len(self._od) > self.max_entries:
+            return True
+        return self.max_bytes is not None and self._bytes > self.max_bytes
+
+    def pop(self, key):
+        with self._lock:
+            ent = self._od.pop(key, None)
+            if ent is None:
+                return None
+            self._bytes -= ent[1]
+        return ent[0]
+
+    def clear(self):
+        with self._lock:
+            self._od.clear()
+            self._bytes = 0
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._od
+
+    def peek(self, key):
+        """get() without touching recency or the hit/miss counters —
+        for bookkeeping scans that must not perturb eviction order."""
+        with self._lock:
+            ent = self._od.get(key)
+            return None if ent is None else ent[0]
+
+    def keys(self):
+        with self._lock:
+            return list(self._od.keys())
+
+
+# -- pubkey-row cache (validator-set split path): a chain's validator
 # keys are fixed across blocks, so the (32, B) pubkey rows are uploaded
 # once and every subsequent VerifyCommit against the same set ships only
 # the 96 B/sig of per-commit data (R, s, k).  Keyed by content hash of
 # the padded pubkey rows; tiny LRU — a node tracks very few sets (own
-# chain + maybe a light client's).
-# ---------------------------------------------------------------------------
+# chain + maybe a light client's). ------------------------------------
 
 PUB_CACHE_MIN = 4096      # below this the tunnel RTT dominates anyway
 _PUB_CACHE_MAX = 4
-_pub_cache: "dict[bytes, object]" = {}
-_pub_cache_mtx = threading.Lock()
+_pub_cache = DeviceLRU(max_entries=_PUB_CACHE_MAX)
 
 
 def _pub_cache_get(pub_rows: np.ndarray, nsub: int):
     """pub_rows: (32, NB) uint8, already padded; nsub: pipeline chunk
     count.  Returns a list of nsub (32, NB/nsub) device arrays (the
-    pipelined launch shape), uploading on first sight (LRU beyond
-    _PUB_CACHE_MAX).  Thread-safe: multiple verifier threads (consensus,
-    light client) route through verify_sigs_bulk concurrently."""
+    pipelined launch shape), uploading on first sight.  Thread-safe:
+    multiple verifier threads (consensus, light client) route through
+    verify_sigs_bulk concurrently; a racing double upload resolves to
+    one resident copy (DeviceLRU.put is first-wins)."""
     key = (hashlib.sha256(pub_rows.tobytes()).digest(), nsub)
-    with _pub_cache_mtx:
-        chunks = _pub_cache.pop(key, None)
-        if chunks is not None:
-            _pub_cache[key] = chunks  # re-insert = most recently used
-            return chunks
-    # upload outside the lock (device_put can take a while through the
-    # tunnel); worst case two threads race the same set and one upload
-    # wins the re-insert below — correct either way
+    chunks = _pub_cache.get(key)
+    if chunks is not None:
+        return chunks
+    # upload outside the cache lock (device_put can take a while
+    # through the tunnel)
     sub = pub_rows.shape[1] // nsub
     chunks = [jax.device_put(jnp.asarray(np.ascontiguousarray(
         pub_rows[:, j * sub:(j + 1) * sub]).view(np.int8)))
         for j in range(nsub)]
-    with _pub_cache_mtx:
-        while len(_pub_cache) >= _PUB_CACHE_MAX:
-            _pub_cache.pop(next(iter(_pub_cache)))
-        _pub_cache[key] = chunks
-    return chunks
+    return _pub_cache.put(key, chunks)
+
+
+# -- comb table cache (ADR-013): per-validator fixed-base window tables,
+# device-resident, keyed by validator-set content hash (sha256 of the
+# sorted distinct pubkey rows).  Subsumes the role of the pubkey-row
+# cache for sets it holds: a batch against a cached set ships only
+# (validator_index, R, s, k) and runs the zero-doubling comb kernel.
+# Bounded in BYTES (config [batch_verifier] table_cache_mb): one padded
+# key costs 64 windows x 9 entries x 4 cached fields x NLIMB x 4 B
+# (~198 KB), so a 256 MB default budget holds ~1.3k validator keys. ----
+
+_TABLE_BYTES_PER_KEY = COMB_WINDOWS * 9 * 4 * F.NLIMB * 4
+TABLE_CACHE_MB_DEFAULT = 256
+
+_comb_enabled_override = None   # node config wins over env, either way
+_comb_min_override = None
+_table_budget_override = None
+
+
+def set_comb_config(enabled: bool = None, table_cache_mb: int = None,
+                    min_batch: int = None):
+    """Node-assembly override of the comb-path knobs (None leaves a knob
+    on its env/default; the env stays the knob only for node-less
+    tooling — benches, tests — same contract as msm.set_enabled)."""
+    global _comb_enabled_override, _comb_min_override, \
+        _table_budget_override
+    if enabled is not None:
+        _comb_enabled_override = bool(enabled)
+    if table_cache_mb is not None:
+        _table_budget_override = int(table_cache_mb) << 20
+    if min_batch is not None:
+        _comb_min_override = int(min_batch)
+
+
+def comb_enabled() -> bool:
+    import os
+    if _comb_enabled_override is not None:
+        return _comb_enabled_override
+    return os.environ.get("TM_TPU_COMB", "1") != "0"
+
+
+def comb_min_batch() -> int:
+    """Smallest batch that triggers a table BUILD (a cache hit engages
+    at any size — the tables are already resident)."""
+    import os
+    if _comb_min_override is not None:
+        return _comb_min_override
+    return int(os.environ.get("TM_TPU_COMB_MIN", PUB_CACHE_MIN))
+
+
+def table_cache_budget_bytes() -> int:
+    import os
+    if _table_budget_override is not None:
+        return _table_budget_override
+    return int(os.environ.get("TM_TPU_TABLE_CACHE_MB",
+                              TABLE_CACHE_MB_DEFAULT)) << 20
+
+
+class CombTables:
+    """One cached validator set: device-resident comb tables + metadata."""
+    __slots__ = ("set_hash", "index", "tables", "dec_ok", "nbytes",
+                 "k", "k_pad", "mesh_repl")
+
+    def __init__(self, set_hash, index, tables, dec_ok, nbytes, k, k_pad):
+        self.set_hash = set_hash
+        self.index = index        # pubkey bytes -> table row
+        self.tables = tables      # C.Cached, fields (64, 9, NLIMB, K_pad)
+        self.dec_ok = dec_ok      # (K_pad,) bool device array
+        self.nbytes = nbytes
+        self.k = k
+        self.k_pad = k_pad
+        # (mesh, replicated operand tuple) placed once by the data
+        # plane's verify_comb — without it every mesh launch would
+        # re-replicate the full table set (~198 KB/key) across shards
+        self.mesh_repl = None
+
+
+_table_key_lock = threading.Lock()
+_table_key_index: "dict[bytes, bytes]" = {}  # pubkey bytes -> set hash
+
+
+def _table_evicted(set_hash, entry):
+    with _table_key_lock:
+        for kb in entry.index:
+            if _table_key_index.get(kb) != set_hash:
+                continue
+            # overlapping sets (a validator-set change keeps most keys):
+            # repoint the key to a surviving resident owner instead of
+            # dropping it, or the survivor's subset lookups — gated on
+            # this index — would silently stop engaging the comb
+            for owner in _table_cache.keys():
+                surv = _table_cache.peek(owner)
+                if surv is not None and kb in surv.index:
+                    _table_key_index[kb] = owner
+                    break
+            else:
+                del _table_key_index[kb]
+    from tendermint_tpu.crypto import degrade
+    degrade.publish_table_cache(bytes_=_table_cache.total_bytes,
+                                evicted=True)
+
+
+_table_cache = DeviceLRU(max_bytes=None, on_evict=_table_evicted)
+
+
+def table_cache_clear():
+    """Drop every cached set (tests / operator tooling)."""
+    for h in _table_cache.keys():
+        entry = _table_cache.pop(h)
+        if entry is not None:
+            _table_evicted(h, entry)
+
+
+def _comb_k_pad(k: int) -> int:
+    """Validator-axis compile bucket: power of two, floor 8 — few table
+    shapes per process, same discipline as the lane buckets."""
+    return max(8, 1 << (k - 1).bit_length())
+
+
+def _table_build(uniq: np.ndarray, set_hash: bytes, replicas: int = 1):
+    """Build + cache the comb tables for a distinct-key matrix (K, 32).
+    Returns the CombTables entry, or None when the HBM budget says no
+    (route comb/declined — the ladder handles the batch).  `replicas=2`
+    on mesh hosts: verify_comb keeps a fully-replicated copy of the
+    tables per device, so the build device's real footprint is original
+    + replica — the budget must model (and the LRU must charge) both,
+    or the decline check under-counts by ~2x exactly where OOM bites."""
+    from tendermint_tpu.crypto import degrade
+
+    k = uniq.shape[0]
+    k_pad = _comb_k_pad(k)
+    nbytes = replicas * k_pad * _TABLE_BYTES_PER_KEY
+    budget = table_cache_budget_bytes()
+    if nbytes > budget:
+        degrade.publish_route("comb", "declined")
+        return None
+    _table_cache.max_bytes = budget  # config may have changed
+    pub_pad = np.zeros((k_pad, 32), dtype=np.uint8)
+    pub_pad[:k] = uniq
+    t0 = time.perf_counter()
+    with trace.span("table_build", k=k, k_pad=k_pad, bytes=nbytes) as sp:
+        tab, dec_ok = comb_build_kernel(jnp.asarray(pub_pad))
+        jax.block_until_ready(tab)
+        sp.add(wall_s=round(time.perf_counter() - t0, 4))
+    index = {uniq[i].tobytes(): i for i in range(k)}
+    entry = CombTables(set_hash, index, tab, dec_ok, nbytes, k, k_pad)
+    entry = _table_cache.put(set_hash, entry, nbytes)
+    with _table_key_lock:
+        for kb, i in entry.index.items():
+            _table_key_index[kb] = set_hash
+    degrade.publish_table_cache(bytes_=_table_cache.total_bytes)
+    return entry
+
+
+def _table_lookup(uniq: np.ndarray):
+    """Resolve a distinct-key matrix against the table cache.  Returns
+    (entry, remap) where remap maps the uniq row order onto the entry's
+    table rows, or (None, None).  A batch whose keys are a SUBSET of a
+    cached set (a partial vote window, the VerifyScheduler's coalesced
+    lanes) resolves through the key-level index; any unknown or
+    cross-set key falls back to the ladder."""
+    set_hash = hashlib.sha256(uniq.tobytes()).digest()
+    entry = _table_cache.get(set_hash)
+    if entry is not None:
+        return entry, np.arange(uniq.shape[0], dtype=np.int32)
+    with _table_key_lock:
+        owner = _table_key_index.get(uniq[0].tobytes())
+    if owner is None:
+        return None, None
+    entry = _table_cache.get(owner)
+    if entry is None:
+        return None, None
+    remap = np.empty(uniq.shape[0], dtype=np.int32)
+    for i in range(uniq.shape[0]):
+        row = entry.index.get(uniq[i].tobytes())
+        if row is None:  # mixed known+unknown keys: whole batch ladders
+            return None, None
+        remap[i] = row
+    return entry, remap
+
+
+def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
+    """The comb route: engage iff every key resolves to one cached set
+    (building the set on a cache_pubs batch >= comb_min_batch()).
+    Returns the bitmap, or None to fall through to the ladder paths.
+    Runs under the same degrade lane as every other device dispatch, so
+    breaker/timeout/host-fallback and the corrupt-bitmap integrity
+    check apply unchanged (site ops.ed25519.comb)."""
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.libs import fail
+
+    n = len(pubkeys)
+    if n == 0 or not comb_enabled():
+        return None
+    can_build = cache_pubs and n >= comb_min_batch()
+    # cheap short-circuit: with nothing cached and no build possible,
+    # don't pay the key-matrix conversion on every ladder-bound batch
+    if len(_table_cache) == 0 and not can_build:
+        return None
+    pub_m = _to_u8_matrix(pubkeys, 32)
+    if pub_m.shape != (n, 32):
+        return None
+    if not can_build:
+        # a batch can only resolve to a cached set if EVERY key is in
+        # the key-level index (_table_build indexes all of a set's
+        # keys), so one O(1) membership probe on the first key gates
+        # the O(n log n) distinct-key sort below — a large ladder-bound
+        # batch of unknown keys must not pay the lexsort just because
+        # some unrelated set is cached
+        with _table_key_lock:
+            if pub_m[0].tobytes() not in _table_key_index:
+                return None
+    uniq, inverse = np.unique(pub_m, axis=0, return_inverse=True)
+    inverse = np.asarray(inverse).reshape(-1)
+    entry, remap = _table_lookup(uniq)
+    built = False
+    if entry is None:
+        if not can_build:
+            return None
+        entry = _table_build(uniq, hashlib.sha256(uniq.tobytes()).digest(),
+                             replicas=2 if plane is not None else 1)
+        if entry is None:
+            return None
+        remap = np.arange(uniq.shape[0], dtype=np.int32)
+        built = True
+    else:
+        degrade.publish_table_cache(hit=True)
+    # chaos seam: a raise/latency armed here fails exactly the comb
+    # dispatch (the ladder is NOT retried in-process — the degradation
+    # runtime owns the fallback, preserving bitmap identity)
+    fail.inject("ops.ed25519.comb")
+    vidx = remap[inverse].astype(np.int32)
+    t0 = time.perf_counter()
+    _, r_b, s_b, kscal, host_ok = _stage_rows(
+        pub_m, _to_u8_matrix(sigs, 64), msgs)
+    s_digits = scalars_to_digits(s_b)
+    k_digits = scalars_to_digits(kscal)
+    use_mesh = plane is not None and plane.worth_sharding(n)
+    path = "mesh-comb" if use_mesh else "comb"
+    # chunk like every other device path (split_chunked_launch, the
+    # nb > MAX_CHUNK pipelined sub-batching): one unbounded launch for
+    # a huge batch would mint a fresh XLA bucket shape per size class
+    # and outgrow the degrade timeouts tuned for <= MAX_CHUNK lanes
+    parts, nb, shards = [], 0, 1
+    for a in range(0, n, MAX_CHUNK):
+        b = min(a + MAX_CHUNK, n)
+        rc, sc, kc, vc = (r_b[a:b], s_digits[a:b], k_digits[a:b],
+                          vidx[a:b])
+        if use_mesh:
+            part, cnb, shards = plane.verify_comb(
+                rc, sc, kc, vc, entry, _base_comb())
+        else:
+            m = b - a
+            cnb = bucket_size(m)
+            if cnb != m:
+                pad = [(0, cnb - m), (0, 0)]
+                rc = np.pad(rc, pad)
+                sc = np.pad(sc, pad)
+                kc = np.pad(kc, pad)
+                vc = np.pad(vc, (0, cnb - m))
+            by, bm, bt = _base_comb()
+            out = comb_kernel(jnp.asarray(rc), jnp.asarray(sc),
+                              jnp.asarray(kc), jnp.asarray(vc),
+                              entry.tables.ypx, entry.tables.ymx,
+                              entry.tables.z, entry.tables.t2d,
+                              entry.dec_ok, by, bm, bt)
+            part = np.asarray(out)[:m]
+        parts.append(np.asarray(part))
+        nb += cnb
+    res = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    _record_launch(path, n, nb, time.perf_counter() - t0, shards=shards,
+                   extra={"table_build": built, "set_k": entry.k,
+                          "k_pad": entry.k_pad})
+    res = fail.corrupt_bitmap("ops.ed25519.comb",
+                              np.asarray(res[:n], dtype=bool))
+    return res & host_ok
 
 
 SPLIT_CHUNK = 16384  # chunk size of the staged split-path pipeline
@@ -687,6 +1232,30 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
             if msm.verify_batch_rlc(pubkeys, msgs, sigs, plane=plane):
                 return np.ones(len(pubkeys), dtype=bool)
             sp.add(rlc_fallback=True)
+        # fixed-base comb (ADR-013): engages when every key resolves to
+        # one device-resident table set (built on cache_pubs batches >=
+        # comb_min_batch()); unknown keys, mixed sets, evicted tables or
+        # a blown HBM budget fall through to the ladder below.  A comb
+        # fault degrades like any other device fault: the raise
+        # propagates to the degradation runtime wrapping this dispatch.
+        try:
+            out = _comb_try(pubkeys, msgs, sigs, cache_pubs, plane)
+        except (fail.InjectedFault, RuntimeError):
+            # chaos AND real device faults (XlaRuntimeError subclasses
+            # RuntimeError) must reach the degrade runtime wrapping this
+            # dispatch — re-dispatching the batch through the ladder on
+            # the same possibly-dead device would just burn a doomed
+            # launch before the breaker sees the failure
+            raise
+        except Exception as e:  # noqa: BLE001 - a comb BUG (shape /
+            # typing / indexing) must not kill verification: route it,
+            # fall back to the ladder
+            from tendermint_tpu.crypto import degrade
+            degrade.publish_route("comb", "error")
+            sp.add(comb_error=type(e).__name__)
+            out = None
+        if out is not None:
+            return out
         if plane is not None and plane.worth_sharding(len(pubkeys)):
             return plane.verify_batch(pubkeys, msgs, sigs)
         t0 = time.perf_counter()

@@ -208,6 +208,75 @@ class _DataPlane:
         return fn(jnp.asarray(r_bytes), jnp.asarray(pub_m),
                   jnp.asarray(zk), jnp.asarray(z), jnp.asarray(zs_rows))
 
+    # -- fixed-base comb over the mesh (ADR-013) ---------------------------
+
+    def _comb_fn(self):
+        """Cached jitted sharded comb verify: the per-signature inputs
+        (r, digits, validator index) batch-sharded, the per-validator
+        window tables + decode verdicts + static basepoint comb
+        REPLICATED on every shard (they are the weights of this
+        inference-shaped path), bitmap batch-sharded back, all-valid
+        verdict psum'd exactly like make_sharded_verifier's."""
+        with self._lock:
+            fn = self._fns.get("comb")
+        if fn is not None:
+            return fn
+
+        from tendermint_tpu.ops import ed25519 as edops
+
+        batch_sharded = NamedSharding(self.mesh, P(BATCH_AXIS))
+        repl = NamedSharding(self.mesh, P())
+
+        def step(r, sd, kd, vidx, ty, tm, tz, td, dok, by, bm, bt):
+            bitmap = edops.comb_verify_staged(
+                r, sd, kd, vidx, ty, tm, tz, td, dok, by, bm, bt)
+            return bitmap, jnp.all(bitmap)
+
+        f = jax.jit(step,
+                    in_shardings=(batch_sharded,) * 4 + (repl,) * 8,
+                    out_shardings=(batch_sharded, repl))
+        with self._lock:
+            self._fns.setdefault("comb", f)
+            return self._fns["comb"]
+
+    def verify_comb(self, r_b, s_digits, k_digits, vidx, entry, base):
+        """Mesh-sharded comb launch: identical bitmap to the
+        single-device comb kernel, batch rows split across devices,
+        tables replicated per shard.  Returns (bitmap[:n], nb, shards)."""
+        import numpy as np
+
+        from tendermint_tpu.ops import ed25519 as edops
+
+        n = r_b.shape[0]
+        nshard = self.nshard
+        nb = max(-(-edops.bucket_size(n) // nshard) * nshard, nshard)
+        if nb != n:
+            pad = [(0, nb - n), (0, 0)]
+            r_b = np.pad(r_b, pad)
+            s_digits = np.pad(s_digits, pad)
+            k_digits = np.pad(k_digits, pad)
+            vidx = np.pad(vidx, (0, nb - n))
+        # replicate the weights of this path (per-validator tables,
+        # decode verdicts, static basepoint comb) across the mesh ONCE
+        # per entry and reuse the committed copies on every launch —
+        # entry.tables is committed to the build device, so passing it
+        # raw would make jit re-replicate ~198 KB/key per call (a
+        # benign race: two first launches both device_put, one copy
+        # wins the slot, the other is garbage once its launch retires)
+        cached = entry.mesh_repl
+        if cached is None or cached[0] is not self.mesh:
+            by, bm, bt = base
+            repl = jax.device_put(
+                (entry.tables.ypx, entry.tables.ymx, entry.tables.z,
+                 entry.tables.t2d, entry.dec_ok, by, bm, bt),
+                NamedSharding(self.mesh, P()))
+            cached = (self.mesh, repl)
+            entry.mesh_repl = cached
+        bitmap, _ = self._comb_fn()(
+            jnp.asarray(r_b), jnp.asarray(s_digits),
+            jnp.asarray(k_digits), jnp.asarray(vidx), *cached[1])
+        return np.asarray(bitmap)[:n], nb, nshard
+
     def _packed_fn(self):
         """TPU path: the fused Pallas kernel inside shard_map, packed
         (128, B) input sharded on the lane axis."""

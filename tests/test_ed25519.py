@@ -177,7 +177,9 @@ def test_pub_cache_routing(monkeypatch):
     monkeypatch.setattr(edops, "SPLIT_CHUNK", 128)
     monkeypatch.setattr(edops, "PALLAS_TILE", 32)
     monkeypatch.setattr(pe, "verify_packed_split_pallas", stub)
-    monkeypatch.setattr(edops, "_pub_cache", {})
+    monkeypatch.setattr(edops, "_pub_cache",
+                        edops.DeviceLRU(max_entries=edops._PUB_CACHE_MAX))
+    monkeypatch.setattr(edops, "_comb_enabled_override", False)
 
     n = 200
     seeds = [(7000 + i).to_bytes(32, "little") for i in range(n)]
@@ -193,13 +195,14 @@ def test_pub_cache_routing(monkeypatch):
     # bucket(200) = 256, SPLIT_CHUNK 128 -> 2 pipelined chunks of 128
     assert calls == [((32, 128), (96, 128))] * 2
     assert len(edops._pub_cache) == 1
-    (key0, chunks0), = edops._pub_cache.items()
+    key0, = edops._pub_cache.keys()
+    chunks0 = edops._pub_cache.get(key0)
     assert len(chunks0) == 2
 
     # same set again: cache hit (same chunk objects), two more launches
     edops.verify_batch(pubs, msgs, sigs, cache_pubs=True)
     assert len(edops._pub_cache) == 1
-    assert edops._pub_cache[key0] is chunks0
+    assert edops._pub_cache.get(key0) is chunks0
     assert len(calls) == 4
 
     # 4 more distinct sets -> LRU capped at _PUB_CACHE_MAX, oldest evicted
