@@ -1,0 +1,77 @@
+"""Human-readable report over tmlint --json output (CI/tooling
+satellite of docs/adr/adr-014-tmlint.md).
+
+Usage:
+    python -m tendermint_tpu.devtools.tmlint --json \
+        --baseline devtools/lint_baseline.json > /tmp/lint.json
+    python scripts/lint_report.py /tmp/lint.json
+
+    python scripts/lint_report.py            # runs tmlint itself
+
+Prints per-rule counts, the worst files, and every NEW (unbaselined)
+finding; exits 1 when new findings exist — same verdict as the CLI,
+formatted for humans and CI summaries instead of line-per-finding.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load(argv):
+    if argv:
+        with open(argv[0], "r", encoding="utf-8") as f:
+            return json.load(f)
+    from tendermint_tpu.devtools.tmlint import core
+    findings = core.run_lint()
+    baseline = core.load_baseline(os.path.join(
+        core.repo_root(), "devtools", "lint_baseline.json"))
+    keys = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    return {
+        "findings": [f.as_dict() for f in findings],
+        "new": [f.as_dict() for f in new],
+        "baselined": len(findings) - len(new),
+        # same stale-entry diff the CLI's --json emits: baseline rot
+        # must be visible in the human report mode too
+        "stale_baseline_keys": sorted(set(baseline) - keys),
+    }
+
+
+def main(argv=None) -> int:
+    data = _load(sys.argv[1:] if argv is None else argv)
+    findings = data.get("findings", [])
+    new = data.get("new", [])
+    from tendermint_tpu.devtools.tmlint.core import RULES_BY_ID
+
+    print("tmlint report")
+    print(f"  findings: {len(findings)} total, "
+          f"{data.get('baselined', 0)} baselined, {len(new)} new")
+    by_rule = Counter(f["rule"] for f in findings)
+    if by_rule:
+        print("  by rule:")
+        for rule, n in by_rule.most_common():
+            name = RULES_BY_ID[rule].name if rule in RULES_BY_ID else "?"
+            print(f"    {rule} {name:32s} {n}")
+    by_file = Counter(f["path"] for f in findings)
+    if by_file:
+        print("  worst files:")
+        for path, n in by_file.most_common(5):
+            print(f"    {n:3d}  {path}")
+    for key in data.get("stale_baseline_keys", []):
+        print(f"  stale baseline entry: {key}")
+    if new:
+        print("  NEW findings (fix or justify in the baseline):")
+        for f in new:
+            print(f"    {f['path']}:{f['line']}: {f['rule']} "
+                  f"[{f['qual']}] {f['msg']}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

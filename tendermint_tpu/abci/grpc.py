@@ -13,7 +13,10 @@ the service is declared by (method, payload codec) pairs against
 """
 from __future__ import annotations
 
-import grpc
+try:
+    import grpc
+except ImportError:  # optional dep: grpc_util.require_grpc() raises a
+    grpc = None      # clear error before any use can be reached
 
 from tendermint_tpu.libs import grpc_util
 from tendermint_tpu.libs import log as tmlog

@@ -96,10 +96,11 @@ class SigCache:
 
     def __init__(self, capacity: int = 1 << 16):
         import collections
+        import threading
         self.capacity = capacity
         self._set: "collections.OrderedDict[bytes, None]" = \
             collections.OrderedDict()
-        self._lock = __import__("threading").Lock()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 

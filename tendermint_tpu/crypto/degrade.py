@@ -136,17 +136,38 @@ class CircuitBreaker:
         return _unsub
 
     def _transition(self, new: str, reason: str):
-        # lock held by caller; fire listeners outside the lock
+        # lock held by caller: mutate state only.  Metrics, the trace
+        # instant AND the listener callbacks all run in the returned
+        # closure, which every caller invokes AFTER releasing _lock —
+        # publishing takes the metric/trace leaf locks and listener
+        # callbacks are arbitrary subscriber code (node logging), none
+        # of which belongs under the breaker lock (tmlint TM201/TM202
+        # discipline; callers invoke the closure before returning, so
+        # the gauge is current by the time any caller observes the
+        # transition).
         old, self._state = self._state, new
         if new == OPEN:
             self.opened_total += 1
-        if self._metrics is not None:
-            self._metrics.breaker_state.set(_STATE_GAUGE[new])
-            self._metrics.breaker_transitions.inc(to=new)
-        trace.instant("breaker.transition", to=new, reason=reason,
-                      **{"from": old})
         listeners = list(self._listeners)
-        return lambda: [fn(old, new, reason) for fn in listeners]
+
+        def _notify():
+            if self._metrics is not None:
+                # gauge publishes the CURRENT state, not this
+                # transition's: two racing transitions may run their
+                # closures out of order (A: ->OPEN preempted, B:
+                # ->HALF_OPEN publishes, A resumes) and a stale `new`
+                # would leave the gauge wrong until the next
+                # transition.  The counter is commutative, so labeling
+                # it with this transition's target is exact regardless
+                # of closure order.
+                self._metrics.breaker_state.set(
+                    _STATE_GAUGE[self.state])
+                self._metrics.breaker_transitions.inc(to=new)
+            trace.instant("breaker.transition", to=new, reason=reason,
+                          **{"from": old})
+            for fn in listeners:
+                fn(old, new, reason)
+        return _notify
 
     # -- the gate ----------------------------------------------------------
 

@@ -202,7 +202,18 @@ class VerifyScheduler(BaseService):
         sees scheduler metrics land in its private registry too."""
         return degrade.runtime().metrics
 
-    def _gauge_depth(self):
+    def _publish_depth(self):
+        """Publish the queue-depth gauge.  NEVER call this holding
+        _cond: resolving the metrics bundle goes through
+        degrade.runtime() (rank 5, the global install lock, possibly
+        CONSTRUCTING the runtime) and the metric's own leaf lock —
+        tmlint TM201 found exactly this inversion under _cond (rank
+        20).  The gauge reads the CURRENT _pending_items (one atomic
+        int read) rather than a value captured inside the lock: two
+        publishers racing out-of-lock with captured snapshots could
+        land the older value last and leave the gauge stale until the
+        next event (same reasoning as the breaker_state fix in
+        degrade._transition)."""
         try:
             self._metrics().sched_queue_depth.set(self._pending_items)
         except Exception:  # noqa: BLE001 - observability must not break
@@ -234,35 +245,56 @@ class VerifyScheduler(BaseService):
         if sub.n == 0:
             sub.future._set(sub.bits)
             return sub.future
+        # under _cond: queue manipulation ONLY.  Shed/evict settlement
+        # (metrics, trace, future exceptions) and the depth gauge are
+        # deferred past the release — the metrics bundle resolves
+        # through degrade.runtime()'s install lock (rank 5), which must
+        # never be taken while holding _cond (rank 20); tmlint TM201.
+        shed: List[Tuple[_Submission, str, int]] = []
+        stopped = False
+        admitted = False
+        depth = 0
         with self._cond:
             if not self.is_running():
-                sub.future._set_exception(SchedulerStoppedError(
-                    f"{self.name} is not running"))
-                return sub.future
-            if self._pending_items + sub.n > self.max_pending:
-                if sub.prio == Priority.MEMPOOL:
-                    self._shed_locked(sub, "queue_full")
-                    return sub.future
-                # admit the higher class by evicting queued shed-class
-                # work, newest first (oldest mempool work is closest to
-                # its launch; the newest waited least)
-                self._evict_mempool_locked(sub.n)
-            sub.enq_t = time.monotonic()
-            self._queues[int(sub.prio)].append(sub)
-            self._pending_items += sub.n
-            with self._stats_lock:
-                self._stats["submissions"] += 1
-                self._stats["items"] += sub.n
-            self._gauge_depth()
-            depth = self._pending_items
-            self._cond.notify_all()
+                stopped = True
+            elif self._pending_items + sub.n > self.max_pending and \
+                    sub.prio == Priority.MEMPOOL:
+                shed.append((sub, "queue_full", self._pending_items))
+            else:
+                if self._pending_items + sub.n > self.max_pending:
+                    # admit the higher class by evicting queued
+                    # shed-class work, newest first (oldest mempool work
+                    # is closest to its launch; the newest waited least)
+                    shed.extend(self._evict_mempool_locked(sub.n))
+                sub.enq_t = time.monotonic()
+                self._queues[int(sub.prio)].append(sub)
+                self._pending_items += sub.n
+                admitted = True
+                depth = self._pending_items
+                self._cond.notify_all()
+        if stopped:
+            sub.future._set_exception(SchedulerStoppedError(
+                f"{self.name} is not running"))
+            return sub.future
+        for victim, reason, pending in shed:
+            self._settle_shed(victim, reason, pending)
+        if not admitted:
+            return sub.future
+        with self._stats_lock:
+            self._stats["submissions"] += 1
+            self._stats["items"] += sub.n
+        self._publish_depth()
         trace.instant("sched.submit", priority=sub.prio.name.lower(),
                       n=sub.n, queue_depth=depth)
         return sub.future
 
-    def _shed_locked(self, sub: _Submission, reason: str):
+    def _settle_shed(self, sub: _Submission, reason: str, pending: int):
+        """Account + fail a shed submission.  Runs with NO scheduler
+        lock held (see submit)."""
         with self._stats_lock:
             self._stats["shed"] += 1
+            if reason == "evicted_for_higher_class":
+                self._stats["evicted"] += 1
         try:
             self._metrics().sched_shed_total.inc(
                 priority=sub.prio.name.lower())
@@ -271,18 +303,20 @@ class VerifyScheduler(BaseService):
         trace.instant("sched.shed", priority=sub.prio.name.lower(),
                       n=sub.n, reason=reason)
         sub.future._set_exception(SchedulerShedError(
-            f"queue full ({self._pending_items} items pending): "
+            f"queue full ({pending} items pending): "
             f"{sub.prio.name} submission of {sub.n} shed"))
 
     def _evict_mempool_locked(self, needed: int):
+        """Pop newest-first mempool victims until `needed` fits; the
+        caller settles them AFTER releasing _cond."""
+        victims: List[Tuple[_Submission, str, int]] = []
         q = self._queues[int(Priority.MEMPOOL)]
         while q and self._pending_items + needed > self.max_pending:
             victim = q.pop()  # newest first
             self._pending_items -= victim.n
-            with self._stats_lock:
-                self._stats["evicted"] += 1
-            self._shed_locked(victim, "evicted_for_higher_class")
-        self._gauge_depth()
+            victims.append((victim, "evicted_for_higher_class",
+                            self._pending_items))
+        return victims
 
     def flush(self):
         """Close the current window immediately (tests, shutdown paths)."""
@@ -312,7 +346,7 @@ class VerifyScheduler(BaseService):
                 subs.extend(q)
                 q.clear()
             self._pending_items = 0
-            self._gauge_depth()
+        self._publish_depth()
         for sub in subs:
             sub.future._set_exception(exc)
         self._drain_staged(exc)
@@ -370,6 +404,8 @@ class VerifyScheduler(BaseService):
         """Block until the window closes (time/size/deadline/flush),
         then drain submissions in priority order up to max_batch items
         (whole submissions; always at least one)."""
+        out: List[_Submission] = []
+        drained = False
         with self._cond:
             while not self.quitting.is_set():
                 if self._pending_items == 0:
@@ -384,9 +420,13 @@ class VerifyScheduler(BaseService):
                 if (self._flush_req or now >= close_at
                         or self._pending_items >= self.max_batch):
                     self._flush_req = False
-                    return self._drain_locked()
+                    out = self._drain_locked()
+                    drained = True
+                    break
                 self._cond.wait(min(max(close_at - now, 0.0005), 0.05))
-        return []
+        if drained:  # gauge published outside _cond (TM201)
+            self._publish_depth()
+        return out
 
     def _oldest_enq_locked(self) -> float:
         return min(q[0].enq_t for q in self._queues.values() if q)
@@ -408,7 +448,6 @@ class VerifyScheduler(BaseService):
             if taken >= self.max_batch and out:
                 break
         self._pending_items -= taken
-        self._gauge_depth()
         return out
 
     def _stage(self, subs: List[_Submission]) -> Optional[_Launch]:

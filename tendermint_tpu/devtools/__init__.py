@@ -1,0 +1,10 @@
+"""Developer tooling that ships with the package but is never imported
+by the node runtime: tmlint (invariant-enforcing static analysis +
+runtime sanitizers, docs/adr/adr-014-tmlint.md) and the declared
+lock-order table it checks against (lockorder.py).
+
+Nothing here may import jax: the static passes run as a tier-1 gate
+before any kernel module is touched, and `python -m
+tendermint_tpu.devtools.tmlint` must work on a machine with no
+accelerator stack at all.
+"""

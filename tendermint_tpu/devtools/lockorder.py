@@ -1,0 +1,90 @@
+"""The declared lock-order table for the verify stack.
+
+Five PRs of concurrency (degradation runtime, VerifyScheduler,
+DeviceLRU, comb table index, flight recorder) left ~25 locks in the
+core modules.  This table makes the acquisition order an explicit,
+machine-checked contract: tmlint's static pass builds the
+acquires-while-holding graph from the AST and the lockset monitor
+(TM_TPU_LOCKSAN=1) records the real acquisition order at runtime —
+both fail on an edge that acquires a LOWER-ranked lock while holding a
+HIGHER-ranked one.
+
+Rules of the table:
+
+  * A lock id is "<path>:<Class>.<attr>" for instance locks and
+    "<path>:<name>" for module-level locks, with <path> relative to the
+    repo root.  tmlint derives the same ids from creation sites
+    (`self._x = threading.Lock()` / `_x = threading.Lock()`), so adding
+    a lock without a row here fails the TM203 rule in core modules, and
+    a row whose creation site disappeared fails TM204 (no table rot in
+    either direction).
+  * Lower rank = acquired FIRST.  While holding rank r, only locks of
+    rank > r may be acquired.  Two locks that are never nested may sit
+    anywhere relative to each other; give every new lock its own value
+    so a future nesting has a defined verdict.
+  * Utility locks everything calls into (metrics, trace ring) rank
+    HIGHEST: they must always be acquired last and hold nothing.
+  * Condition variables rank like locks; waiting on the condition you
+    hold is allowed (wait releases it), waiting on anything else under
+    a lock is a blocking-call finding (TM202).
+
+Intended nestings this table encodes:
+
+  degrade._runtime_lock (5)  -> metrics Registry/_Metric (80/84):
+      runtime() constructs CryptoMetrics under the install lock.
+  VerifyScheduler._cond (20) -> _stats_lock (28):
+      submit/evict update pipeline stats while holding the queue cond.
+  ed25519._table_key_lock (44) -> DeviceLRU._lock (48):
+      eviction repointing peeks surviving cache entries while holding
+      the key index.
+"""
+from __future__ import annotations
+
+# rank by lock id; see module docstring for the id grammar
+LOCK_ORDER = {
+    # -- process-global installers (held while constructing the world) --
+    "tendermint_tpu/crypto/degrade.py:_runtime_lock": 5,
+    "tendermint_tpu/crypto/scheduler.py:_global_lock": 10,
+
+    # -- VerifyScheduler pipeline --
+    "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._cond": 20,
+    "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._res_lock": 24,
+    "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._stats_lock": 28,
+
+    # -- batch verifier / caches --
+    "tendermint_tpu/crypto/batch.py:SigCache._lock": 32,
+
+    # -- degradation runtime --
+    "tendermint_tpu/crypto/degrade.py:CircuitBreaker._lock": 36,
+    "tendermint_tpu/crypto/degrade.py:DeviceLaneRuntime._pool_lock": 38,
+    "tendermint_tpu/crypto/degrade.py:DeviceLaneRuntime._backend_lock": 40,
+
+    # -- device-resident caches and launch bookkeeping (ops/) --
+    "tendermint_tpu/ops/ed25519.py:_table_key_lock": 44,
+    "tendermint_tpu/ops/ed25519.py:DeviceLRU._lock": 48,
+    "tendermint_tpu/ops/ed25519.py:_base_comb_lock": 52,
+    "tendermint_tpu/ops/ed25519.py:_launch_lock": 54,
+    "tendermint_tpu/ops/msm.py:_route_lock": 56,
+    "tendermint_tpu/parallel/sharding.py:_PLANE_LOCK": 57,
+    "tendermint_tpu/parallel/sharding.py:_DataPlane._lock": 58,
+
+    # -- libs/ leaves --
+    "tendermint_tpu/libs/service.py:BaseService._mtx": 60,
+    "tendermint_tpu/libs/fail.py:_lock": 62,
+    "tendermint_tpu/libs/log.py:_lock": 64,
+    "tendermint_tpu/libs/native.py:_lock": 66,
+    "tendermint_tpu/libs/kvdb.py:MemDB._lock": 68,
+    "tendermint_tpu/libs/kvdb.py:SQLiteDB._lock": 69,
+    "tendermint_tpu/libs/autofile.py:Group._lock": 70,
+    "tendermint_tpu/libs/flowrate.py:Monitor._lock": 72,
+
+    # -- observability: always acquired last, hold nothing --
+    "tendermint_tpu/libs/metrics.py:Registry._lock": 80,
+    "tendermint_tpu/libs/metrics.py:_Metric._lock": 84,
+    "tendermint_tpu/libs/trace.py:Tracer._lock": 90,
+}
+
+
+def rank(lock_id: str):
+    """Declared rank of a lock id, or None when unranked."""
+    return LOCK_ORDER.get(lock_id)

@@ -1,0 +1,18 @@
+"""tmlint — invariant-enforcing static analysis + runtime sanitizers
+for the tendermint_tpu verify stack (docs/adr/adr-014-tmlint.md).
+
+Static passes (pure AST, no jax):
+  passes_shape    TM101/TM102  compile-shape discipline at kernel seams
+  passes_locks    TM201-TM204  lock order, blocking calls, table parity
+  passes_hygiene  TM301-TM307  threads, optional deps, f-strings,
+                               except-pass, chaos/trace/metric registries
+
+Runtime sanitizers (tmlint.runtime, imported only by tests):
+  CompileSentinel  per-test XLA bucket/compile accounting
+  LockSanitizer    lockset monitor against devtools/lockorder.py
+
+CLI:  python -m tendermint_tpu.devtools.tmlint \
+          --baseline devtools/lint_baseline.json
+"""
+from .core import (Finding, RULES, RULES_BY_ID, generate_docs,  # noqa: F401
+                   load_baseline, load_corpus, main, run_lint)

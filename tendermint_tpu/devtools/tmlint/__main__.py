@@ -1,0 +1,6 @@
+"""`python -m tendermint_tpu.devtools.tmlint` entry point."""
+import sys
+
+from .core import main
+
+sys.exit(main())

@@ -1,0 +1,487 @@
+"""TM201-TM204 — lock-order and blocking-call analysis.
+
+Phase 1 walks every file for lock creation sites
+(``self._x = threading.Lock()`` inside a class, module-level
+``_x = threading.Lock()``) and derives the same ids
+devtools/lockorder.py declares ranks for.
+
+Phase 2 builds per-function summaries: which locks a function acquires
+directly (``with self._x:`` / ``with _x:``), which calls it makes while
+holding which locks, and which blocking calls appear under a held lock.
+Call targets resolve naively but effectively for this codebase:
+``self.m()`` to the enclosing class, ``mod.f()`` through the import
+table to analyzed modules, bare ``f()`` to the same module.
+
+Phase 3 closes the call graph to a fixpoint (transitive acquire sets),
+emits the acquires-while-holding edge set, and checks it against the
+declared ranks: an edge from rank a to rank b requires a < b (TM201);
+any cycle among creation-site locks is TM201 regardless of ranks.
+Blocking calls (queue get/put, future .result, .join, sleep, waiting
+on a primitive other than the held condition, device kernel entries)
+under a RANKED lock are TM202.  Core-module locks with no rank are
+TM203; declared ranks with no creation site are TM204.
+
+The static pass underapproximates (dynamic dispatch, callbacks); its
+runtime twin — the lockset monitor in tmlint/runtime.py, armed with
+TM_TPU_LOCKSAN=1 — records the ACTUAL acquisition order in the
+scheduler/degrade/comb tests against the same table.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tendermint_tpu.devtools import lockorder
+
+from .core import Corpus, Finding
+from .passes_shape import CROSS_MODULE_ENTRIES, _call_name
+
+# lock-order discipline is enforced in the concurrency core; p2p/rpc
+# socket locks serialize I/O by design and stay out of the table
+CORE_SCOPE = ("tendermint_tpu/crypto/", "tendermint_tpu/ops/",
+              "tendermint_tpu/libs/", "tendermint_tpu/parallel/")
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# callee attribute names that block the calling thread
+BLOCKING_ATTRS = {"result", "join", "sleep", "serve_forever", "accept",
+                  "recv", "recv_into", "sendall", "connect", "select",
+                  "block_until_ready", "device_put"}
+# queue verbs: blocking unless the _nowait variant
+QUEUE_ATTRS = {"get", "put"}
+# jitted kernel entries whose CALL launches device work; building a
+# shard_map/pallas_call wrapper is lazy and cheap, so those two are
+# excluded here even though they are shape-discipline entries
+KERNEL_LAUNCH_ENTRIES = CROSS_MODULE_ENTRIES - {"shard_map",
+                                                "pallas_call"}
+
+
+@dataclass(frozen=True)
+class LockSite:
+    lock_id: str       # "path:Class.attr" / "path:name"
+    path: str
+    line: int
+    kind: str          # Lock / RLock / Condition
+    scope: str         # "class" / "module" / "local"
+
+
+@dataclass
+class FnSummary:
+    key: Tuple[str, Optional[str], str]     # (path, class, name)
+    acquires: Set[str] = field(default_factory=set)
+    # calls made while holding locks: (callee_key_candidates, held ids,
+    # line) — candidates because resolution is by name
+    calls: List[Tuple[List[Tuple[str, Optional[str], str]],
+                      Tuple[str, ...], int]] = field(default_factory=list)
+    blocking: List[Tuple[str, Tuple[str, ...], int]] = \
+        field(default_factory=list)
+    direct_edges: Set[Tuple[str, str, int]] = field(default_factory=set)
+
+
+def _lock_factory_kind(call: ast.AST) -> Optional[str]:
+    """'Lock' for threading.Lock() / Lock() / __import__("threading")
+    .Lock(); None otherwise."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    name = _call_name(f)
+    if name not in LOCK_FACTORIES:
+        return None
+    if isinstance(f, ast.Name):
+        return name
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name) and v.id == "threading":
+            return name
+        if isinstance(v, ast.Call) and _call_name(v.func) == "__import__":
+            return name
+    return None
+
+
+def lock_creation_sites(corpus: Corpus) -> List[LockSite]:
+    sites: List[LockSite] = []
+    for f in sorted(corpus.files.values(), key=lambda x: x.path):
+        if f.tree is None:
+            continue
+
+        def scan_body(body, cls: Optional[str], fn: Optional[str]):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    scan_body(node.body, node.name, None)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    scan_body(node.body, cls, node.name)
+                elif isinstance(node, ast.Assign):
+                    kind = _lock_factory_kind(node.value)
+                    if kind is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and cls:
+                            sites.append(LockSite(
+                                f"{f.path}:{cls}.{t.attr}", f.path,
+                                node.lineno, kind, "class"))
+                        elif isinstance(t, ast.Name):
+                            if fn is None and cls is None:
+                                sites.append(LockSite(
+                                    f"{f.path}:{t.id}", f.path,
+                                    node.lineno, kind, "module"))
+                            else:
+                                sites.append(LockSite(
+                                    f"{f.path}:{fn or cls}.{t.id}",
+                                    f.path, node.lineno, kind, "local"))
+                else:
+                    for child in ast.iter_child_nodes(node):
+                        if not isinstance(child, ast.expr):
+                            # stmt or ExceptHandler/match_case: a lock
+                            # created in an except block is still a lock
+                            scan_body([child], cls, fn)
+
+        scan_body(f.tree.body, None, None)
+    return sites
+
+
+def _import_table(tree: ast.AST, path: str) -> Dict[str, str]:
+    """local alias -> dotted module for tendermint_tpu imports,
+    including relative ones (``from . import degrade``)."""
+    pkg_parts = path.rsplit("/", 1)[0].split("/") \
+        if "/" in path else []
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("tendermint_tpu"):
+                    out[(a.asname or a.name).split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: resolve against this file's pkg
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                mod = ".".join(base + ([node.module] if node.module
+                                       else []))
+            else:
+                mod = node.module or ""
+            if not mod.startswith("tendermint_tpu"):
+                continue
+            for a in node.names:
+                out[a.asname or a.name] = f"{mod}.{a.name}"
+    return out
+
+
+def _mod_to_path(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+class _FnLockWalk:
+    """Walk one function body tracking the held-lock stack."""
+
+    def __init__(self, path: str, cls: Optional[str],
+                 class_locks: Dict[Tuple[str, str], str],
+                 module_locks: Dict[Tuple[str, str], str],
+                 imports: Dict[str, str], summary: FnSummary,
+                 cond_ids: Set[str]):
+        self.path = path
+        self.cls = cls
+        self.class_locks = class_locks
+        self.module_locks = module_locks
+        self.imports = imports
+        self.s = summary
+        self.cond_ids = cond_ids
+        self.held: List[str] = []
+        self.nested: List[FnSummary] = []
+
+    # -- resolution -----------------------------------------------------
+
+    def _lock_ref(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls:
+            return self.class_locks.get((self.cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get((self.path, expr.id))
+        return None
+
+    def _callee_keys(self, func: ast.AST) \
+            -> List[Tuple[str, Optional[str], str]]:
+        if isinstance(func, ast.Name):
+            tgt = self.imports.get(func.id)
+            if tgt:  # from tendermint_tpu.x import f
+                mod, _, name = tgt.rpartition(".")
+                return [(_mod_to_path(mod), None, name)]
+            return [(self.path, None, func.id),
+                    (self.path, self.cls, func.id)]
+        if isinstance(func, ast.Attribute):
+            v = func.value
+            if isinstance(v, ast.Name):
+                if v.id in ("self", "cls") and self.cls:
+                    return [(self.path, self.cls, func.attr)]
+                tgt = self.imports.get(v.id)
+                if tgt:
+                    return [(_mod_to_path(tgt), None, func.attr)]
+        return []
+
+    # -- walk -----------------------------------------------------------
+
+    def run(self, fn: ast.AST):
+        for st in fn.body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.AST):
+        if isinstance(st, ast.With):
+            pushed = 0
+            for item in st.items:
+                self._expr(item.context_expr)
+                lid = self._lock_ref(item.context_expr)
+                if lid is not None:
+                    for held in self.held:
+                        if held != lid:
+                            self.s.direct_edges.add(
+                                (held, lid, st.lineno))
+                    self.s.acquires.add(lid)
+                    self.held.append(lid)
+                    pushed += 1
+            for s in st.body:
+                self._stmt(s)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body runs LATER (not under the current
+            # held set), and what it acquires must NOT count as an
+            # acquisition of the enclosing factory — collect it into a
+            # sibling summary so direct nesting inside the closure is
+            # still checked
+            sub = FnSummary((self.path, self.cls,
+                             f"{self.s.key[2]}.{st.name}"))
+            walker = _FnLockWalk(self.path, self.cls, self.class_locks,
+                                 self.module_locks, self.imports, sub,
+                                 self.cond_ids)
+            walker.run(st)
+            self.nested.append(sub)
+            self.nested.extend(walker.nested)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            else:
+                # stmt OR a non-stmt container (ast.ExceptHandler,
+                # ast.match_case, withitem...): recurse — lock
+                # acquisitions and blocking calls in error-recovery
+                # paths must not be invisible
+                self._stmt(child)
+
+    def _expr(self, expr: ast.AST):
+        # skip Lambda bodies: a lambda built under a lock runs later,
+        # not while the lock is held
+        lambda_nodes = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node.body):
+                    lambda_nodes.add(id(sub))
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call) or id(node) in lambda_nodes:
+                continue
+            self._call(node)
+
+    def _call(self, node: ast.Call):
+        name = _call_name(node.func)
+        held = tuple(self.held)
+        # record EVERY resolvable call (held or not): the transitive
+        # closure must see lock-free intermediates — submit() holds
+        # _cond while calling _gauge_depth(), which only via _metrics()
+        # reaches degrade.runtime()'s install lock
+        keys = self._callee_keys(node.func)
+        if keys:
+            self.s.calls.append((keys, held, node.lineno))
+        if held:
+            self._check_blocking(node, name, held)
+
+    def _check_blocking(self, node: ast.Call, name: Optional[str],
+                        held: Tuple[str, ...]):
+        if name is None:
+            return
+        desc = None
+        if name in BLOCKING_ATTRS:
+            # allow event.wait-style names only via the wait rule below;
+            # .sleep only when the receiver is `time`
+            if name == "sleep":
+                v = getattr(node.func, "value", None)
+                if not (isinstance(v, ast.Name) and v.id == "time"):
+                    return
+            desc = f".{name}()"
+        elif name in QUEUE_ATTRS and isinstance(node.func, ast.Attribute):
+            # heuristic: queue-like receivers (self._q, *_queue, staged)
+            v = node.func.value
+            rname = v.attr if isinstance(v, ast.Attribute) else \
+                (v.id if isinstance(v, ast.Name) else "")
+            if not any(h in rname.lower() for h in ("q", "queue",
+                                                    "staged")):
+                return
+            desc = f"{rname}.{name}()"
+        elif name == "wait" and isinstance(node.func, ast.Attribute):
+            # waiting on the condition you hold is the whole point of a
+            # condition variable; waiting on anything else under a lock
+            # parks the thread with the lock held
+            ref = self._lock_ref(node.func.value)
+            if ref is not None and ref in self.held and \
+                    ref in self.cond_ids:
+                return
+            if ref is None and not isinstance(node.func.value,
+                                              (ast.Name, ast.Attribute)):
+                return
+            desc = ".wait() on a primitive other than the held condition"
+        elif name in KERNEL_LAUNCH_ENTRIES:
+            desc = f"device kernel entry {name}()"
+        if desc is not None:
+            self.s.blocking.append((desc, held, node.lineno))
+
+
+def _build_summaries(corpus: Corpus, sites: List[LockSite]):
+    class_locks: Dict[str, Dict[Tuple[str, str], str]] = {}
+    module_locks: Dict[Tuple[str, str], str] = {}
+    cond_ids = {s.lock_id for s in sites if s.kind == "Condition"}
+    for s in sites:
+        mod, _, qual = s.lock_id.partition(":")
+        if s.scope == "class":
+            cls, attr = qual.split(".", 1)
+            class_locks.setdefault(s.path, {})[(cls, attr)] = s.lock_id
+        elif s.scope == "module":
+            module_locks[(s.path, qual)] = s.lock_id
+
+    summaries: Dict[Tuple[str, Optional[str], str], FnSummary] = {}
+    for f in corpus.files.values():
+        if f.tree is None:
+            continue
+        imports = _import_table(f.tree, f.path)
+
+        def visit_fn(fn, cls: Optional[str]):
+            key = (f.path, cls, fn.name)
+            summary = FnSummary(key)
+            walker = _FnLockWalk(f.path, cls, class_locks.get(f.path, {}),
+                                 module_locks, imports, summary, cond_ids)
+            walker.run(fn)
+            summaries[key] = summary
+            for sub in walker.nested:  # closures: own edge context,
+                # invisible to the name-resolved call graph
+                summaries.setdefault(sub.key, sub)
+
+        for node in f.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        visit_fn(sub, node.name)
+    return summaries
+
+
+def _transitive_acquires(summaries) -> Dict[Tuple, Set[str]]:
+    """Fixpoint: locks a call to fn may acquire (directly or via
+    callees)."""
+    acq = {k: set(s.acquires) for k, s in summaries.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, s in summaries.items():
+            for keys, _held, _line in s.calls:
+                for cand in keys:
+                    got = acq.get(cand)
+                    if got and not got <= acq[k]:
+                        acq[k] |= got
+                        changed = True
+    return acq
+
+
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = lock_creation_sites(corpus)
+
+    # TM203: core locks must be ranked (module + instance locks; locals
+    # are scoped to one call and cannot order-invert across threads)
+    declared = set(lockorder.LOCK_ORDER)
+    seen_ids = set()
+    for s in sites:
+        seen_ids.add(s.lock_id)
+        if s.scope == "local":
+            continue
+        if s.path.startswith(CORE_SCOPE) and s.lock_id not in declared:
+            findings.append(Finding(
+                "TM203", s.path, s.line, s.lock_id.partition(":")[2],
+                f"lock {s.lock_id} has no rank in devtools/lockorder.py "
+                "— every core-module lock takes a declared position"))
+
+    # TM204: declared ranks must correspond to live creation sites
+    for lock_id in sorted(declared - seen_ids):
+        findings.append(Finding(
+            "TM204", "tendermint_tpu/devtools/lockorder.py", 1,
+            lock_id.partition(":")[2],
+            f"declared lock {lock_id} has no creation site in the tree "
+            "(renamed or removed?) — drop or fix the table row"))
+
+    summaries = _build_summaries(corpus, sites)
+    acq = _transitive_acquires(summaries)
+
+    # edge set: direct with-nesting plus call-closure edges
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for k, s in summaries.items():
+        path, cls, name = k
+        qual = f"{cls}.{name}" if cls else name
+        for a, b, line in s.direct_edges:
+            edges.setdefault((a, b), (path, line, qual))
+        for keys, held, line in s.calls:
+            if not held:
+                continue
+            for cand in keys:
+                for b in acq.get(cand, ()):
+                    for a in held:
+                        if a != b:
+                            edges.setdefault(
+                                (a, b),
+                                (path, line,
+                                 f"{qual} -> {cand[2]}()"))
+
+    # TM201: rank violations on edges
+    for (a, b), (path, line, qual) in sorted(edges.items()):
+        ra, rb = lockorder.rank(a), lockorder.rank(b)
+        if ra is not None and rb is not None and ra >= rb:
+            findings.append(Finding(
+                "TM201", path, line, qual,
+                f"acquires {b} (rank {rb}) while holding {a} (rank "
+                f"{ra}); declared order requires "
+                f"{'strictly lower-ranked locks first' if ra > rb else 'distinct ranks for nested locks'}"))
+
+    # TM201: cycles even among unranked locks
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for start in sorted(graph):
+        stack, seen = [(start, [start])], set()
+        while stack:
+            cur, trail = stack.pop()
+            for nxt in graph.get(cur, ()):
+                if nxt == start:
+                    path, line, qual = edges[(cur, nxt)]
+                    cyc = " -> ".join(trail + [start])
+                    findings.append(Finding(
+                        "TM201", path, line, qual,
+                        f"lock cycle: {cyc}"))
+                elif nxt not in seen and nxt > start:
+                    seen.add(nxt)
+                    stack.append((nxt, trail + [nxt]))
+
+    # TM202: blocking calls under a RANKED lock
+    for k, s in summaries.items():
+        path, cls, name = k
+        if not path.startswith(CORE_SCOPE) and path != "bench.py":
+            continue
+        qual = f"{cls}.{name}" if cls else name
+        for desc, held, line in s.blocking:
+            ranked = [h for h in held if lockorder.rank(h) is not None]
+            if ranked:
+                findings.append(Finding(
+                    "TM202", path, line, qual,
+                    f"blocking call {desc} while holding "
+                    f"{', '.join(ranked)} — park the thread only after "
+                    "releasing ranked locks"))
+    return findings

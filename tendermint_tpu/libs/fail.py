@@ -39,6 +39,54 @@ _lock = threading.Lock()
 _modes: Dict[str, str] = {}
 _fired: Dict[Tuple[str, str], int] = {}
 
+# ---------------------------------------------------------------------------
+# the chaos-site registry (tmlint TM305 + tests/test_lint.py coverage
+# gate).  Every fail.inject/corrupt_bitmap call site must be reachable
+# from this registry: literal sites appear in REGISTERED_SITES, and
+# dynamic sites (crypto/degrade.py injects at the caller-supplied lane
+# site, "batch.<scheme>" / "sched.<scheme>" / "bulk.<scheme>") must
+# match a DYNAMIC_SITE_PREFIXES family.  set_mode() refuses to arm an
+# unregistered site, so a typo'd chaos test fails loudly instead of
+# silently never injecting — and the coverage test can assert every
+# registered site is actually exercised by the chaos suites.
+# Tests register throwaway sites with register().
+# ---------------------------------------------------------------------------
+
+REGISTERED_SITES = frozenset({
+    # device-kernel entry seams (ops/)
+    "ops.ed25519.verify_batch",   # the ladder/RLC/comb dispatch seam
+    "ops.ed25519.comb",           # the fixed-base comb route (ADR-013)
+    "ops.sr25519.verify_batch",   # the ristretto lane seam
+    # degradation-runtime lane sites (crypto/degrade.py submit/run):
+    # one per (consumer, scheme) lane family — enumerated so the chaos
+    # coverage gate can demand at least one exercised site per family
+    "batch.ed25519", "batch.sr25519", "batch.secp256k1",
+    "sched.ed25519", "sched.sr25519", "sched.secp256k1",
+    "bulk.ed25519",
+})
+
+# families for sites assembled at runtime (f"batch.{scheme}" in
+# crypto/batch.py, f"sched.{scheme}" in crypto/scheduler.py)
+DYNAMIC_SITE_PREFIXES = frozenset({"batch.", "sched.", "bulk."})
+
+_extra_sites: set = set()
+
+
+def register(site: str) -> str:
+    """Register an ad-hoc site (tests, experiments).  Returns it."""
+    with _lock:
+        _extra_sites.add(site)
+    return site
+
+
+def is_registered(site: str) -> bool:
+    if site == "*" or site in REGISTERED_SITES:
+        return True
+    with _lock:
+        if site in _extra_sites:
+            return True
+    return any(site.startswith(p) for p in DYNAMIC_SITE_PREFIXES)
+
 
 class InjectedFault(RuntimeError):
     """A chaos-injected device fault (mode "raise")."""
@@ -63,11 +111,18 @@ def fail_point(_site_id: int = 0):
 
 
 def reset():
-    global _counter
+    """Back to a pristine state: counter, modes, hit counts, AD-HOC
+    site registrations and the env-validation cache.  Clearing
+    _extra_sites matters for the unregistered-site guard: a site one
+    test registered must not let a later test's typo of the same name
+    arm silently."""
+    global _counter, _env_validated
     with _lock:
         _counter = 0
         _modes.clear()
         _fired.clear()
+        _extra_sites.clear()
+    _env_validated = None
 
 
 # ---------------------------------------------------------------------------
@@ -78,7 +133,14 @@ def set_mode(site: str, mode: Optional[str]):
     """Arm (or with mode=None disarm) an injection mode at a named site.
     The mode stays armed until cleared — chaos tests drive the breaker
     through open/backoff/re-close by arming, verifying repeatedly, then
-    disarming."""
+    disarming.  Arming an UNREGISTERED site raises: a typo'd site name
+    would otherwise never fire and the chaos test would silently pass
+    without injecting anything (register ad-hoc test sites with
+    register())."""
+    if mode is not None and not is_registered(site):
+        raise ValueError(
+            f"fail site {site!r} is not registered (REGISTERED_SITES / "
+            f"DYNAMIC_SITE_PREFIXES in libs/fail.py, or fail.register)")
     with _lock:
         if mode is None:
             _modes.pop(site, None)
@@ -99,6 +161,30 @@ def fired(site: str, mode: str) -> int:
         return _fired.get((site, mode), 0)
 
 
+_env_validated: Optional[str] = None
+
+
+def _validate_env(env: str):
+    """Every TM_TPU_FAILPOINTS key must be a registered site: a typo'd
+    key would otherwise never match and the chaos subprocess would run
+    green without ever injecting — the same silent failure set_mode()
+    refuses.  Validated once per distinct env value, at the first
+    inject() that reads it, so the error surfaces loudly inside the
+    armed process."""
+    global _env_validated
+    if env == _env_validated:
+        return
+    for entry in env.split(";"):
+        k, _, v = entry.partition("=")
+        k = k.strip()
+        if v and k and k != "*" and not is_registered(k):
+            raise ValueError(
+                f"TM_TPU_FAILPOINTS site {k!r} is not registered "
+                f"(REGISTERED_SITES / DYNAMIC_SITE_PREFIXES in "
+                f"libs/fail.py)")
+    _env_validated = env
+
+
 def _mode_for(site: str) -> Optional[str]:
     with _lock:
         m = _modes.get(site) or _modes.get("*")
@@ -107,6 +193,7 @@ def _mode_for(site: str) -> Optional[str]:
     env = os.environ.get("TM_TPU_FAILPOINTS", "")
     if not env:
         return None
+    _validate_env(env)
     for entry in env.split(";"):
         k, _, v = entry.partition("=")
         if v and k.strip() in (site, "*"):

@@ -6,11 +6,26 @@ from __future__ import annotations
 
 from concurrent import futures
 
-import grpc
+try:
+    import grpc
+except ImportError:  # optional dep: the node runs without the gRPC
+    grpc = None      # transports; construction raises a clear error
+
+
+def require_grpc():
+    """Raise an actionable error when the optional grpcio dependency is
+    absent; every server/channel constructor calls this first."""
+    if grpc is None:
+        raise RuntimeError(
+            "grpcio is not installed: the gRPC transports "
+            "(abci/grpc.py, rpc/grpc_api.py) are unavailable — install "
+            "grpcio or use the socket transport")
+    return grpc
 
 
 def raw_unary_handler(fn):
     """Wrap a bytes->bytes unary handler (no message classes)."""
+    require_grpc()
     return grpc.unary_unary_rpc_method_handler(
         fn,
         request_deserializer=lambda b: b,
@@ -21,6 +36,7 @@ def serve_generic(service: str, handlers: dict, addr: str,
                   max_workers: int, thread_prefix: str):
     """Bind + start a generic-handler server.  Returns
     (server, bound_addr) — addr may use port 0 for an ephemeral port."""
+    require_grpc()
     server = grpc.server(futures.ThreadPoolExecutor(
         max_workers=max_workers, thread_name_prefix=thread_prefix))
     server.add_generic_rpc_handlers(
@@ -36,6 +52,7 @@ def serve_generic(service: str, handlers: dict, addr: str,
 def connect_channel(addr: str, timeout: float, what: str):
     """Open an insecure channel and wait for readiness; raises
     ConnectionError (channel closed) on timeout."""
+    require_grpc()
     channel = grpc.insecure_channel(addr)
     try:
         grpc.channel_ready_future(channel).result(timeout=timeout)

@@ -48,6 +48,35 @@ from typing import Any, Dict, List, Optional
 
 _UNSET = object()  # sentinel: "inherit parent from the thread's stack"
 
+# ---------------------------------------------------------------------------
+# the span-name registry (tmlint TM306).  Every literal name passed to
+# trace.span()/trace.instant() must appear here: trace consumers (the
+# bench report's route columns, the debug-trace CLI, the scheduler
+# acceptance tests walking span trees) key on these strings, so an
+# unregistered name is either a typo or an undocumented contract.
+# Grouped by subsystem; keep alphabetical within a group.
+# ---------------------------------------------------------------------------
+
+KNOWN_SPANS = frozenset({
+    # crypto/batch.py — the BatchVerifier coalesce window
+    "batch.host_lane", "batch.verdict", "batch.verify",
+    # bench.py
+    "bench.host_baseline", "bench.pass",
+    # crypto/degrade.py — breaker + device lane lifecycle
+    "breaker.transition", "device.collect", "device.host_fallback",
+    "device.launch",
+    # consensus/state.py
+    "consensus.finalize_commit", "consensus.preverify",
+    "consensus.step", "consensus.vote",
+    # ops/ — kernel routing
+    "msm.route", "ops.ed25519.verify_batch", "table_build",
+    # crypto/scheduler.py — the VerifyScheduler pipeline
+    "sched.coalesce", "sched.host_lane", "sched.launch",
+    "sched.resolve", "sched.shed", "sched.submit",
+    # state/execution.py
+    "state.apply_block", "state.validate_block",
+})
+
 
 class _NoopSpan:
     """The disabled path: one shared instance, every method a no-op."""

@@ -12,8 +12,10 @@ sub-messages reuse the socket codec byte-for-byte.  Gated by
 """
 from __future__ import annotations
 
-
-import grpc
+try:
+    import grpc
+except ImportError:  # optional dep: grpc_util.require_grpc() raises a
+    grpc = None      # clear error before any use can be reached
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.grpc import (decode_response_bare,

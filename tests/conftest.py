@@ -29,6 +29,46 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _locksan(request):
+    """tmlint lockset monitor (docs/adr/adr-014-tmlint.md): armed for
+    EVERY test under TM_TPU_LOCKSAN=1, or per-test via the `locksan`
+    marker.  Locks created by tendermint_tpu modules during the test
+    are wrapped; an acquisition that takes a lower-ranked lock while
+    holding a higher-ranked one (devtools/lockorder.py) fails the test
+    with the offending edge.  Pre-existing singletons keep their raw
+    locks — scheduler/degrade/comb tests build fresh runtimes, which is
+    exactly where the ordering matters."""
+    armed = os.environ.get("TM_TPU_LOCKSAN") == "1" or \
+        request.node.get_closest_marker("locksan") is not None
+    if not armed:
+        yield None
+        return
+    from tendermint_tpu.devtools.tmlint.runtime import LockSanitizer
+    san = LockSanitizer()
+    san.install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+    assert not san.violations, (
+        "lockset monitor: lock-order inversion(s) against "
+        "devtools/lockorder.py:\n  " + "\n  ".join(san.violations))
+
+
+@pytest.fixture
+def compile_sentinel():
+    """tmlint compile sentinel (opt-in): snapshots the launch-bucket
+    set and watched jit-entry cache sizes; at teardown fails the test
+    if a launch landed outside the known padded-lane shapes.  Tests
+    that must not compile anything new assert on the returned report or
+    construct their own CompileSentinel(max_new_compiles=0)."""
+    from tendermint_tpu.devtools.tmlint.runtime import CompileSentinel
+    s = CompileSentinel().start()
+    yield s
+    s.check()
+
+
+@pytest.fixture(autouse=True)
 def _no_thread_leaks():
     """Every worker thread in this codebase must either be a daemon
     (service.spawn, the degrade lane worker) or be joined by the test

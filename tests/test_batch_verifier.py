@@ -156,5 +156,37 @@ def test_graft_entry_single_chip():
     assert np.asarray(out).all()
 
 
+def test_device_launch_stays_in_shared_bucket(monkeypatch,
+                                              compile_sentinel):
+    """tmlint compile sentinel (ADR-014) proven on the real verify
+    path: a forced device batch of 60 sigs pads into the SHARED nb=64
+    lane bucket, so the sentinel's bucket-set check passes — it would
+    fail the test on any foreign padded shape (the seeded negative
+    lives in tests/test_lint.py).  Launch timeout is raised so a cold
+    first compile of the shared bucket (paid HERE instead of a later
+    chaos test, same per-process total) can't divert the lane to host
+    fallback mid-proof."""
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.ops import ed25519 as edops
+
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    degrade.configure(degrade.DegradeConfig(launch_timeout_s=600.0),
+                      registry=Registry("sentinel"))
+    try:
+        privs, msgs, sigs = _signed(60)
+        bv = BatchVerifier(tpu_threshold=8)
+        for p, m, s in zip(privs, msgs, sigs):
+            bv.add(p.pub_key(), m, s)
+        ok, bits = bv.verify()
+        assert ok and bits.all()
+        rec = edops.last_launch()
+        assert rec["n"] == 60 and rec["nb"] == 64, rec
+        report = compile_sentinel.check()
+        assert all(b[1] == 64 for b in report["new_buckets"]), report
+    finally:
+        degrade.reset()
+
+
 # dryrun_multichip coverage lives in tests/test_multichip.py (in-proc mesh
 # tests + a slow-marked hermetic subprocess test of the driver entry).

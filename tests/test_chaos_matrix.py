@@ -107,6 +107,36 @@ def test_bitmap_identical_to_host_under_injection(monkeypatch, site,
             site="batch.ed25519", reason=reason) == 1
 
 
+def test_sr25519_lane_chaos_raise_bitmap_exact():
+    """The ristretto lane's chaos seam (ops.sr25519.verify_batch — a
+    registered site in libs/fail.REGISTERED_SITES, asserted exercised
+    by tests/test_lint.py): an injected raise at the lane entry
+    degrades to host re-verify with the exact per-sig bitmap.  The
+    injection fires at function entry BEFORE any staging or kernel
+    dispatch, so this spends no XLA compile budget on the sr kernel."""
+    from tendermint_tpu.crypto import sr25519 as srpy
+
+    rt = _runtime()
+    n = 6
+    minis = [(0xBEE0 + i).to_bytes(32, "little") for i in range(n)]
+    msgs = [b"sr chaos %d" % i for i in range(n)]
+    sigs = [srpy.sign(minis[i], msgs[i]) for i in range(n)]
+    sigs[2] = bytes([sigs[2][0] ^ 1]) + sigs[2][1:]  # tamper R
+    pubs = [srpy.PrivKey(m).pub_key() for m in minis]
+    fail.set_mode("ops.sr25519.verify_batch", "raise")
+    bv = cb.BatchVerifier(tpu_threshold=4)
+    for p, m, s in zip(pubs, msgs, sigs):
+        bv.add(p, m, s)
+    ok, bits = bv.verify()
+    assert not ok
+    assert bits.tolist() == [True, True, False, True, True, True]
+    assert fail.fired("ops.sr25519.verify_batch", "raise") >= 1
+    assert rt.metrics.device_failures.value(
+        site="batch.sr25519", reason="raise") == 1
+    assert rt.metrics.host_fallbacks.value(
+        site="batch.sr25519", reason="raise") == 1
+
+
 def test_latency_past_deadline_times_out_bitmap_exact(monkeypatch):
     """The timeout class: a launch stalled past its wall-clock budget is
     abandoned and the batch re-verifies host-side — same bitmap, no

@@ -212,10 +212,15 @@ def test_runtime_spot_check_rejects_corrupt_device_result():
 
 
 def test_runtime_injection_sites():
-    """fail.py modes reach the device fn through submit()'s wrapper."""
+    """fail.py modes reach the device fn through submit()'s wrapper.
+    Ad-hoc sites must be registered before arming (a typo'd site in a
+    chaos test would otherwise never fire, tmlint TM305)."""
     rt = degrade.DeviceLaneRuntime(_cfg(failure_threshold=10),
                                    clock=Clock(), registry=Registry("t"))
     host = np.array([True])
+    with pytest.raises(ValueError, match="not registered"):
+        fail.set_mode("site", "raise")
+    fail.register("site")
     fail.set_mode("site", "raise")
     out = rt.run("site", lambda: np.array([False]), host_fn=lambda: host)
     assert (out == host).all()
@@ -267,6 +272,13 @@ def test_backend_probe_backoff(monkeypatch):
 
 
 def test_env_failpoints_parsing(monkeypatch):
+    # a typo'd env key must fail loudly at the first inject, not
+    # silently never fire (same contract as the set_mode guard)
+    monkeypatch.setenv("TM_TPU_FAILPOINTS", "a.typo=raise")
+    with pytest.raises(ValueError, match="not registered"):
+        fail.inject("anything.at.all")
+    fail.register("a.site")
+    fail.register("b.site")
     monkeypatch.setenv("TM_TPU_FAILPOINTS",
                        "a.site=raise; b.site=latency:1")
     with pytest.raises(fail.InjectedFault):
