@@ -431,6 +431,107 @@ def _comb_main():
         edops._comb_enabled_override, edops._comb_min_override = prev
 
 
+def _make_mixed_batch(n):
+    """n triples round-robined over the three key schemes with the
+    in-repo signers (no `cryptography` dependency), unique messages —
+    the PERF.md config-5 shape."""
+    from tendermint_tpu.crypto import ed25519 as edk
+    from tendermint_tpu.crypto import secp256k1 as secp
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    items = []
+    for i in range(n):
+        seed = (0xD000 + i).to_bytes(32, "big")
+        msg = b"mixed bench %6d" % i
+        if i % 3 == 0:
+            k = edk.PrivKey(seed)
+        elif i % 3 == 1:
+            k = secp.PrivKey.gen_from_secret(seed)
+        else:
+            k = sr.PrivKey(seed)
+        items.append((k.pub_key(), msg, k.sign(msg)))
+    return items
+
+
+def _mixed_main():
+    """Mixed-batch config (BENCH_MIXED=1, PERF.md config 5): one cold-
+    cache mixed ed25519+secp256k1+sr25519 batch through the production
+    BatchVerifier seam, concurrent lane executor (ADR-015) versus the
+    serial host-lane walk (host pool forced to 1 worker) on identical
+    fresh-cache batches.  One JSON line with the per-lane wall-time
+    decomposition + overlap ratio; without an accelerator every lane
+    runs on the host (rc=0, explicit note) and the number measures the
+    multi-core host pool alone."""
+    import threading
+
+    t_start = time.time()
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto import lanepool
+
+    n = int(os.environ.get("BENCH_MIXED_BATCH", "4096"))
+    items = _make_mixed_batch(n)
+    build_s = time.time() - t_start
+
+    platform, probe_err = _probe_backend()
+    device = probe_err is None and platform != "cpu"
+    if not device:
+        # keep the degradation runtime from re-probing a wedged backend
+        # inline (jax.default_backend can hang right back)
+        os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+        print(f"# mixed bench: host-only "
+              f"({probe_err or 'cpu backend'})", file=sys.stderr)
+
+    def run_once():
+        cbatch.verified_sigs = cbatch.SigCache()  # COLD cache each pass
+        bv = cbatch.BatchVerifier()
+        for pub, m, s in items:
+            bv.add(pub, m, s)
+        t0 = time.perf_counter()
+        ok, bits = bv.verify()
+        dt = time.perf_counter() - t0
+        assert ok, "mixed bench rejected valid signatures"
+        return dt, dict(cbatch.last_lane_report())
+
+    # one untimed warm-up pass over the REAL mixed batch: it compiles
+    # every device lane this batch will dispatch (ed AND — default-on —
+    # secp/sr, each historically a 40-300 s one-off per bucket) and
+    # lazily cc-builds the native .so, so neither one-time cost lands
+    # inside a timed pass.  run_once resets the SigCache before every
+    # verify, so the timed passes below are still cold-cache.
+    run_once()
+
+    # serial comparator: the pre-ADR-015 shape (one host core walks the
+    # host lanes back to back)
+    lanepool.set_workers(1)
+    try:
+        serial_s, serial_rep = run_once()
+    finally:
+        lanepool.set_workers(None)
+    conc_s, rep = run_once()
+
+    line = {
+        "metric": "mixed_3scheme_verify_e2e",
+        "value": round(n / conc_s, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(serial_s / conc_s, 2),
+        "serial_sigs_per_s": round(n / serial_s, 1),
+        "wall_s": round(conc_s, 4),
+        "lanes": rep.get("lanes"),
+        "lane_sum_s": rep.get("sum_s"),
+        "overlap_ratio": rep.get("overlap_ratio"),
+        "host_pool_workers": lanepool.workers(),
+        "active_threads": threading.active_count(),
+        "trace": _trace_artifact("mixed"),
+    }
+    if not device:
+        line["note"] = "device unavailable, host fallback"
+    print(json.dumps(line))
+    print(f"# mixed bench: n={n} build_s={build_s:.1f} "
+          f"serial_s={serial_s:.3f} concurrent_s={conc_s:.3f} "
+          f"serial_overlap={serial_rep.get('overlap_ratio')} "
+          f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
@@ -445,6 +546,9 @@ def main():
         return
     if os.environ.get("BENCH_COMB") == "1":
         _comb_main()
+        return
+    if os.environ.get("BENCH_MIXED") == "1":
+        _mixed_main()
         return
     t_start = time.time()
     pubs, msgs, sigs = _make_batch(BATCH)

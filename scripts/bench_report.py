@@ -233,8 +233,18 @@ def config5_mixed(n=4096):
     ok, _ = bv.verify()
     dt = time.perf_counter() - t0
     assert ok
+    # per-lane decomposition from the concurrent lane executor
+    # (ADR-015): which scheme ran where, for how long, and how much the
+    # lanes actually overlapped (0 = the old serial host-lane walk)
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto import lanepool
+    rep = cbatch.last_lane_report()
     return {"config": f"5: mixed 3-scheme batch ({n}, cold cache)",
             "wall_s": round(dt, 2), "sigs_per_s": round(n / dt),
+            "lanes": rep.get("lanes"),
+            "lane_sum_s": rep.get("sum_s"),
+            "overlap_ratio": rep.get("overlap_ratio"),
+            "host_pool_workers": lanepool.workers(),
             **_launch_cols(base)}
 
 

@@ -125,11 +125,17 @@ class BatchVerifierConfig:
     # reference Go verifier is cofactorless, and a mixed fleet could be
     # chain-split by an adversarial small-order-component signature.
     rlc: bool = False
-    # opt-in to the secp256k1 TPU lane (ops/secp.py).  OFF by default:
-    # verdicts are exact either way, but the host C lane is the measured
-    # production path and the device lane only pays off with a
-    # co-located chip.
-    secp_lane: bool = False
+    # secp256k1 TPU lane (ops/secp.py).  ON by default since ADR-015:
+    # verdicts are exact either way, the lane only engages when an
+    # accelerator is attached, and it runs under the full degradation
+    # runtime (breaker/timeout/host-C-fallback, chaos parity at site
+    # ops.secp.verify_batch).  `secp_lane = false` is the rollback
+    # switch to the host C lane.
+    secp_lane: bool = True
+    # host-lane verify pool (crypto/lanepool.py, ADR-015): worker count
+    # for the multi-core native C lanes of a mixed batch.  0 = auto
+    # (os.cpu_count()); 1 = serial in-caller (pool disabled).
+    host_pool_workers: int = 0
     # fixed-base comb verify path (ops/ed25519, ADR-013): per-validator
     # window tables kept device-resident so known-set batches verify
     # with zero doublings.  ON by default — the verdict is the exact
@@ -148,6 +154,10 @@ class BatchVerifierConfig:
                              ">= 0")
         if self.table_cache_mb < 0:
             raise ValueError("batch_verifier.table_cache_mb must be "
+                             ">= 0")
+        # 0 = auto-size, 1 = serial; only negatives are nonsense
+        if self.host_pool_workers < 0:
+            raise ValueError("batch_verifier.host_pool_workers must be "
                              ">= 0")
 
 
@@ -312,6 +322,7 @@ rlc = {str(self.batch_verifier.rlc).lower()}
 secp_lane = {str(self.batch_verifier.secp_lane).lower()}
 comb = {str(self.batch_verifier.comb).lower()}
 table_cache_mb = {self.batch_verifier.table_cache_mb}
+host_pool_workers = {self.batch_verifier.host_pool_workers}
 
 [verify_scheduler]
 enable = {str(self.verify_scheduler.enable).lower()}
@@ -391,9 +402,10 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             tpu_threshold=bv.get("tpu_threshold", 32),
             enable=bv.get("enable", True),
             rlc=bool(bv.get("rlc", False)),
-            secp_lane=bool(bv.get("secp_lane", False)),
+            secp_lane=bool(bv.get("secp_lane", True)),
             comb=bool(bv.get("comb", True)),
-            table_cache_mb=int(bv.get("table_cache_mb", 256)))
+            table_cache_mb=int(bv.get("table_cache_mb", 256)),
+            host_pool_workers=int(bv.get("host_pool_workers", 0)))
         vs = d.get("verify_scheduler", {})
         cfg.verify_scheduler = VerifySchedulerConfig(
             enable=bool(vs.get("enable", True)),

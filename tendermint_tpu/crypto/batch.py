@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import random
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import List, Sequence, Tuple
@@ -27,6 +28,7 @@ from tendermint_tpu.libs import trace
 from . import PubKey
 from . import degrade
 from . import ed25519 as ed
+from . import lanepool
 
 
 def _use_device() -> bool:
@@ -195,7 +197,7 @@ class BatchVerifier:
         # runtime's breaker lock is shared across reactor threads and
         # pure contention for batches that could never dispatch
         rt = degrade.runtime() if n >= self.tpu_threshold else None
-        device_lanes = []  # [(tname, idxs, items, future)] — one worker
+        device_lanes = []  # [(tname, idxs, items, future, t0, done_at)]
         host_lanes = []
         for tname, idxs in by_type.items():
             items = [self._items[i] for i in idxs]
@@ -203,12 +205,15 @@ class BatchVerifier:
             if (verifier is not None and _use_device()
                     and len(items) >= self.tpu_threshold):
                 if rt.try_acquire():
+                    t0 = time.monotonic()
                     fut = rt.submit(
                         f"batch.{tname}", verifier,
                         [it.pub.bytes() for it in items],
                         [it.msg for it in items],
                         [it.sig for it in items])
-                    device_lanes.append((tname, idxs, items, fut))
+                    done_at = _lane_done_stamp(fut)
+                    device_lanes.append((tname, idxs, items, fut, t0,
+                                         done_at))
                     continue
                 # breaker open: this lane WOULD have gone to the device
                 rt.metrics.host_fallbacks.inc(site=f"batch.{tname}",
@@ -220,11 +225,13 @@ class BatchVerifier:
                    device_lanes=len(device_lanes),
                    host_lanes=len(host_lanes),
                    device_eligible=rt is not None)
+        lane_times: List[Tuple[str, str, float, float]] = []
         try:
-            for tname, idxs, items in host_lanes:
-                with trace.span("batch.host_lane", scheme=tname,
-                                n=len(items)):
-                    out[np.asarray(idxs)] = _host_verify_items(tname, items)
+            # host lanes run CONCURRENTLY on the lane pool (and the
+            # device lanes are already in flight on their workers), so
+            # a mixed batch costs max over lanes, not their sum
+            _run_host_lanes(host_lanes, out, "batch.host_lane",
+                            sp.span_id, lane_times=lane_times)
         finally:
             # always settle EVERY device lane: a host-lane exception must
             # not abandon an in-flight device RPC or leave the breaker's
@@ -232,11 +239,15 @@ class BatchVerifier:
             # times out, raises, or fails the host spot check is counted
             # against the breaker and the lane re-verifies through the
             # host path, preserving the exact per-triple bitmap.
-            for tname, idxs, items, fut in device_lanes:
+            for tname, idxs, items, fut, t0, done_at in device_lanes:
                 out[np.asarray(idxs)] = rt.collect(
                     f"batch.{tname}", fut,
                     host_fn=partial(_host_verify_items, tname, items),
                     spot_check=_spot_check_items(items))
+                lane_times.append((tname, "device", t0,
+                                   done_at[0] if done_at
+                                   else time.monotonic()))
+        _publish_lane_report(lane_times, sp, rt is not None)
         # remember the valid ones so later serial re-checks are cache hits
         with trace.span("batch.verdict") as vsp:
             for i, it in enumerate(self._items):
@@ -247,13 +258,111 @@ class BatchVerifier:
         return bool(out.all()), out
 
 
+def _run_host_lanes(host_lanes, out: np.ndarray, span_name: str, parent,
+                    assume_miss: bool = False, lane_times=None):
+    """Run the per-scheme host lanes CONCURRENTLY through the host-lane
+    pool (crypto/lanepool.py, ADR-015) — the host side of a mixed batch
+    costs max over lanes instead of their sum.  When the pool is
+    disabled or saturated, unadmitted lanes run serially in the caller
+    (the pre-ADR-015 loop).  `parent` is the caller's span id, linking
+    each lane span under the batch span across the pool's thread
+    boundary; `lane_times` (when given) collects (scheme, kind, t0, t1)
+    wall brackets for the overlap gauge and bench decomposition."""
+    if not host_lanes:
+        return
+
+    def lane(tname, items):
+        t0 = time.monotonic()
+        with trace.span(span_name, parent=parent, scheme=tname,
+                        n=len(items)):
+            bits = _host_verify_items(tname, items,
+                                      assume_miss=assume_miss)
+        if lane_times is not None:
+            lane_times.append((tname, "host", t0, time.monotonic()))
+        return bits
+
+    # lane-level pooling needs at least MIN_CHUNK items across the
+    # lanes: a tiny mixed vote window (a few signatures) must not
+    # construct the pool or pay future handoffs on the consensus hot
+    # path — the serial walk is already microseconds there
+    if len(host_lanes) > 1 and \
+            sum(len(items) for _, _, items in host_lanes) \
+            >= lanepool.MIN_CHUNK:
+        results = lanepool.run_lanes(
+            [partial(lane, tname, items)
+             for tname, _idxs, items in host_lanes])
+    else:
+        results = [lane(tname, items)
+                   for tname, _idxs, items in host_lanes]
+    for (tname, idxs, items), bits in zip(host_lanes, results):
+        out[np.asarray(idxs)] = bits
+
+
+def _lane_done_stamp(fut) -> list:
+    """Timestamp box filled when a device-lane future completes.  The
+    lane's wall bracket must end when the DEVICE finished, not when the
+    caller got around to collect() (which runs after every host lane —
+    using collect-return would inflate the device wall by the host-lane
+    wait and make the overlap gauge read concurrency that never
+    happened).  A launch that never completes (timeout/quarantine)
+    leaves the box empty and the bracket falls back to collect-return,
+    which then genuinely includes the host re-verify that settled the
+    lane."""
+    done_at: list = []
+
+    def _stamp(_f):
+        done_at.append(time.monotonic())
+    fut.add_done_callback(_stamp)
+    return done_at
+
+
+_last_lanes: dict = {}
+
+
+def last_lane_report() -> dict:
+    """Wall-time decomposition of the most recent multi-lane verify:
+    {"lanes": [{"scheme", "kind", "wall_s"}, ...], "wall_s", "sum_s",
+    "overlap_ratio"} — overlap_ratio = 1 - wall/sum is 0 for serial
+    lanes and (k-1)/k for k perfectly overlapped ones.  Read by
+    BENCH_MIXED=1 bench.py and scripts/bench_report config 5."""
+    return _last_lanes
+
+
+def _publish_lane_report(lane_times, sp, publish_metrics: bool):
+    """Fold per-lane wall brackets into the lane report + the
+    crypto_lane_overlap_ratio gauge.  Skips the gauge for tiny batches
+    (publish_metrics False): they never touch degrade.runtime() and
+    publishing would construct it just for a metric."""
+    global _last_lanes
+    if not lane_times:
+        return
+    wall = max(t1 for _, _, _, t1 in lane_times) - \
+        min(t0 for _, _, t0, _ in lane_times)
+    total = sum(t1 - t0 for _, _, t0, t1 in lane_times)
+    overlap = 0.0
+    if len(lane_times) > 1 and total > 0 and wall > 0:
+        overlap = max(0.0, 1.0 - wall / total)
+    _last_lanes = {
+        "lanes": [{"scheme": s, "kind": k, "wall_s": round(t1 - t0, 6)}
+                  for s, k, t0, t1 in lane_times],
+        "wall_s": round(wall, 6),
+        "sum_s": round(total, 6),
+        "overlap_ratio": round(overlap, 4),
+    }
+    if len(lane_times) > 1:
+        if trace.is_enabled():
+            sp.add(lane_overlap=round(overlap, 4))
+        if publish_metrics:
+            degrade.publish_lane_overlap(overlap)
+
+
 def _device_verifier(tname: str):
     """The TPU lane for a key scheme, or None if that scheme stays on the
     host.  ed25519: the fused ladder / RLC MSM stack (ops/ed25519.py);
     sr25519: same curve, ristretto lane (ops/sr25519.py); secp256k1:
-    the Jacobian Straus lane (ops/secp.py), opt-in via
-    TM_TPU_SECP_LANE=1 / [batch_verifier] secp_lane — the host C lane
-    stays the default."""
+    the Jacobian Straus lane (ops/secp.py), default-on since ADR-015 —
+    TM_TPU_SECP_LANE=0 / [batch_verifier] secp_lane=false is the
+    rollback switch back to the host C lane."""
     if tname == ed.KEY_TYPE:
         return verify_ed25519_batch
     if tname == "sr25519":
@@ -274,14 +383,13 @@ def _host_verify_items(tname: str, items, assume_miss: bool = False) \
         -> np.ndarray:
     """Host lane: SigCache hits first; cache misses batch through the
     native C verifiers for secp256k1/sr25519 (native/ecverify.c — the
-    pure-Python bignum path costs ~5 ms/sig, the C lanes ~0.1-0.2 ms);
+    pure-Python bignum path costs ~5 ms/sig, the C lanes ~0.1-0.2 ms),
+    sharded across the host pool's cores by lanepool.verify_sharded;
     per-item Python remains the no-toolchain fallback and handles
     malformed-length inputs.  `assume_miss` skips the cache pre-pass
     when the caller already filtered hits (the scheduler's stager hashed
     every triple once and resolved hits without lanes — re-hashing here
     could only re-prove misses)."""
-    from tendermint_tpu.libs import native
-
     n = len(items)
     bits = np.zeros(n, dtype=bool)
     if assume_miss:
@@ -295,14 +403,14 @@ def _host_verify_items(tname: str, items, assume_miss: bool = False) \
                 miss.append(i)
     if not miss:
         return bits
-    sub = None
-    if len(miss) >= 2:
-        fn = {"secp256k1": native.secp_verify,
-              "sr25519": native.sr25519_verify}.get(tname)
-        if fn is not None:
-            sub = fn([items[i].pub.bytes() for i in miss],
-                     [items[i].msg for i in miss],
-                     [items[i].sig for i in miss])
+    # EVERY miss count takes the C lane, including a single cache miss
+    # (which previously fell to the ~5 ms/sig pure-Python path); big
+    # miss lists are sharded across the host pool's cores
+    sub = lanepool.verify_sharded(
+        tname,
+        [items[i].pub.bytes() for i in miss],
+        [items[i].msg for i in miss],
+        [items[i].sig for i in miss])
     if sub is None:
         sub = [items[i].pub.verify_signature(items[i].msg, items[i].sig)
                for i in miss]

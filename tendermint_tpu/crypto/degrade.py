@@ -461,6 +461,16 @@ def runtime() -> DeviceLaneRuntime:
         return _runtime
 
 
+def runtime_if_installed() -> Optional[DeviceLaneRuntime]:
+    """The runtime IF one already exists — never constructs.  The
+    best-effort metric bridges below use this so publishing from a
+    sub-threshold path (which BatchVerifier deliberately keeps
+    runtime-free: the breaker lock is shared across reactor threads)
+    can never build the runtime just for a gauge."""
+    with _runtime_lock:
+        return _runtime
+
+
 def configure(cfg: Optional[DegradeConfig] = None,
               clock: Callable[[], float] = time.monotonic,
               registry=None) -> DeviceLaneRuntime:
@@ -501,6 +511,41 @@ def publish_route(path, outcome, n=None, nb=None, compile_s=None):
             m.batch_occupancy.set(n / nb)
         if compile_s is not None:
             m.device_compile_seconds.observe(compile_s, site=str(path))
+    except Exception:  # noqa: BLE001 - metrics are best-effort here
+        pass
+
+
+def publish_host_pool(depth=None, tasks=None):
+    """Bridge from the host-lane pool (crypto/lanepool.py, ADR-015)
+    into CryptoMetrics: admitted-task depth gauge and per-kind task
+    counters — ``tasks`` is an iterable of (kind, outcome, count).
+    Swallows everything, same contract as publish_route: the pool must
+    keep verifying even when metrics are broken or mid-reconfigure.
+    No-op until a runtime exists (runtime_if_installed): the pool also
+    serves sub-threshold batches that must never construct one."""
+    try:
+        rt = runtime_if_installed()
+        if rt is None:
+            return
+        m = rt.metrics
+        if depth is not None:
+            m.host_pool_depth.set(float(depth))
+        for kind, outcome, count in tasks or ():
+            if count:
+                m.host_pool_tasks.inc(count, kind=kind, outcome=outcome)
+    except Exception:  # noqa: BLE001 - metrics are best-effort here
+        pass
+
+
+def publish_lane_overlap(ratio):
+    """Bridge for the per-batch lane-overlap ratio (crypto/batch.py and
+    crypto/scheduler.py publish it after a multi-lane window settles:
+    1 - wall/sum(lane walls); 0 = fully serial lanes).  Swallowing and
+    non-constructing, see publish_host_pool."""
+    try:
+        rt = runtime_if_installed()
+        if rt is not None:
+            rt.metrics.lane_overlap.set(float(ratio))
     except Exception:  # noqa: BLE001 - metrics are best-effort here
         pass
 

@@ -567,12 +567,15 @@ class VerifyScheduler(BaseService):
                 if (verifier is not None and _batch._use_device()
                         and len(items) >= self.tpu_threshold):
                     if rt.try_acquire():
+                        t0 = time.monotonic()
                         fut = rt.submit(
                             f"sched.{tname}", verifier,
                             [it.pub.bytes() for it in items],
                             [it.msg for it in items],
                             [it.sig for it in items])
-                        device_lanes.append((tname, idxs, items, fut))
+                        done_at = _batch._lane_done_stamp(fut)
+                        device_lanes.append((tname, idxs, items, fut,
+                                             t0, done_at))
                         continue
                     rt.metrics.host_fallbacks.inc(
                         site=f"sched.{tname}", reason="breaker_open")
@@ -580,25 +583,31 @@ class VerifyScheduler(BaseService):
             if tracing:
                 sp.add(device_lanes=len(device_lanes),
                        host_lanes=len(host_lanes))
+            lane_times: List[Tuple[str, str, float, float]] = []
             try:
                 # assume_miss: the stager already hashed every lane and
                 # resolved all SigCache hits without lanes, so the host
-                # path's cache pre-pass could only re-prove misses
-                for tname, idxs, items in host_lanes:
-                    with trace.span("sched.host_lane", scheme=tname,
-                                    n=len(items)):
-                        out[np.asarray(idxs)] = _batch._host_verify_items(
-                            tname, items, assume_miss=True)
+                # path's cache pre-pass could only re-prove misses.
+                # Host lanes run CONCURRENTLY on the host-lane pool
+                # (ADR-015), overlapped with the in-flight device lanes
+                # — the window costs max over lanes, not their sum
+                _batch._run_host_lanes(host_lanes, out, "sched.host_lane",
+                                       sp.span_id, assume_miss=True,
+                                       lane_times=lane_times)
             finally:
                 # settle EVERY device lane (same contract as
                 # BatchVerifier): collect() never raises — any failure
                 # re-verifies through host_fn with the exact bitmap
-                for tname, idxs, items, fut in device_lanes:
+                for tname, idxs, items, fut, t0, done_at in device_lanes:
                     out[np.asarray(idxs)] = rt.collect(
                         f"sched.{tname}", fut,
                         host_fn=partial(_batch._host_verify_items,
                                         tname, items, assume_miss=True),
                         spot_check=_batch._spot_check_items(items))
+                    lane_times.append((tname, "device", t0,
+                                       done_at[0] if done_at
+                                       else time.monotonic()))
+            _batch._publish_lane_report(lane_times, sp, rt is not None)
             if tracing and len(device_lanes) == 1:
                 # which kernel family the window's device lane actually
                 # took (comb when it resolved to a cached validator set,

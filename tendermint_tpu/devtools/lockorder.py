@@ -45,6 +45,7 @@ LOCK_ORDER = {
     # -- process-global installers (held while constructing the world) --
     "tendermint_tpu/crypto/degrade.py:_runtime_lock": 5,
     "tendermint_tpu/crypto/scheduler.py:_global_lock": 10,
+    "tendermint_tpu/crypto/lanepool.py:_install_lock": 12,
 
     # -- VerifyScheduler pipeline --
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._cond": 20,
@@ -52,6 +53,7 @@ LOCK_ORDER = {
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._stats_lock": 28,
 
     # -- batch verifier / caches --
+    "tendermint_tpu/crypto/lanepool.py:HostLanePool._lock": 30,
     "tendermint_tpu/crypto/batch.py:SigCache._lock": 32,
 
     # -- degradation runtime --

@@ -57,16 +57,27 @@ REGISTERED_SITES = frozenset({
     "ops.ed25519.verify_batch",   # the ladder/RLC/comb dispatch seam
     "ops.ed25519.comb",           # the fixed-base comb route (ADR-013)
     "ops.sr25519.verify_batch",   # the ristretto lane seam
+    "ops.secp.verify_batch",      # the secp256k1 Straus lane seam
+    #                               (default-on since ADR-015)
     # degradation-runtime lane sites (crypto/degrade.py submit/run):
     # one per (consumer, scheme) lane family — enumerated so the chaos
     # coverage gate can demand at least one exercised site per family
     "batch.ed25519", "batch.sr25519", "batch.secp256k1",
     "sched.ed25519", "sched.sr25519", "sched.secp256k1",
     "bulk.ed25519",
+    # host-lane pool (crypto/lanepool.py, ADR-015): the sharded native
+    # C verify — raise/latency/corrupt-bitmap all degrade to the
+    # serial in-caller path with exact bitmaps
+    "lanepool.verify",
 })
 
-# families for sites assembled at runtime (f"batch.{scheme}" in
-# crypto/batch.py, f"sched.{scheme}" in crypto/scheduler.py)
+# families for sites assembled at runtime ONLY (f"batch.{scheme}" in
+# crypto/batch.py, f"sched.{scheme}" in crypto/scheduler.py).
+# lanepool.verify is deliberately NOT a prefix family: its one site is
+# a static literal, and registering a prefix would let a typo'd
+# "lanepool.verfy" arm silently — the exact failure the registry
+# exists to prevent.  test_lint's coverage gate requires such literal
+# non-ops sites to be armed individually instead.
 DYNAMIC_SITE_PREFIXES = frozenset({"batch.", "sched.", "bulk."})
 
 _extra_sites: set = set()

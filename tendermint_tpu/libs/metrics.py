@@ -353,6 +353,28 @@ class CryptoMetrics:
             "Fraction of VerifyScheduler host-staging time that "
             "overlapped an in-flight device launch (the double-"
             "buffered pipeline's effectiveness; 0 when idle).")
+        # concurrent lane executor (crypto/lanepool.py, ADR-015): are a
+        # mixed batch's per-scheme lanes really running side by side
+        # (wall = max over lanes) or has the pool degraded to the old
+        # serial walk (wall = sum over lanes)
+        self.lane_overlap = reg.gauge(
+            "crypto", "lane_overlap_ratio",
+            "Lane concurrency of the most recent multi-lane verify "
+            "batch: 1 - wall/sum(per-lane wall times).  0 means the "
+            "lanes ran serially; (k-1)/k means k lanes fully "
+            "overlapped.")
+        self.host_pool_depth = reg.gauge(
+            "crypto", "host_pool_depth",
+            "Tasks currently admitted to the host-lane verify pool "
+            "(queued or running on a pool worker).")
+        self.host_pool_tasks = reg.counter(
+            "crypto", "host_pool_tasks_total",
+            "Host-lane pool work items, by kind (whole 'lane' thunks "
+            "vs C-call 'chunk' shards) and placement outcome ('pooled' "
+            "on a worker, 'inline' in the caller when the pool was "
+            "full or disabled, 'fallback' when a pool fault forced the "
+            "serial re-verify).",
+            labels=("kind", "outcome"))
 
 
 class P2PMetrics:

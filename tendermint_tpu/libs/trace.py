@@ -65,6 +65,8 @@ KNOWN_SPANS = frozenset({
     # crypto/degrade.py — breaker + device lane lifecycle
     "breaker.transition", "device.collect", "device.host_fallback",
     "device.launch",
+    # crypto/lanepool.py — sharded native C host verify (ADR-015)
+    "lanepool.verify",
     # consensus/state.py
     "consensus.finalize_commit", "consensus.preverify",
     "consensus.step", "consensus.vote",

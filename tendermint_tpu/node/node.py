@@ -440,6 +440,11 @@ class Node(BaseService):
         # config wins over any stale env in BOTH directions
         from tendermint_tpu.ops import secp as secp_ops
         secp_ops.set_lane_enabled(self.config.batch_verifier.secp_lane)
+        # host-lane verify pool size (crypto/lanepool.py, ADR-015):
+        # config wins over env, both ways (0 = auto from cpu_count,
+        # 1 = serial)
+        from tendermint_tpu.crypto import lanepool
+        lanepool.set_workers(self.config.batch_verifier.host_pool_workers)
         # fixed-base comb path + its HBM budget (ops/ed25519, ADR-013):
         # config wins over env, either way
         from tendermint_tpu.ops import ed25519 as edops

@@ -137,6 +137,120 @@ def test_sr25519_lane_chaos_raise_bitmap_exact():
         site="batch.sr25519", reason="raise") == 1
 
 
+def _secp_batch(n=6, bad=(2,)):
+    from tendermint_tpu.crypto import secp256k1 as secp
+
+    privs = [secp.PrivKey.gen_from_secret((0xC500 + i).to_bytes(32, "big"))
+             for i in range(n)]
+    msgs = [b"secp chaos %d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    for i in bad:
+        sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]
+    return [p.pub_key() for p in privs], msgs, sigs
+
+
+def test_secp_device_lane_chaos_raise_bitmap_exact():
+    """The secp256k1 lane is default-on (ADR-015) and its chaos seam
+    (ops.secp.verify_batch, registered in libs/fail.REGISTERED_SITES,
+    asserted exercised by tests/test_lint.py) degrades to the host C
+    lane with the exact per-sig bitmap.  Like the sr25519 twin above,
+    the injection fires at function entry BEFORE any staging or kernel
+    dispatch — no XLA compile budget spent on the secp ladder."""
+    rt = _runtime()
+    pubs, msgs, sigs = _secp_batch()
+    fail.set_mode("ops.secp.verify_batch", "raise")
+    bv = cb.BatchVerifier(tpu_threshold=4)
+    for p, m, s in zip(pubs, msgs, sigs):
+        bv.add(p, m, s)
+    ok, bits = bv.verify()
+    assert not ok
+    assert bits.tolist() == [True, True, False, True, True, True]
+    assert fail.fired("ops.secp.verify_batch", "raise") >= 1
+    assert rt.metrics.device_failures.value(
+        site="batch.secp256k1", reason="raise") == 1
+    assert rt.metrics.host_fallbacks.value(
+        site="batch.secp256k1", reason="raise") == 1
+
+
+def test_secp_lane_latency_timeout_and_corrupt_bitmap(monkeypatch):
+    """The remaining secp failure classes — a stalled launch past its
+    deadline and a garbage bitmap caught by the host spot check — with
+    the kernel stubbed by the host oracle: the degrade plumbing under
+    test sits strictly ABOVE the kernel, and running the real 64-step
+    complete-add ladder would cost a multi-minute XLA-on-CPU compile
+    (its own bitmap is pinned in test_secp_lane's slow tier)."""
+    from tendermint_tpu.crypto import secp256k1 as secp
+    from tendermint_tpu.ops import secp as secp_ops
+
+    def stub(pubs_, msgs_, sigs_):
+        # batch.py hands the device verifier raw key bytes
+        fail.inject("ops.secp.verify_batch")
+        return np.array([secp.PubKey(bytes(p)).verify_signature(m, s)
+                         for p, m, s in zip(pubs_, msgs_, sigs_)])
+
+    monkeypatch.setattr(secp_ops, "verify_batch_device", stub)
+    pubs, msgs, sigs = _secp_batch()
+
+    def run(rt):
+        bv = cb.BatchVerifier(tpu_threshold=4)
+        for p, m, s in zip(pubs, msgs, sigs):
+            bv.add(p, m, s)
+        return bv.verify()
+
+    # timeout class: stalled past the launch deadline -> quarantine +
+    # host re-verify, bitmap exact
+    rt = _runtime(launch_timeout_s=0.05)
+    fail.set_mode("ops.secp.verify_batch", "latency:400")
+    ok, bits = run(rt)
+    assert bits.tolist() == [True, True, False, True, True, True]
+    assert rt.metrics.device_failures.value(
+        site="batch.secp256k1", reason="timeout") == 1
+    fail.clear()
+
+    # integrity class: corrupt bitmap at the degrade seam -> spot check
+    # catches it -> host re-verify, bitmap exact
+    monkeypatch.setattr(cb, "verified_sigs", cb.SigCache())
+    rt = _runtime()
+    fail.set_mode("batch.secp256k1", "corrupt-bitmap")
+    ok, bits = run(rt)
+    assert bits.tolist() == [True, True, False, True, True, True]
+    assert fail.fired("batch.secp256k1", "corrupt-bitmap") >= 1
+    assert rt.metrics.device_failures.value(
+        site="batch.secp256k1", reason="integrity") == 1
+    assert rt.metrics.host_fallbacks.value(
+        site="batch.secp256k1", reason="integrity") == 1
+
+
+def test_lanepool_chaos_all_modes_bitmap_exact():
+    """The host-lane pool's chaos seam (lanepool.verify, ADR-015):
+    raise, latency and corrupt-bitmap each degrade to the serial
+    in-caller C path with the exact per-index bitmap.  No device, no
+    kernels — this is pure host-pool plumbing."""
+    from tendermint_tpu.crypto import lanepool
+    from tendermint_tpu.libs import native
+
+    if native.get_lib() is None:
+        pytest.skip("no C toolchain: native lane unavailable")
+    pubs, msgs, sigs = _secp_batch(n=32, bad=(3, 19))
+    pb = [p.bytes() for p in pubs]
+    want = [p.verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)]
+    # pin the pool size: corrupt-bitmap only fires on the POOLED path
+    # (the chunked merge), and a 1-core runner would otherwise resolve
+    # pool() to None and never exercise it
+    lanepool.set_workers(2)
+    try:
+        for mode in ("raise", "latency:20", "corrupt-bitmap"):
+            fail.reset()
+            fail.set_mode("lanepool.verify", mode)
+            got = lanepool.verify_sharded("secp256k1", pb, msgs, sigs)
+            assert got is not None and got.tolist() == want, mode
+            assert fail.fired("lanepool.verify", mode) >= 1, \
+                "injection never triggered"
+    finally:
+        lanepool.set_workers(None)
+
+
 def test_latency_past_deadline_times_out_bitmap_exact(monkeypatch):
     """The timeout class: a launch stalled past its wall-clock budget is
     abandoned and the batch re-verifies host-side — same bitmap, no

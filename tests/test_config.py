@@ -21,6 +21,8 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     cfg.p2p.dial_timeout_s = 1.5
     cfg.p2p.handshake_timeout_s = 7.0
     cfg.rpc.max_body_bytes = 65536
+    cfg.batch_verifier.secp_lane = False   # non-default (rollback)
+    cfg.batch_verifier.host_pool_workers = 6
     cfg.save()
     back = Config.load(str(tmp_path))
     assert back.consensus.timeout_commit == 2.5
@@ -33,6 +35,10 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     assert back.p2p.dial_timeout_s == 1.5
     assert back.p2p.handshake_timeout_s == 7.0
     assert back.rpc.max_body_bytes == 65536
+    assert back.batch_verifier.secp_lane is False
+    assert back.batch_verifier.host_pool_workers == 6
+    # and the shipped defaults survive a round trip too
+    assert Config(home=str(tmp_path)).batch_verifier.secp_lane is True
     back.validate_basic()
 
 
@@ -46,6 +52,8 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     (lambda c: setattr(c.p2p, "send_rate", 0), "p2p"),
     (lambda c: setattr(c.p2p, "max_num_peers", -1), "p2p"),
     (lambda c: setattr(c.rpc, "max_body_bytes", 0), "rpc"),
+    (lambda c: setattr(c.batch_verifier, "host_pool_workers", -2),
+     "batch_verifier"),
 ])
 def test_validate_basic_rejects_nonsense(mutate, wants):
     cfg = Config(home="/tmp/x")

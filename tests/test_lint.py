@@ -436,9 +436,14 @@ def test_every_registered_chaos_site_is_exercised():
     for prefix in fail.DYNAMIC_SITE_PREFIXES:
         assert any(s.startswith(prefix) for s in armed), (
             f"no chaos test arms any '{prefix}*' lane site")
-    # and each registered dynamic-family site matches its family
+    # registered non-ops sites either belong to a dynamic family or are
+    # standalone literals (lanepool.verify) that must each be armed —
+    # a literal site is its own family of one
     for s in fail.REGISTERED_SITES - static:
-        assert any(s.startswith(p) for p in fail.DYNAMIC_SITE_PREFIXES)
+        if not any(s.startswith(p) for p in fail.DYNAMIC_SITE_PREFIXES):
+            assert s in armed, (
+                f"literal chaos site {s!r} never armed by "
+                f"{CHAOS_TEST_FILES}")
 
 
 def test_set_mode_refuses_unregistered_site():

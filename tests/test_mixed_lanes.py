@@ -1,0 +1,255 @@
+"""Concurrent multi-scheme lane executor (ADR-015) acceptance tests.
+
+The tentpole claim, proven via flight-recorder span timestamps: a mixed
+ed25519+secp256k1+sr25519 batch runs its host lanes on >= 2 host-pool
+workers CONCURRENTLY with the in-flight device lane — the old serial
+host-lane walk's `sum` wall-clock is replaced by `max` — while every
+bitmap stays byte-identical to the per-item host oracle and to the
+serial (pool-disabled) path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import batch as cb
+from tendermint_tpu.crypto import degrade
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto import lanepool
+from tendermint_tpu.crypto import secp256k1 as secp
+from tendermint_tpu.crypto import sr25519 as sr
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    # fresh SigCache so host lanes really verify (a warm cache would
+    # short-circuit the C lanes this file is about)
+    monkeypatch.setattr(cb, "verified_sigs", cb.SigCache())
+    fail.reset()
+    # pin the pool size: the span assertions need >= 2 pool workers and
+    # must not depend on the runner's core count (auto-sizing on a
+    # 1-core CI box would disable the pool entirely)
+    lanepool.set_workers(4)
+    yield
+    fail.reset()
+    lanepool.set_workers(None)
+    degrade.reset()
+
+
+def _mixed_items(n_ed=16, n_secp=6, n_sr=6, tag=b"mx", bad=()):
+    items = []
+    for i in range(n_ed):
+        k = ed.PrivKey((0x5100 + i).to_bytes(32, "big"))
+        m = b"%s ed %d" % (tag, i)
+        items.append((k.pub_key(), m, k.sign(m)))
+    for i in range(n_secp):
+        k = secp.PrivKey.gen_from_secret(b"%s-secp-%d" % (tag, i))
+        m = b"%s secp %d" % (tag, i)
+        items.append((k.pub_key(), m, k.sign(m)))
+    for i in range(n_sr):
+        k = sr.PrivKey((0x5200 + i).to_bytes(32, "little"))
+        m = b"%s sr %d" % (tag, i)
+        items.append((k.pub_key(), m, k.sign(m)))
+    out = []
+    for i, (p, m, s) in enumerate(items):
+        if i in bad:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        out.append((p, m, s))
+    return out
+
+
+def _verify(items, threshold):
+    bv = cb.BatchVerifier(tpu_threshold=threshold)
+    for p, m, s in items:
+        bv.add(p, m, s)
+    return bv.verify()
+
+
+def _oracle(items):
+    out = np.zeros(len(items), dtype=bool)
+    for i, (p, m, s) in enumerate(items):
+        try:
+            out[i] = p.verify_signature(m, s)
+        except Exception:  # noqa: BLE001 - malformed = invalid
+            out[i] = False
+    return out
+
+
+def _spans(records, name):
+    return [r for r in records if r["name"] == name and r["ph"] == "X"]
+
+
+def _overlaps(a, b):
+    a0, a1 = a["ts_ns"], a["ts_ns"] + a["dur_ns"]
+    b0, b1 = b["ts_ns"], b["ts_ns"] + b["dur_ns"]
+    return a0 < b1 and b0 < a1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 acceptance test (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def test_mixed_batch_host_lanes_overlap_device_lane(monkeypatch):
+    """Flight-recorder proof that serial-loop `sum` became `max`: the
+    secp256k1 and sr25519 host lanes run on two DISTINCT host-pool
+    worker threads, their spans overlap each other in time, and both
+    overlap the ed25519 device launch — with the bitmap byte-identical
+    to the per-item host oracle.  Injected latency (50 ms at the host
+    C seam, 50 ms at the device kernel seam) makes every lane's span
+    long enough that real concurrency is the only way the overlap
+    assertions can hold; the generous margins keep slow-CI noise out."""
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.delenv("TM_TPU_DISABLE_BATCH", raising=False)
+    # the ed device lane here is the XLA kernel forced onto CPU in the
+    # SHARED nb=64 bucket (no new compile shapes); first use in the
+    # process may still pay the one-off bucket compile, so the launch
+    # budget stays generous
+    degrade.configure(degrade.DegradeConfig(launch_timeout_s=600.0),
+                      registry=Registry("mixedlanes"))
+    items = _mixed_items(bad=(3, 17, 24))  # one offender per scheme
+    # host oracle FIRST (cache untouched: oracle bypasses BatchVerifier)
+    base = _oracle(items)
+    assert base.sum() == len(items) - 3
+
+    # warm the shared nb=64 ed bucket BEFORE tracing: a cold first
+    # compile would stretch the device span to tens of seconds and the
+    # wall-vs-sum assertion would compare lanes against compile time
+    warm = _mixed_items(n_ed=16, n_secp=0, n_sr=0, tag=b"warm")
+    ok, _ = _verify(warm, threshold=8)
+    assert ok
+
+    # stretch every lane so overlap is unambiguous in the trace
+    fail.set_mode("lanepool.verify", "latency:50")
+    fail.set_mode("ops.ed25519.verify_batch", "latency:50")
+    was_enabled = trace.is_enabled()
+    trace.enable()
+    seq0 = trace.last_seq()
+    try:
+        ok, bits = _verify(items, threshold=8)  # ed(16) device;
+        #                                         secp(6)/sr(6) host
+    finally:
+        if not was_enabled:
+            trace.disable()
+        fail.clear()
+    assert (bits == base).all()
+    assert not ok
+
+    records = trace.snapshot(since=seq0)
+    host = _spans(records, "batch.host_lane")
+    assert len(host) == 2, host
+    # >= 2 distinct pool workers — not the caller thread
+    assert all(str(r["tname"]).startswith("host-lane-pool") for r in host)
+    assert len({r["tid"] for r in host}) == 2
+    # ... running concurrently with each other
+    assert _overlaps(host[0], host[1])
+    # ... and with the device lane's launch span
+    launches = _spans(records, "device.launch")
+    assert launches, records
+    launch = launches[-1]
+    assert all(_overlaps(launch, h) for h in host)
+    # wall-clock: max over lanes, not their sum.  Each host lane slept
+    # >= 50 ms and the device kernel seam another 50 ms, so the serial
+    # walk would cost >= 150 ms; concurrent lanes stay well under.
+    walls = [h["dur_ns"] for h in host] + [launch["dur_ns"]]
+    wall_union = max(r["ts_ns"] + r["dur_ns"] for r in host + [launch]) \
+        - min(r["ts_ns"] for r in host + [launch])
+    assert wall_union < 0.75 * sum(walls), (wall_union, walls)
+    # the lane report agrees (this is what BENCH_MIXED=1 publishes)
+    rep = cb.last_lane_report()
+    assert len(rep["lanes"]) == 3
+    assert {(ln["scheme"], ln["kind"]) for ln in rep["lanes"]} == {
+        ("ed25519", "device"), ("secp256k1", "host"), ("sr25519", "host")}
+    assert rep["overlap_ratio"] > 0.25, rep
+
+
+def test_mixed_sweep_concurrent_vs_serial_vs_oracle(monkeypatch):
+    """Bitmap-identity sweep: pooled concurrent lanes vs the serial
+    (pool-disabled) path vs the per-item host oracle, with a tampered
+    signature in each scheme and a malformed-length signature thrown
+    in.  Pure host path — no device routing at all."""
+    monkeypatch.delenv("TM_TPU_FORCE_BATCH", raising=False)
+    items = _mixed_items(n_ed=10, n_secp=18, n_sr=18, tag=b"sweep",
+                         bad=(2, 12, 30))
+    # malformed length in the secp lane: must be invalid, not fatal
+    p, m, s = items[15]
+    items[15] = (p, m, s[:40])
+    base = _oracle(items)
+    assert base.sum() == len(items) - 4
+
+    ok, bits = _verify(items, threshold=1 << 30)
+    assert (bits == base).all() and not ok
+
+    monkeypatch.setattr(cb, "verified_sigs", cb.SigCache())
+    lanepool.set_workers(1)  # serial in-caller fallback
+    ok2, bits2 = _verify(items, threshold=1 << 30)
+    assert (bits2 == base).all() and not ok2
+
+
+def test_single_cache_miss_takes_native_c_lane(monkeypatch):
+    """Regression for the `len(miss) >= 2` gate (ISSUE 7 satellite): a
+    SINGLE cache miss must route through the native C verifier instead
+    of the ~5 ms/sig pure-Python path."""
+    from tendermint_tpu.libs import native
+
+    if native.get_lib() is None:
+        pytest.skip("no C toolchain: native lane unavailable")
+    k = secp.PrivKey.gen_from_secret(b"single-miss")
+    m = b"single miss msg"
+    s = k.sign(m)
+    calls = []
+    real = lanepool.verify_sharded
+
+    def spy(tname, pubs, msgs, sigs):
+        calls.append((tname, len(pubs)))
+        return real(tname, pubs, msgs, sigs)
+
+    monkeypatch.setattr(lanepool, "verify_sharded", spy)
+
+    def no_python(self, *a, **kw):
+        raise AssertionError("pure-Python per-item path used for a "
+                             "single miss")
+
+    monkeypatch.setattr(secp.PubKey, "verify_signature", no_python)
+    bv = cb.BatchVerifier()
+    bv.add(k.pub_key(), m, s)
+    ok, bits = bv.verify()
+    assert ok and bits.tolist() == [True]
+    assert calls == [("secp256k1", 1)]
+
+
+def test_scheduler_window_host_lanes_run_on_pool(monkeypatch):
+    """The same restructure inside VerifyScheduler._execute: a mixed
+    window's host lanes land on >= 2 distinct pool workers with
+    overlapping spans, and the coalesced bitmap matches the oracle."""
+    from tendermint_tpu.crypto import scheduler as vsched
+
+    monkeypatch.delenv("TM_TPU_FORCE_BATCH", raising=False)
+    items = _mixed_items(n_ed=6, n_secp=8, n_sr=8, tag=b"sched",
+                         bad=(1, 9, 18))
+    base = _oracle(items)
+    fail.set_mode("lanepool.verify", "latency:40")
+    was_enabled = trace.is_enabled()
+    trace.enable()
+    seq0 = trace.last_seq()
+    s = vsched.VerifyScheduler(window_s=0.001)
+    s.start()
+    try:
+        bits = s.submit(items, vsched.Priority.COMMIT).result(timeout=120)
+    finally:
+        s.stop()
+        if not was_enabled:
+            trace.disable()
+        fail.clear()
+    assert (bits == base).all()
+
+    records = trace.snapshot(since=seq0)
+    host = _spans(records, "sched.host_lane")
+    pooled = [r for r in host
+              if str(r["tname"]).startswith("host-lane-pool")]
+    assert len({r["tid"] for r in pooled}) >= 2, host
+    slow = [r for r in host if r["name"] == "sched.host_lane"
+            and r["dur_ns"] >= 30_000_000]
+    assert len(slow) >= 2 and _overlaps(slow[0], slow[1]), host

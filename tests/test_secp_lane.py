@@ -236,12 +236,14 @@ def test_secp_device_lane_bitmap_vs_host_oracles():
         assert [bool(b) for b in got] == [bool(b) for b in cok]
 
 
-def test_secp_lane_routing_is_optin(monkeypatch):
-    """crypto/batch routes secp256k1 to the device lane ONLY behind the
-    opt-in (env TM_TPU_SECP_LANE=1 or config secp_lane -> set_lane_enabled,
-    config winning both directions); the bitmap stays exact either way.
-    The heavy kernel is stubbed with the host oracle — compile-free, the
-    lane's own bitmap is pinned in the slow-tier test above."""
+def test_secp_lane_routing_default_on_with_rollback(monkeypatch):
+    """crypto/batch routes secp256k1 to the device lane BY DEFAULT
+    (ADR-015); TM_TPU_SECP_LANE=0 or config secp_lane=false ->
+    set_lane_enabled is the rollback switch back to the host C lane,
+    config winning over env both directions.  The bitmap stays exact
+    either way.  The heavy kernel is stubbed with the host oracle —
+    compile-free, the lane's own bitmap is pinned in the slow-tier test
+    above."""
     from tendermint_tpu.crypto import batch as cb
     from tendermint_tpu.crypto import secp256k1 as secp
     from tendermint_tpu.ops import secp as secp_ops
@@ -274,19 +276,19 @@ def test_secp_lane_routing_is_optin(monkeypatch):
         _, bits = bv.verify()
         return want, list(bits)
 
-    # default: stays on the host C/python lane
+    # default (no env, no config): routes to the device lane
     monkeypatch.delenv("TM_TPU_SECP_LANE", raising=False)
     want, bits = run_batch()
-    assert bits == want and routed == []
-    # env opt-in routes to the device lane
-    monkeypatch.setenv("TM_TPU_SECP_LANE", "1")
+    assert bits == want and routed == [6]
+    # env rollback keeps it on the host C/python lane
+    monkeypatch.setenv("TM_TPU_SECP_LANE", "0")
     want, bits = run_batch()
     assert bits == want and routed == [6]
     # config override wins over the env, both directions
-    secp_ops.set_lane_enabled(False)
-    want, bits = run_batch()
-    assert bits == want and routed == [6]
     secp_ops.set_lane_enabled(True)
+    want, bits = run_batch()
+    assert bits == want and routed == [6, 6]
+    secp_ops.set_lane_enabled(False)
     monkeypatch.delenv("TM_TPU_SECP_LANE")
     want, bits = run_batch()
     assert bits == want and routed == [6, 6]
