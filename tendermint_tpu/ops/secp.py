@@ -34,19 +34,22 @@ import jax.numpy as jnp
 from . import field_secp as FS
 
 
-# explicit opt-in (config [batch_verifier] secp_lane, or
-# TM_TPU_SECP_LANE=1), wired by node assembly via set_lane_enabled().
-# Default OFF: the host C lane (native/ecverify.c) is the measured
-# production path for secp256k1 and this device lane has never run on
-# real TPU hardware — operators flip it on deliberately once a
-# co-located chip makes the per-launch round trip worth it.  Verdicts
-# are per-signature exact either way (BIP-340), pinned against the host
-# oracle in tests/test_secp_lane.py.
+# default ON since ADR-015 (config [batch_verifier] secp_lane /
+# TM_TPU_SECP_LANE=0 is the rollback switch, wired by node assembly via
+# set_lane_enabled()).  The lane only ever engages when an accelerator
+# is actually attached (crypto/batch._use_device gates every device
+# dispatch), runs under the full degradation runtime — breaker,
+# per-launch timeout, host C fallback with exact bitmaps — at sites
+# batch.secp256k1/sched.secp256k1, and its verdicts are per-signature
+# exact (BIP-340), pinned against the host oracle in
+# tests/test_secp_lane.py.  On a host with no device nothing changes:
+# the host C lane keeps serving, now multi-core through
+# crypto/lanepool.py.
 _lane_override: "bool | None" = None
 
 
 def set_lane_enabled(on: "bool | None"):
-    """Config-driven override of the device-lane opt-in (wins over the
+    """Config-driven override of the device-lane default (wins over the
     env, both directions — mirrors msm.set_enabled).  None clears the
     override so TM_TPU_SECP_LANE governs again."""
     global _lane_override
@@ -56,7 +59,11 @@ def set_lane_enabled(on: "bool | None"):
 def use_lane() -> bool:
     if _lane_override is not None:
         return _lane_override
-    return os.environ.get("TM_TPU_SECP_LANE", "0") == "1"
+    # rollback accepts the natural spellings, not just "0" — an
+    # operator typing TM_TPU_SECP_LANE=false (mirroring the config's
+    # `secp_lane = false`) must not silently keep the lane on
+    return os.environ.get("TM_TPU_SECP_LANE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
 
 _i32 = jnp.int32
 
@@ -274,6 +281,13 @@ def verify_batch_device(pubs, msgs, sigs) -> np.ndarray:
     (x-only semantics: the parity byte must parse, reference
     secp256k1.go:203-212); sigs: 64-byte (r, s) big-endian.  Malformed
     lengths are rejected host-side without poisoning the batch."""
+    from tendermint_tpu.libs import fail
+
+    # chaos seam: same role as ops/ed25519.verify_batch's — it fires at
+    # entry, BEFORE any staging or kernel dispatch, so an armed "raise"
+    # proves the degrade plumbing without spending the multi-minute
+    # XLA-on-CPU compile of the 64-step complete-add ladder
+    fail.inject("ops.secp.verify_batch")
     n = len(pubs)
     if n == 0:
         return np.zeros(0, dtype=bool)
