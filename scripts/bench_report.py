@@ -25,6 +25,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_trend
 
 import numpy as np  # noqa: E402
 
@@ -532,10 +533,20 @@ def main():
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb)
     only = os.environ.get("BENCH_ONLY", "")
+    # round-over-round context (ISSUE 8): each config line carries
+    # delta-vs-previous-round columns against the append-only
+    # bench_history.jsonl, and is itself appended to the history THE
+    # MOMENT it completes — an interrupted run keeps its finished
+    # configs (partial-run capture, ROADMAP item 5)
+    from bench import append_history, history_record, load_history
+    from bench_trend import with_prev_round_delta
+    history = load_history()
     for fn in fns:
         if only and only not in fn.__name__:
             continue
-        print(json.dumps(fn()), flush=True)
+        line = with_prev_round_delta(fn(), history)
+        print(json.dumps(line), flush=True)
+        append_history(history_record(line, "bench_report"))
 
 
 if __name__ == "__main__":

@@ -374,6 +374,38 @@ def cmd_debug_trace(args):
         print(body)
 
 
+def cmd_debug_latency(args):
+    """Snapshot the running node's latency observatory (libs/slo.py +
+    the VerifyScheduler lifecycle report) via its pprof listener's
+    GET /debug/latency — windowed p50/p99/burn-rate per priority
+    stream and the most recent verify window's submit -> window-close
+    -> stage -> launch -> settle decomposition."""
+    import urllib.request
+
+    addr = args.pprof_laddr
+    if not addr:
+        cfg = Config.load(_home(args))
+        cfg.home = _home(args)
+        addr = cfg.rpc.pprof_laddr
+    if not addr:
+        raise SystemExit(
+            "no pprof listener: pass --pprof-laddr or set [rpc] "
+            "pprof_laddr in config.toml (and enable the SLO estimator "
+            "with [slo] enable or TM_TPU_SLO=1 for windowed quantiles)")
+    url = f"http://{addr}/debug/latency"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        n = len((doc.get("slo") or {}).get("streams") or {})
+        print(f"wrote latency report ({n} SLO streams) to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
 def cmd_debug_kill(args):
     """Reference cmd debug kill: take a dump, then kill the node."""
     import signal
@@ -664,6 +696,13 @@ def main(argv=None):
                     help="fetch only events after this seq cursor")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_trace)
+    sp = sub.add_parser("debug-latency",
+                        help="snapshot the node's latency observatory "
+                             "(SLO quantiles + lifecycle decomposition)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_latency)
     sp = sub.add_parser("debug-kill",
                         help="collect a diagnostic tarball, then SIGTERM "
                              "the node")

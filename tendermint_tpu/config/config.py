@@ -185,6 +185,39 @@ class VerifySchedulerConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Per-priority latency SLOs for the verify path (libs/slo.py,
+    docs/adr/adr-016-latency-observatory.md).  When enabled the node
+    arms the sliding-window quantile estimator: each priority stream
+    keeps its last `window` end-to-end latencies and publishes
+    windowed p50/p99 and (when a target is set) the error-budget burn
+    rate.  Targets are p99 objectives in MILLISECONDS; 0 = track the
+    quantiles but no target (no burn-rate gauge)."""
+    enable: bool = False
+    window: int = 1024
+    consensus_p99_ms: float = 0.0
+    commit_p99_ms: float = 0.0
+    blocksync_p99_ms: float = 0.0
+    mempool_p99_ms: float = 0.0
+
+    def targets_s(self) -> dict:
+        """Stream -> p99 target in seconds (only the set ones)."""
+        out = {}
+        for stream in ("consensus", "commit", "blocksync", "mempool"):
+            ms = getattr(self, f"{stream}_p99_ms")
+            if ms > 0:
+                out[stream] = ms / 1000.0
+        return out
+
+    def validate_basic(self):
+        if self.window <= 0:
+            raise ValueError("slo.window must be positive")
+        for stream in ("consensus", "commit", "blocksync", "mempool"):
+            if getattr(self, f"{stream}_p99_ms") < 0:
+                raise ValueError(f"slo.{stream}_p99_ms must be >= 0")
+
+
+@dataclass
 class Config:
     home: str = ""
     moniker: str = "node"
@@ -207,12 +240,13 @@ class Config:
         default_factory=BatchVerifierConfig)
     verify_scheduler: VerifySchedulerConfig = field(
         default_factory=VerifySchedulerConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
-                     "batch_verifier", "verify_scheduler"):
+                     "batch_verifier", "verify_scheduler", "slo"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -330,6 +364,14 @@ window_ms = {self.verify_scheduler.window_ms}
 max_batch = {self.verify_scheduler.max_batch}
 max_pending = {self.verify_scheduler.max_pending}
 
+[slo]
+enable = {str(self.slo.enable).lower()}
+window = {self.slo.window}
+consensus_p99_ms = {self.slo.consensus_p99_ms}
+commit_p99_ms = {self.slo.commit_p99_ms}
+blocksync_p99_ms = {self.slo.blocksync_p99_ms}
+mempool_p99_ms = {self.slo.mempool_p99_ms}
+
 [consensus]
 timeout_propose = {c.timeout_propose}
 timeout_propose_delta = {c.timeout_propose_delta}
@@ -412,6 +454,14 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             window_ms=float(vs.get("window_ms", 2.0)),
             max_batch=int(vs.get("max_batch", 8192)),
             max_pending=int(vs.get("max_pending", 65536)))
+        sl = d.get("slo", {})
+        cfg.slo = SLOConfig(
+            enable=bool(sl.get("enable", False)),
+            window=int(sl.get("window", 1024)),
+            consensus_p99_ms=float(sl.get("consensus_p99_ms", 0.0)),
+            commit_p99_ms=float(sl.get("commit_p99_ms", 0.0)),
+            blocksync_p99_ms=float(sl.get("blocksync_p99_ms", 0.0)),
+            mempool_p99_ms=float(sl.get("mempool_p99_ms", 0.0)))
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

@@ -20,7 +20,7 @@ import random
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -173,15 +173,25 @@ class BatchVerifier:
         n = len(self._items)
         if n == 0:
             return True, np.zeros(0, dtype=bool)
+        # lifecycle origin of the DIRECT path (ADR-016): verify() entry
+        # is this request's "submit", and the e2e bracket lands in the
+        # same verify_e2e_latency histogram the scheduler publishes,
+        # labeled path="direct" at the caller's context priority
+        t_submit = time.monotonic()
         # flight-recorder root of the coalesce window: the lane spans
         # (device.launch on the worker, device.collect, verdict
         # application) all link under this span, so an exported trace
         # shows where one batch spent its time and which route it took
         with trace.span("batch.verify", n=n,
                         threshold=self.tpu_threshold) as sp:
-            return self._verify(n, sp)
+            ok, bits = self._verify(n, sp, t_submit)
+        degrade.publish_request_latency(
+            _context_priority_name(), "direct",
+            time.monotonic() - t_submit)
+        return ok, bits
 
-    def _verify(self, n: int, sp) -> Tuple[bool, np.ndarray]:
+    def _verify(self, n: int, sp,
+                t_submit: Optional[float] = None) -> Tuple[bool, np.ndarray]:
         out = np.zeros(n, dtype=bool)
         # dispatch per key scheme; the device (ed25519) lane runs in a
         # worker thread OVERLAPPED with the host C lanes — the tunnel
@@ -231,7 +241,8 @@ class BatchVerifier:
             # device lanes are already in flight on their workers), so
             # a mixed batch costs max over lanes, not their sum
             _run_host_lanes(host_lanes, out, "batch.host_lane",
-                            sp.span_id, lane_times=lane_times)
+                            sp.span_id, lane_times=lane_times,
+                            t_submit=t_submit)
         finally:
             # always settle EVERY device lane: a host-lane exception must
             # not abandon an in-flight device RPC or leave the breaker's
@@ -259,7 +270,8 @@ class BatchVerifier:
 
 
 def _run_host_lanes(host_lanes, out: np.ndarray, span_name: str, parent,
-                    assume_miss: bool = False, lane_times=None):
+                    assume_miss: bool = False, lane_times=None,
+                    t_submit: Optional[float] = None):
     """Run the per-scheme host lanes CONCURRENTLY through the host-lane
     pool (crypto/lanepool.py, ADR-015) — the host side of a mixed batch
     costs max over lanes instead of their sum.  When the pool is
@@ -267,16 +279,22 @@ def _run_host_lanes(host_lanes, out: np.ndarray, span_name: str, parent,
     (the pre-ADR-015 loop).  `parent` is the caller's span id, linking
     each lane span under the batch span across the pool's thread
     boundary; `lane_times` (when given) collects (scheme, kind, t0, t1)
-    wall brackets for the overlap gauge and bench decomposition."""
+    wall brackets for the overlap gauge and bench decomposition;
+    `t_submit` is the request's lifecycle origin (ADR-016), threaded
+    through so every lane span — even on a pool worker thread —
+    carries the request's age when the lane started."""
     if not host_lanes:
         return
 
     def lane(tname, items):
         t0 = time.monotonic()
         with trace.span(span_name, parent=parent, scheme=tname,
-                        n=len(items)):
+                        n=len(items)) as lsp:
+            if t_submit is not None and trace.is_enabled():
+                lsp.add(since_submit_s=round(t0 - t_submit, 6))
             bits = _host_verify_items(tname, items,
-                                      assume_miss=assume_miss)
+                                      assume_miss=assume_miss,
+                                      t_submit=t_submit)
         if lane_times is not None:
             lane_times.append((tname, "host", t0, time.monotonic()))
         return bits
@@ -332,28 +350,47 @@ def _publish_lane_report(lane_times, sp, publish_metrics: bool):
     """Fold per-lane wall brackets into the lane report + the
     crypto_lane_overlap_ratio gauge.  Skips the gauge for tiny batches
     (publish_metrics False): they never touch degrade.runtime() and
-    publishing would construct it just for a metric."""
+    publishing would construct it just for a metric.  Returns THIS
+    call's report dict (None when there were no lanes): the scheduler
+    embeds it in its window's latency report, and re-reading the
+    process-global last_lane_report() there could hand back a
+    concurrent direct batch's lanes instead."""
     global _last_lanes
     if not lane_times:
-        return
+        return None
     wall = max(t1 for _, _, _, t1 in lane_times) - \
         min(t0 for _, _, t0, _ in lane_times)
     total = sum(t1 - t0 for _, _, t0, t1 in lane_times)
     overlap = 0.0
     if len(lane_times) > 1 and total > 0 and wall > 0:
         overlap = max(0.0, 1.0 - wall / total)
-    _last_lanes = {
+    report = {
         "lanes": [{"scheme": s, "kind": k, "wall_s": round(t1 - t0, 6)}
                   for s, k, t0, t1 in lane_times],
         "wall_s": round(wall, 6),
         "sum_s": round(total, 6),
         "overlap_ratio": round(overlap, 4),
     }
+    _last_lanes = report
     if len(lane_times) > 1:
         if trace.is_enabled():
             sp.add(lane_overlap=round(overlap, 4))
         if publish_metrics:
             degrade.publish_lane_overlap(overlap)
+    return report
+
+
+def _context_priority_name() -> str:
+    """Priority label for the direct path's e2e latency: the caller's
+    scheduler priority context when one is set (light client under
+    priority_context(COMMIT), blocksync replay, ...), COMMIT otherwise.
+    Lazy import — scheduler imports this module at load."""
+    try:
+        from tendermint_tpu.crypto import scheduler as vsched
+        return vsched.context_priority(
+            vsched.Priority.COMMIT)[0].name.lower()
+    except Exception:  # noqa: BLE001 - a label must never break verify
+        return "commit"
 
 
 def _device_verifier(tname: str):
@@ -379,8 +416,8 @@ def _device_verifier(tname: str):
     return None
 
 
-def _host_verify_items(tname: str, items, assume_miss: bool = False) \
-        -> np.ndarray:
+def _host_verify_items(tname: str, items, assume_miss: bool = False,
+                       t_submit: Optional[float] = None) -> np.ndarray:
     """Host lane: SigCache hits first; cache misses batch through the
     native C verifiers for secp256k1/sr25519 (native/ecverify.c — the
     pure-Python bignum path costs ~5 ms/sig, the C lanes ~0.1-0.2 ms),
@@ -410,7 +447,8 @@ def _host_verify_items(tname: str, items, assume_miss: bool = False) \
         tname,
         [items[i].pub.bytes() for i in miss],
         [items[i].msg for i in miss],
-        [items[i].sig for i in miss])
+        [items[i].sig for i in miss],
+        t_submit=t_submit)
     if sub is None:
         sub = [items[i].pub.verify_signature(items[i].msg, items[i].sig)
                for i in miss]

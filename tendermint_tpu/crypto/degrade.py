@@ -45,6 +45,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from tendermint_tpu.libs import fail
+from tendermint_tpu.libs import slo
 from tendermint_tpu.libs import trace
 
 # breaker states (rendered into the tendermint_crypto_breaker_state
@@ -387,7 +388,11 @@ class DeviceLaneRuntime:
         bookkeeping — and on ANY device failure re-verify the batch
         through host_fn so the caller's bitmap is exact regardless."""
         with trace.span("device.collect", site=site) as sp:
-            t0 = self._clock()
+            # launch-seconds bracket via the Histogram.time helper;
+            # observed manually (success only — a degraded launch's
+            # wall belongs to the failure counters, not this histogram)
+            launch_timer = self.metrics.device_launch_seconds.time(
+                clock=self._clock, site=site)
             reason = None
             try:
                 out = fut.result(timeout=self.cfg.launch_timeout_s)
@@ -414,8 +419,7 @@ class DeviceLaneRuntime:
                 reason = "integrity" if isinstance(e, DeviceLaneError) \
                     else "raise"
             if reason is None:
-                self.metrics.device_launch_seconds.observe(
-                    self._clock() - t0, site=site)
+                launch_timer.observe()
                 self.breaker.record_success()
                 sp.add(outcome="ok")
                 return np.asarray(out)
@@ -546,6 +550,27 @@ def publish_lane_overlap(ratio):
         rt = runtime_if_installed()
         if rt is not None:
             rt.metrics.lane_overlap.set(float(ratio))
+    except Exception:  # noqa: BLE001 - metrics are best-effort here
+        pass
+
+
+def publish_request_latency(priority: str, path: str, e2e_s: float):
+    """Bridge for the direct verify path's end-to-end latency
+    (crypto/batch.BatchVerifier.verify stamps entry and publishes at
+    return; the scheduler publishes its own richer lifecycle through
+    its metrics handle).  Swallowing, and it reads the runtime global
+    WITHOUT the install lock: the tiny-batch direct path is the
+    consensus vote-window hot path, deliberately runtime-free, and
+    publishing one gauge must not serialize every reactor thread on
+    the rank-5 install lock (a plain global read is atomic in
+    CPython).  The SLO estimator is fed regardless — its disabled
+    path is a guaranteed sub-microsecond no-op."""
+    try:
+        slo.observe(priority, e2e_s)
+        rt = _runtime
+        if rt is not None:
+            rt.metrics.verify_e2e_latency.observe(
+                e2e_s, priority=priority, path=path)
     except Exception:  # noqa: BLE001 - metrics are best-effort here
         pass
 

@@ -47,6 +47,7 @@ import os
 import queue as _queue
 import random
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -289,11 +290,18 @@ def native_verifier(tname: str):
     return getattr(native, entry[0])
 
 
-def verify_sharded(tname: str, pubs, msgs, sigs) -> Optional[np.ndarray]:
+def verify_sharded(tname: str, pubs, msgs, sigs,
+                   t_submit: Optional[float] = None) \
+        -> Optional[np.ndarray]:
     """One scheme's miss list through the native C lane, sharded into
     per-core chunks.  Exact per-index bool bitmap, or None when no
     native lane exists / the inputs are irregular (caller falls back to
     its per-item path, exactly as with a direct libs/native call).
+
+    `t_submit` threads the request's lifecycle origin (ADR-016) down
+    to this layer: the lanepool.verify span records how old the
+    request already was when the C lane started, so a slow request's
+    trace shows WHERE the time went even across the pool boundary.
 
     Degradation: any pool-path fault — an injected fault at site
     ``lanepool.verify``, a chunk exception, or the merged bitmap
@@ -316,6 +324,9 @@ def verify_sharded(tname: str, pubs, msgs, sigs) -> Optional[np.ndarray]:
         return None
     with trace.span("lanepool.verify", scheme=tname, n=n) as sp:
         try:
+            if t_submit is not None and trace.is_enabled():
+                sp.add(since_submit_s=round(
+                    time.monotonic() - t_submit, 6))
             fail.inject("lanepool.verify")
             bits = _pooled_chunks(fn, pubs, msgs, sigs, sp)
             if bits is None:

@@ -35,7 +35,12 @@ This module gives the verify plane the classic inference-serving shape
     admit higher classes;
   * deadline flush: a submission may carry a monotonic deadline and the
     window closes early to honor it — consensus never waits out a
-    coalescing window sized for throughput.
+    coalescing window sized for throughput;
+  * per-request lifecycle stamps (ADR-016): every submission is stamped
+    submit -> window-close -> stage -> launch -> settle, feeding the
+    queue-wait/e2e latency histograms, deadline-miss accounting, the
+    sliding-window SLO estimator (libs/slo.py), and
+    last_latency_report().
 
 Degradation inherits crypto/degrade.py wholesale: a device raise,
 timeout, corrupt bitmap, or open breaker re-verifies the SAME lanes on
@@ -56,6 +61,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tendermint_tpu.libs import slo
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.service import BaseService
 from . import PubKey
@@ -122,7 +128,10 @@ class VerifyFuture:
 
 class _Submission:
     __slots__ = ("items", "prio", "deadline", "populate_cache", "future",
-                 "bits", "remaining", "enq_t", "n")
+                 "bits", "remaining", "enq_t", "n",
+                 # lifecycle stamps (ADR-016): monotonic, 0.0 = not yet
+                 "submit_t", "wclose_t", "settle_t", "deadline_missed",
+                 "path")
 
     def __init__(self, items, prio, deadline, populate_cache):
         self.items = items          # List[_batch._Item]
@@ -134,11 +143,17 @@ class _Submission:
         self.bits = np.zeros(self.n, dtype=bool)
         self.remaining = self.n
         self.enq_t = 0.0
+        self.submit_t = 0.0         # submit() entry
+        self.wclose_t = 0.0         # the coalescing window closed
+        self.settle_t = 0.0         # future resolved
+        self.deadline_missed = False
+        self.path = "sched-cache"   # what settled it (see _execute)
 
 
 class _Launch:
     __slots__ = ("lanes", "keys", "waiters", "by_scheme", "subs",
-                 "parent_span", "cache_hits", "dedup")
+                 "parent_span", "cache_hits", "dedup",
+                 "wclose_t", "staged_t")
 
     def __init__(self, lanes, keys, waiters, by_scheme, subs, parent_span,
                  cache_hits, dedup):
@@ -150,6 +165,8 @@ class _Launch:
         self.parent_span = parent_span
         self.cache_hits = cache_hits
         self.dedup = dedup
+        self.wclose_t = 0.0
+        self.staged_t = 0.0
 
 
 def _as_item(triple) -> _batch._Item:
@@ -159,6 +176,72 @@ def _as_item(triple) -> _batch._Item:
     if not isinstance(pub, PubKey):
         pub = _ed.PubKey(bytes(pub))
     return _batch._Item(pub, bytes(msg), bytes(sig))
+
+
+def _mark_fallback(box: List[str], tag: str, fn):
+    """Wrap a degrade host_fn so the window knows its device lane fell
+    back — degrade only INVOKES host_fn on a fallback, so the append
+    is exactly the signal (the e2e path label must say sched-fallback,
+    not claim device latency for a host re-verify)."""
+    def run():
+        box.append(tag)
+        return fn()
+    return run
+
+
+# ---------------------------------------------------------------------------
+# the latency report (ADR-016): per-request lifecycle decomposition of
+# the most recently settled window, alongside batch.last_lane_report()
+# ---------------------------------------------------------------------------
+
+_MAX_REPORT_REQUESTS = 32
+
+_last_latency: dict = {}
+
+
+def last_latency_report() -> dict:
+    """Lifecycle decomposition of the most recent VerifyScheduler
+    window: submit -> window-close (queue_wait) -> stage -> launch
+    (exec_wait/execute, with the per-lane wall breakdown) -> settle,
+    plus one row per request with its e2e latency and whether its
+    deadline was met.  Read by GET /debug/latency (libs/pprof.py), the
+    `debug-latency` CLI, and the latency acceptance test."""
+    return _last_latency
+
+
+def _set_latency_report(report: dict):
+    global _last_latency
+    _last_latency = report
+
+
+def _build_report(subs, path: str, lanes_n: int, stage_s: float,
+                  exec_wait_s: float, execute_s: float, settle_s: float,
+                  lane_report: Optional[dict] = None) -> dict:
+    e2es = [s.settle_t - s.submit_t for s in subs if s.settle_t]
+    qws = [s.wclose_t - s.submit_t for s in subs if s.wclose_t]
+    reqs = [{
+        "priority": s.prio.name.lower(),
+        "n": s.n,
+        "queue_wait_s": round(s.wclose_t - s.submit_t, 6)
+        if s.wclose_t else None,
+        "e2e_s": round(s.settle_t - s.submit_t, 6) if s.settle_t else None,
+        "deadline_met": (None if s.deadline is None
+                         else not s.deadline_missed),
+    } for s in subs[:_MAX_REPORT_REQUESTS]]
+    return {
+        "path": path,
+        "submissions": len(subs),
+        "items": sum(s.n for s in subs),
+        "lanes": lanes_n,
+        "queue_wait_max_s": round(max(qws), 6) if qws else None,
+        "stage_s": round(stage_s, 6),
+        "exec_wait_s": round(exec_wait_s, 6),
+        "execute_s": round(execute_s, 6),
+        "settle_s": round(settle_s, 6),
+        "e2e_max_s": round(max(e2es), 6) if e2es else None,
+        "lane_report": lane_report,
+        "requests": reqs,
+    }
 
 
 class VerifyScheduler(BaseService):
@@ -242,6 +325,7 @@ class VerifyScheduler(BaseService):
             f._set_exception(exc)
             return f
         sub = _Submission(norm, Priority(prio), deadline, populate_cache)
+        sub.submit_t = time.monotonic()  # lifecycle origin (ADR-016)
         if sub.n == 0:
             sub.future._set(sub.bits)
             return sub.future
@@ -426,6 +510,9 @@ class VerifyScheduler(BaseService):
                 self._cond.wait(min(max(close_at - now, 0.0005), 0.05))
         if drained:  # gauge published outside _cond (TM201)
             self._publish_depth()
+            wc = time.monotonic()
+            for sub in out:
+                sub.wclose_t = wc
         return out
 
     def _oldest_enq_locked(self) -> float:
@@ -462,6 +549,7 @@ class VerifyScheduler(BaseService):
         waiters: List[List[Tuple[_Submission, int]]] = []
         lane_of: Dict[bytes, int] = {}
         cache_hits = dedup = 0
+        settled: List[_Submission] = []  # fully cache-resolved subs
         with trace.span("sched.coalesce", submissions=len(subs),
                         items=sum(s.n for s in subs)) as sp:
             for sub in subs:
@@ -474,7 +562,8 @@ class VerifyScheduler(BaseService):
                         continue
                     if _batch.verified_sigs.hit_key(k):
                         cache_hits += 1
-                        self._resolve(sub, i, True, None)
+                        self._resolve(sub, i, True, None,
+                                      settled=settled)
                         continue
                     lane_of[k] = len(lanes)
                     lanes.append(it)
@@ -499,10 +588,28 @@ class VerifyScheduler(BaseService):
             # end -> half; a gauge, not an invoice
             self._stats["stage_overlap_s"] += \
                 dt * (0.5 * (overlap0 + overlap1))
+        # publish BEFORE firing the settled futures: a waiter returning
+        # from result() must already find its request on every surface
+        for sub in settled:
+            self._account_latency(sub)
         if not lanes:
+            # the whole window resolved from SigCache at staging: this
+            # IS the window's latency report — there will be no execute
+            _set_latency_report(_build_report(
+                subs, "sched-cache", 0, stage_s=dt, exec_wait_s=0.0,
+                execute_s=0.0, settle_s=0.0))
+            self._publish_slo({s.prio.name.lower() for s in subs})
+            for sub in settled:
+                self._fire(sub)
             return None
-        return _Launch(lanes, keys, waiters, by_scheme, subs, parent,
-                       cache_hits, dedup)
+        for sub in settled:  # fully-cached subs need not wait for the
+            self._fire(sub)  # window's lanes; their report rows come
+        #                      from launch.subs in _execute
+        launch = _Launch(lanes, keys, waiters, by_scheme, subs, parent,
+                         cache_hits, dedup)
+        launch.wclose_t = min(s.wclose_t for s in subs)
+        launch.staged_t = time.monotonic()
+        return launch
 
     # -- execute side of the pipeline -------------------------------------
 
@@ -545,6 +652,9 @@ class VerifyScheduler(BaseService):
         lanes, by_scheme = launch.lanes, launch.by_scheme
         n = len(lanes)
         out = np.zeros(n, dtype=bool)
+        t_exec0 = time.monotonic()
+        t_submit0 = min(s.submit_t for s in launch.subs)
+        fell_back: List[str] = []  # schemes whose device lane degraded
         with trace.span("sched.launch", parent=launch.parent_span, n=n,
                         schemes=",".join(f"{t}:{len(ix)}"
                                          for t, ix in by_scheme.items()),
@@ -593,21 +703,28 @@ class VerifyScheduler(BaseService):
                 # — the window costs max over lanes, not their sum
                 _batch._run_host_lanes(host_lanes, out, "sched.host_lane",
                                        sp.span_id, assume_miss=True,
-                                       lane_times=lane_times)
+                                       lane_times=lane_times,
+                                       t_submit=t_submit0)
             finally:
                 # settle EVERY device lane (same contract as
                 # BatchVerifier): collect() never raises — any failure
                 # re-verifies through host_fn with the exact bitmap
+                # (the _mark_fallback wrapper records that this window
+                # degraded, so the e2e latency is labeled
+                # path="sched-fallback", not mistaken for device speed)
                 for tname, idxs, items, fut, t0, done_at in device_lanes:
                     out[np.asarray(idxs)] = rt.collect(
                         f"sched.{tname}", fut,
-                        host_fn=partial(_batch._host_verify_items,
-                                        tname, items, assume_miss=True),
+                        host_fn=_mark_fallback(
+                            fell_back, tname,
+                            partial(_batch._host_verify_items,
+                                    tname, items, assume_miss=True)),
                         spot_check=_batch._spot_check_items(items))
                     lane_times.append((tname, "device", t0,
                                        done_at[0] if done_at
                                        else time.monotonic()))
-            _batch._publish_lane_report(lane_times, sp, rt is not None)
+            lane_rep = _batch._publish_lane_report(lane_times, sp,
+                                                   rt is not None)
             if tracing and len(device_lanes) == 1:
                 # which kernel family the window's device lane actually
                 # took (comb when it resolved to a cached validator set,
@@ -618,15 +735,52 @@ class VerifyScheduler(BaseService):
                 rec = _edops.last_launch()
                 if rec.get("seq", 0) == seq0 + 1:
                     sp.add(route=rec.get("path"))
+        t_exec1 = time.monotonic()
         try:
             self._metrics().sched_batch_size.observe(float(n))
         except Exception:  # noqa: BLE001
             pass
-        for j in range(n):
-            bit = bool(out[j])
-            key = launch.keys[j] if bit else None
-            for sub, i in launch.waiters[j]:
-                self._resolve(sub, i, bit, key)
+        if fell_back:
+            path = "sched-fallback"
+        elif device_lanes:
+            path = "sched-device"
+        else:
+            path = "sched-host"
+        settled: List[_Submission] = []
+        try:
+            for j in range(n):
+                bit = bool(out[j])
+                key = launch.keys[j] if bit else None
+                for sub, i in launch.waiters[j]:
+                    self._resolve(sub, i, bit, key, path,
+                                  settled=settled)
+            t_settle = time.monotonic()
+            # publication order matters: histograms + report + SLO
+            # gauges land BEFORE the futures fire, so a waiter
+            # returning from result() (and anything it immediately
+            # polls — /debug/latency, /metrics) already reflects its
+            # own request.  lane_rep is THIS window's decomposition,
+            # not a re-read of the process-global last_lane_report()
+            # (a concurrent direct batch could have replaced it).
+            for sub in settled:
+                self._account_latency(sub)
+            _set_latency_report(_build_report(
+                launch.subs, path, n,
+                stage_s=launch.staged_t - launch.wclose_t,
+                exec_wait_s=max(t_exec0 - launch.staged_t, 0.0),
+                execute_s=t_exec1 - t_exec0,
+                settle_s=t_settle - t_exec1,
+                lane_report=lane_rep))
+            self._publish_slo({s.prio.name.lower() for s in launch.subs})
+        finally:
+            # completed submissions fire even if resolution or
+            # publication raised mid-way — a raise past this point
+            # reaches _exec_loop's rescue (_resolve_by_host), and a
+            # sub whose future never fired would otherwise hang its
+            # waiter forever (the re-resolve drives `remaining`
+            # negative, so `done` can never trigger again)
+            for sub in settled:
+                self._fire(sub)
 
     def _resolve_by_host(self, launch: _Launch):
         """Last-ditch settlement when _execute itself raised: per-item
@@ -638,20 +792,95 @@ class VerifyScheduler(BaseService):
                 bit = False
             for sub, i in launch.waiters[j]:
                 self._resolve(sub, i, bit,
-                              launch.keys[j] if bit else None)
+                              launch.keys[j] if bit else None,
+                              "sched-fallback")
+        # a sub that already completed inside the failed _execute has
+        # remaining <= 0 now (the re-resolve above decremented past
+        # zero), so _resolve's `done` can never fire for it again —
+        # force-settle every future.  First resolution wins: for
+        # futures _execute or the loop above already fired this is a
+        # no-op; for a stranded one, bits are fully populated by the
+        # host re-verify above, so no waiter can hang.
+        for sub in launch.subs:
+            sub.future._set(sub.bits)
 
     def _resolve(self, sub: _Submission, i: int, bit: bool,
-                 key: Optional[bytes]):
+                 key: Optional[bytes], path: str = "sched-cache",
+                 settled: Optional[List[_Submission]] = None):
+        """Apply one item's verdict.  When the submission completes it
+        is stamped and either finished immediately or — when `settled`
+        is given — handed back to the caller, which publishes the
+        window's latency surfaces BEFORE firing the futures: a waiter
+        returning from fut.result() must already find its request in
+        the histograms and last_latency_report() (the surfaces would
+        otherwise race the woken thread)."""
         if bit and sub.populate_cache and key is not None:
             _batch.verified_sigs.add_key(key)
         with self._res_lock:
             sub.bits[i] = bit
             sub.remaining -= 1
             done = sub.remaining == 0
-        if done:
-            trace.instant("sched.resolve", priority=sub.prio.name.lower(),
-                          n=sub.n, valid=int(sub.bits.sum()))
-            sub.future._set(sub.bits)
+        if not done:
+            return
+        # stamp AFTER _res_lock releases; publication never holds a
+        # scheduler lock (_account_latency resolves the metrics bundle
+        # through degrade.runtime()'s rank-5 install lock — TM201)
+        sub.settle_t = time.monotonic()
+        sub.path = path
+        if settled is not None:
+            settled.append(sub)
+        else:
+            self._account_latency(sub)
+            self._fire(sub)
+
+    @staticmethod
+    def _fire(sub: _Submission):
+        trace.instant("sched.resolve", priority=sub.prio.name.lower(),
+                      n=sub.n, valid=int(sub.bits.sum()))
+        sub.future._set(sub.bits)
+
+    def _account_latency(self, sub: _Submission):
+        """Publish the settled request's lifecycle (ADR-016):
+        queue-wait + e2e histograms, deadline-met accounting, SLO
+        stream feed.  Runs with NO scheduler lock held."""
+        prio = sub.prio.name.lower()
+        e2e = sub.settle_t - sub.submit_t
+        missed = sub.deadline is not None and sub.settle_t > sub.deadline
+        sub.deadline_missed = missed
+        slo.observe(prio, e2e)  # no-op unless [slo]/TM_TPU_SLO enabled
+        try:
+            m = self._metrics()
+            if sub.wclose_t:
+                m.sched_queue_wait.observe(sub.wclose_t - sub.submit_t,
+                                           priority=prio)
+            m.verify_e2e_latency.observe(e2e, priority=prio,
+                                         path=sub.path)
+            if missed:
+                m.sched_deadline_miss.inc(priority=prio)
+        except Exception:  # noqa: BLE001 - observability must not break
+            pass
+        if missed:
+            trace.instant("sched.deadline_miss", priority=prio, n=sub.n,
+                          late_s=round(sub.settle_t - sub.deadline, 6))
+
+    def _publish_slo(self, streams):
+        """Refresh the windowed SLO gauges for the priority streams the
+        settled window touched.  One read-side pass per launch — the
+        per-observation hot path stays a ring store."""
+        if not slo.is_enabled():
+            return
+        try:
+            m = self._metrics()
+            for s in streams:
+                rep = slo.stream_report(s)
+                if rep is None:
+                    continue
+                m.slo_p50.set(rep["p50_s"], stream=s)
+                m.slo_p99.set(rep["p99_s"], stream=s)
+                if "burn_rate" in rep:
+                    m.slo_burn_rate.set(rep["burn_rate"], stream=s)
+        except Exception:  # noqa: BLE001 - observability must not break
+            pass
 
     # -- introspection -----------------------------------------------------
 

@@ -79,6 +79,10 @@ LOCK_ORDER = {
     "tendermint_tpu/libs/kvdb.py:SQLiteDB._lock": 69,
     "tendermint_tpu/libs/autofile.py:Group._lock": 70,
     "tendermint_tpu/libs/flowrate.py:Monitor._lock": 72,
+    # SLO estimator ring (libs/slo.py, ADR-016): a leaf like the
+    # metrics locks — observe() takes it alone, and the read side
+    # (stream_report) sorts a snapshot OUTSIDE it
+    "tendermint_tpu/libs/slo.py:SloEstimator._lock": 76,
 
     # -- observability: always acquired last, hold nothing --
     "tendermint_tpu/libs/metrics.py:Registry._lock": 80,

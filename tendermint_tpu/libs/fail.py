@@ -69,6 +69,12 @@ REGISTERED_SITES = frozenset({
     # C verify — raise/latency/corrupt-bitmap all degrade to the
     # serial in-caller path with exact bitmaps
     "lanepool.verify",
+    # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
+    # dead-backend (raise) and wedged-backend (latency:<ms> past the
+    # probe timeout) classes deterministically, so the opportunistic
+    # probe-retry window and the rc=0 host-fallback line are testable
+    # without a real tunnel
+    "bench.probe",
 })
 
 # families for sites assembled at runtime ONLY (f"batch.{scheme}" in

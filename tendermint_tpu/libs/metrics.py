@@ -12,6 +12,7 @@ node/node.go:959-962 — here it rides the existing RPC listener).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -109,6 +110,38 @@ def exp_buckets(start: float, factor: float, count: int) -> List[float]:
     return out
 
 
+class _HistTimer:
+    """One timed bracket against a histogram (Histogram.time()).
+
+    Two shapes: the context-manager form observes the wall clock on a
+    CLEAN exit (an exception means the bracket never completed — same
+    policy every existing hand-rolled site applied by observing at the
+    end of the happy path), and the manual form calls ``observe()``
+    exactly at the point the caller declares success (the degradation
+    runtime observes launch seconds only when the launch did not
+    degrade)."""
+
+    __slots__ = ("_h", "_clock", "_labels", "_t0")
+
+    def __init__(self, h: "Histogram", clock, labels):
+        self._h = h
+        self._clock = clock
+        self._labels = labels
+        self._t0 = clock()
+
+    def __enter__(self):
+        self._t0 = self._clock()
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        if etype is None:
+            self.observe()
+        return False
+
+    def observe(self):
+        self._h.observe(self._clock() - self._t0, **self._labels)
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -120,6 +153,24 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sum: Dict[Tuple[str, ...], float] = {}
         self._n: Dict[Tuple[str, ...], int] = {}
+
+    def time(self, clock=time.monotonic, **labels) -> _HistTimer:
+        """Timed-bracket helper: ``with hist.time(site=...):`` observes
+        the wall clock of the block, replacing the hand-rolled
+        ``t0 = monotonic() ... observe(monotonic() - t0)`` pattern.
+        `clock` is injectable (the degradation runtime times against
+        its deterministic test clock)."""
+        return _HistTimer(self, clock, labels)
+
+    def count(self, **labels) -> int:
+        """Observation count for a label set (test/report accessor)."""
+        with self._lock:
+            return self._n.get(self._key(labels), 0)
+
+    def total(self, **labels) -> float:
+        """Sum of observed values for a label set."""
+        with self._lock:
+            return self._sum.get(self._key(labels), 0.0)
 
     def observe(self, v: float, **labels):
         key = self._key(labels)
@@ -375,6 +426,51 @@ class CryptoMetrics:
             "full or disabled, 'fallback' when a pool fault forced the "
             "serial re-verify).",
             labels=("kind", "outcome"))
+        # per-request latency observatory (ADR-016): the lifecycle of a
+        # verify request — time in the scheduler queue, end-to-end
+        # submit-to-settle latency by priority and the path that
+        # settled it, and whether deadlines were actually met (the
+        # scheduler's `deadline` used to only TIME the window close,
+        # never record the outcome)
+        self.sched_queue_wait = reg.histogram(
+            "crypto", "sched_queue_wait_seconds",
+            "Time a VerifyScheduler submission waited from submit() to "
+            "its coalescing window closing, by priority class.",
+            labels=("priority",), buckets=exp_buckets(0.0002, 4, 10))
+        self.verify_e2e_latency = reg.histogram(
+            "crypto", "verify_e2e_latency_seconds",
+            "End-to-end verify latency, submit to settle, by priority "
+            "class and settling path: sched-device / sched-host / "
+            "sched-fallback (degrade host re-verify inside a scheduler "
+            "window) / sched-cache (resolved from SigCache without "
+            "lanes) / direct (the BatchVerifier path when the "
+            "scheduler is not running).",
+            labels=("priority", "path"),
+            buckets=exp_buckets(0.0002, 4, 12))
+        self.sched_deadline_miss = reg.counter(
+            "crypto", "sched_deadline_miss_total",
+            "Submissions that settled AFTER their requested deadline "
+            "(the window closes early to chase a deadline; this counts "
+            "the ones the launch still failed to meet).",
+            labels=("priority",))
+        # sliding-window SLO estimator (libs/slo.py): windowed
+        # quantiles and error-budget burn, refreshed after each
+        # scheduler launch when [slo] / TM_TPU_SLO=1 is enabled
+        self.slo_p50 = reg.gauge(
+            "crypto", "slo_p50_seconds",
+            "Median verify e2e latency over the sliding SLO window, "
+            "per stream (priority class).  Absent until [slo] enables "
+            "the estimator.", labels=("stream",))
+        self.slo_p99 = reg.gauge(
+            "crypto", "slo_p99_seconds",
+            "p99 verify e2e latency over the sliding SLO window, per "
+            "stream.", labels=("stream",))
+        self.slo_burn_rate = reg.gauge(
+            "crypto", "slo_burn_rate",
+            "Error-budget burn rate against the stream's p99 target "
+            "([slo] config): windowed fraction of requests over "
+            "target / 0.01.  1.0 = spending the budget exactly as "
+            "fast as the SLO allows.", labels=("stream",))
 
 
 class P2PMetrics:

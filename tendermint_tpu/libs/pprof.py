@@ -17,6 +17,11 @@ image) and with near-zero overhead when idle:
                                as Chrome-trace / Perfetto JSON; `since`
                                fetches incrementally from a previous
                                response's last_seq cursor
+  GET /debug/latency           latency observatory (libs/slo.py +
+                               crypto/scheduler.last_latency_report):
+                               windowed SLO quantiles/burn rates and
+                               the most recent verify window's
+                               per-request lifecycle decomposition
 
 SIGUSR1 installs the same stack dump onto the process logger, so a hung
 node can be inspected with plain `kill -USR1` even when the HTTP
@@ -147,10 +152,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(trace.chrome_trace(since),
                                            default=str),
                            ctype="application/json")
+            elif url.path == "/debug/latency":
+                # the latency observatory (ADR-016): windowed SLO
+                # quantiles/burn rates + the most recent scheduler
+                # window's lifecycle decomposition + the per-lane wall
+                # breakdown.  Lazy crypto imports: the pprof listener
+                # must stay importable without the verify stack
+                from tendermint_tpu.crypto import batch as _cbatch
+                from tendermint_tpu.crypto import scheduler as _vsched
+                from tendermint_tpu.libs import slo
+                body = {
+                    "slo": slo.report(),
+                    "last_latency_report":
+                        _vsched.last_latency_report(),
+                    "last_lane_report": _cbatch.last_lane_report(),
+                }
+                self._send(200, json.dumps(body, default=str),
+                           ctype="application/json")
             else:
                 self._send(404, "pprof routes: /debug/stacks "
                                 "/debug/threads /debug/profile?seconds=N "
-                                "/debug/gc /debug/trace?since=N\n")
+                                "/debug/gc /debug/trace?since=N "
+                                "/debug/latency\n")
         except Exception as e:  # noqa: BLE001 - debug surface never fatal
             self._send(500, f"error: {e}\n")
 

@@ -73,8 +73,8 @@ KNOWN_SPANS = frozenset({
     # ops/ — kernel routing
     "msm.route", "ops.ed25519.verify_batch", "table_build",
     # crypto/scheduler.py — the VerifyScheduler pipeline
-    "sched.coalesce", "sched.host_lane", "sched.launch",
-    "sched.resolve", "sched.shed", "sched.submit",
+    "sched.coalesce", "sched.deadline_miss", "sched.host_lane",
+    "sched.launch", "sched.resolve", "sched.shed", "sched.submit",
     # state/execution.py
     "state.apply_block", "state.validate_block",
 })

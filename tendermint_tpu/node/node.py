@@ -451,6 +451,13 @@ class Node(BaseService):
         edops.set_comb_config(
             enabled=self.config.batch_verifier.comb,
             table_cache_mb=self.config.batch_verifier.table_cache_mb)
+        # latency SLO estimator (libs/slo.py, ADR-016): window +
+        # per-priority p99 targets from [slo]; config wins over a stale
+        # TM_TPU_SLO env both ways
+        from tendermint_tpu.libs import slo
+        slo.set_config(enabled=self.config.slo.enable,
+                       window=self.config.slo.window,
+                       targets=self.config.slo.targets_s())
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,

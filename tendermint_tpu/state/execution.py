@@ -184,7 +184,11 @@ class BlockExecutor:
 
     def _apply_block(self, state: State, block_id: BlockID,
                      block: Block) -> Tuple[State, ABCIResponses]:
-        _t0 = time.perf_counter()
+        # Histogram.time observes on clean exit only — identical to the
+        # old hand-rolled perf_counter delta, which sat after the last
+        # raise site and so never recorded a failed apply either
+        block_timer = self.metrics.block_processing_time.time(
+            clock=time.perf_counter)
         with trace.span("state.validate_block",
                         height=block.header.height):
             self.validate_block(state, block)
@@ -218,8 +222,7 @@ class BlockExecutor:
 
         if self.event_bus is not None:
             self._fire_events(block, block_id, responses, validator_updates)
-        self.metrics.block_processing_time.observe(
-            time.perf_counter() - _t0)
+        block_timer.observe()
         return new_state, responses
 
     def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:

@@ -23,6 +23,10 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     cfg.rpc.max_body_bytes = 65536
     cfg.batch_verifier.secp_lane = False   # non-default (rollback)
     cfg.batch_verifier.host_pool_workers = 6
+    cfg.slo.enable = True                  # non-default (ADR-016)
+    cfg.slo.window = 2048
+    cfg.slo.consensus_p99_ms = 5.0
+    cfg.slo.mempool_p99_ms = 250.0
     cfg.save()
     back = Config.load(str(tmp_path))
     assert back.consensus.timeout_commit == 2.5
@@ -37,8 +41,15 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     assert back.rpc.max_body_bytes == 65536
     assert back.batch_verifier.secp_lane is False
     assert back.batch_verifier.host_pool_workers == 6
+    assert back.slo.enable is True
+    assert back.slo.window == 2048
+    assert back.slo.consensus_p99_ms == 5.0
+    assert back.slo.mempool_p99_ms == 250.0
+    # only the set targets appear, converted ms -> seconds
+    assert back.slo.targets_s() == {"consensus": 0.005, "mempool": 0.25}
     # and the shipped defaults survive a round trip too
     assert Config(home=str(tmp_path)).batch_verifier.secp_lane is True
+    assert Config(home=str(tmp_path)).slo.enable is False
     back.validate_basic()
 
 
@@ -54,6 +65,8 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     (lambda c: setattr(c.rpc, "max_body_bytes", 0), "rpc"),
     (lambda c: setattr(c.batch_verifier, "host_pool_workers", -2),
      "batch_verifier"),
+    (lambda c: setattr(c.slo, "window", 0), "slo"),
+    (lambda c: setattr(c.slo, "consensus_p99_ms", -1.0), "slo"),
 ])
 def test_validate_basic_rejects_nonsense(mutate, wants):
     cfg = Config(home="/tmp/x")

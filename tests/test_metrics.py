@@ -57,6 +57,40 @@ def test_exp_buckets():
     assert b == [0.1, 1.0, 10.0, 100.0]
 
 
+def test_histogram_time_context_manager_and_manual():
+    """Histogram.time() (ISSUE 8 satellite): the context-manager form
+    observes the bracket's wall clock on clean exit only; the manual
+    form observes exactly where the caller declares success (the
+    degrade device_launch_seconds discipline); `clock` is injectable."""
+    reg = Registry("tm_timer")
+    h = reg.histogram("x", "dur_seconds", labels=("site",))
+    clk = [100.0]
+
+    def clock():
+        return clk[0]
+
+    with h.time(clock=clock, site="a"):
+        clk[0] += 2.5
+    assert h.count(site="a") == 1
+    assert h.total(site="a") == 2.5
+
+    # an exception inside the bracket skips the observation — the
+    # failure path's wall belongs to failure counters, not latency
+    try:
+        with h.time(clock=clock, site="a"):
+            clk[0] += 9.0
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert h.count(site="a") == 1
+
+    # manual form: start at construction, observe() on demand
+    t = h.time(clock=clock, site="b")
+    clk[0] += 0.75
+    t.observe()
+    assert h.total(site="b") == 0.75
+
+
 # ---------------------------------------------------------------------------
 # text-format escaping + scrape-and-parse conformance (ISSUE 3 satellite:
 # a label value carrying ", \ or a newline used to corrupt the whole

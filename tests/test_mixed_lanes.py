@@ -202,9 +202,9 @@ def test_single_cache_miss_takes_native_c_lane(monkeypatch):
     calls = []
     real = lanepool.verify_sharded
 
-    def spy(tname, pubs, msgs, sigs):
+    def spy(tname, pubs, msgs, sigs, **kw):
         calls.append((tname, len(pubs)))
-        return real(tname, pubs, msgs, sigs)
+        return real(tname, pubs, msgs, sigs, **kw)
 
     monkeypatch.setattr(lanepool, "verify_sharded", spy)
 

@@ -1,0 +1,184 @@
+"""libs/slo: the sliding-window SLO estimator (ADR-016) — disabled
+no-op discipline (trace.py's contract), exact-over-the-window quantiles
+vs a sorted-array oracle (wraparound included), burn rates against
+targets, and the config/env wiring."""
+from __future__ import annotations
+
+import math
+import random
+import threading
+import timeit
+
+import pytest
+
+from tendermint_tpu.libs import slo
+from tendermint_tpu.libs.slo import SloEstimator
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    slo.disable()
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_records_nothing():
+    est = SloEstimator(window=16, enabled=False)
+    for i in range(100):
+        est.observe("consensus", i / 1000.0)
+    assert est.window_values("consensus") == []
+    assert est.quantile("consensus", 0.99) is None
+    assert est.stream_report("consensus") is None
+    est.enable()
+    est.observe("consensus", 0.001)
+    assert est.window_values("consensus") == [0.001]
+    est.disable()
+    est.observe("consensus", 0.002)
+    assert est.window_values("consensus") == [0.001]
+
+
+def test_disabled_call_site_overhead_sub_microsecond():
+    """The scheduler and the direct verify path call slo.observe()
+    unconditionally per settled request, so the disabled path must
+    stay sub-microsecond (one enabled check, one return) — same gate
+    trace.py carries.  min-of-repeats dodges CI load spikes."""
+    slo.disable()
+    n = 20000
+
+    def site():
+        slo.observe("consensus", 0.0042)
+
+    per_call = min(timeit.repeat(site, number=n, repeat=5)) / n
+    assert per_call < 1e-6, f"disabled observe cost {per_call * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------------------------------
+# quantiles vs the sorted-array oracle
+# ---------------------------------------------------------------------------
+
+def _oracle_quantile(window_vals, q):
+    """Nearest-rank over the sorted window: the smallest value with at
+    least ceil(q*n) of the window at or below it."""
+    vals = sorted(window_vals)
+    k = max(1, math.ceil(q * len(vals)))
+    return vals[k - 1]
+
+
+@pytest.mark.parametrize("window,total", [
+    (64, 40),     # partially filled ring
+    (64, 64),     # exactly full
+    (64, 1000),   # wrapped many times
+    (1, 17),      # degenerate one-slot ring
+])
+def test_quantiles_match_sorted_oracle(window, total):
+    """Property: for ANY observation stream, the estimator's quantile
+    equals the nearest-rank quantile of the LAST `window` observations
+    — the ring is an exact sliding window, wraparound included."""
+    rng = random.Random(window * 100003 + total)
+    est = SloEstimator(window=window, enabled=True)
+    seen = []
+    for _ in range(total):
+        v = rng.expovariate(100.0)  # latency-shaped heavy tail
+        est.observe("s", v)
+        seen.append(v)
+    tail = seen[-window:]
+    assert sorted(est.window_values("s")) == sorted(tail)
+    for q in (0.01, 0.5, 0.9, 0.99, 1.0):
+        assert est.quantile("s", q) == _oracle_quantile(tail, q), (
+            window, total, q)
+
+
+def test_streams_are_independent():
+    est = SloEstimator(window=8, enabled=True)
+    for i in range(8):
+        est.observe("a", 0.001)
+        est.observe("b", 0.100)
+    assert est.quantile("a", 0.99) == 0.001
+    assert est.quantile("b", 0.99) == 0.100
+
+
+# ---------------------------------------------------------------------------
+# burn rate
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_against_target():
+    """10% of the window over a p99 target burns the 1% budget at 10x."""
+    est = SloEstimator(window=100, enabled=True,
+                       targets={"mempool": 0.05})
+    for i in range(100):
+        est.observe("mempool", 0.2 if i % 10 == 0 else 0.01)
+    rep = est.stream_report("mempool")
+    assert rep["n"] == 100
+    assert rep["target_p99_s"] == 0.05
+    assert rep["over_target_frac"] == pytest.approx(0.10)
+    assert rep["burn_rate"] == pytest.approx(10.0)
+    # a stream with no target reports quantiles but no burn rate
+    est.observe("commit", 0.01)
+    rep2 = est.stream_report("commit")
+    assert "burn_rate" not in rep2 and "target_p99_s" not in rep2
+
+
+def test_report_shape_and_reset():
+    est = SloEstimator(window=4, enabled=True, targets={"commit": 1.0})
+    est.observe("commit", 0.5)
+    rep = est.report()
+    assert rep["enabled"] is True and rep["window"] == 4
+    assert rep["targets_s"] == {"commit": 1.0}
+    assert rep["streams"]["commit"]["p50_s"] == 0.5
+    est.reset()
+    assert est.report()["streams"] == {}
+
+
+# ---------------------------------------------------------------------------
+# process-global wiring
+# ---------------------------------------------------------------------------
+
+def test_set_config_wins_over_env_both_ways(monkeypatch):
+    """Node wiring: [slo] enable=true arms the estimator even without
+    TM_TPU_SLO; enable=false disarms it even WITH TM_TPU_SLO=1 (the
+    same both-ways contract as secp.set_lane_enabled)."""
+    monkeypatch.delenv("TM_TPU_SLO", raising=False)
+    slo.set_config(enabled=True, window=32,
+                   targets={"consensus": 0.005})
+    assert slo.is_enabled()
+    assert slo.EST.window == 32
+    assert slo.EST.targets == {"consensus": 0.005}
+    slo.observe("consensus", 0.001)
+    assert slo.quantile("consensus", 0.5) == 0.001
+
+    monkeypatch.setenv("TM_TPU_SLO", "1")
+    slo.set_config(enabled=False)
+    assert not slo.is_enabled()
+
+
+def test_enable_resizes_window_and_drops_stale_rings():
+    est = SloEstimator(window=4, enabled=True)
+    for i in range(4):
+        est.observe("s", float(i))
+    est.enable(window=8)
+    assert est.window == 8
+    assert est.window_values("s") == []  # rings are sized at creation
+    for i in range(3):
+        est.observe("s", float(i))
+    assert len(est.window_values("s")) == 3
+
+
+def test_concurrent_observes_keep_ring_bounded():
+    est = SloEstimator(window=64, enabled=True)
+
+    def worker(k):
+        for i in range(500):
+            est.observe("hot", k + i / 1000.0)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    vals = est.window_values("hot")
+    assert len(vals) == 64
+    assert est.stream_report("hot")["n"] == 64
