@@ -634,12 +634,158 @@ def _mixed_main():
           f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
 
 
+def _build_bsync_chain(n_vals: int, n_blocks: int, n_txs: int):
+    """Deterministic committed chain for the blocksync config, built
+    with the same helper the blocksync tests use (tests/helpers.py)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from helpers import build_chain, make_genesis
+
+    gdoc, privs = make_genesis(n_vals)
+    txs_fn = lambda h: [b"bench%d.%d=%s" % (h, i, b"v" * 64)  # noqa: E731
+                        for i in range(n_txs)]
+    blocks, commits, states = build_chain(gdoc, privs, n_blocks,
+                                          txs_fn=txs_fn)
+    return gdoc, blocks, commits, states
+
+
+def _blocksync_main():
+    """Block-pipeline config (BENCH_BLOCKSYNC=1, PERF.md config 4 floor):
+    replay one committed chain into REAL temp-file SQLiteDB-backed
+    stores three ways — (a) strict serial reference shape: per-height
+    verify + apply + per-height durable commits (commit_every=1,
+    synchronous=FULL — the reference's WriteSync/SetSync semantics),
+    (b) the coalesced window path (ADR-003/012 era), (c) the ADR-017
+    BlockPipeline with GroupCommitDB group commit.  CPU-only by design:
+    config 4's verify share is ~0% (BASELINE: replay with verify vs
+    without differs by run-to-run noise), so the SigCache is prewarmed
+    with every triple the windows need — the bench isolates the
+    apply + storage floor that bounds catch-up, the thing this config
+    exists to measure.  Emits ONE JSON line (rc=0 even without any
+    accelerator: nothing here wants one)."""
+    import tempfile
+
+    from tendermint_tpu.blocksync import replay as _replay
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.libs.kvdb import GroupCommitDB, SQLiteDB
+    from tendermint_tpu.state import pipeline as blockpipe
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    t_start = time.time()
+    # keep the degradation runtime off a possibly-wedged backend: the
+    # verify cost is prewarmed out of the measurement either way
+    os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+    n_vals = int(os.environ.get("BENCH_BSYNC_VALS", "16"))
+    n_blocks = int(os.environ.get("BENCH_BSYNC_BLOCKS", "64"))
+    n_txs = int(os.environ.get("BENCH_BSYNC_TXS", "20"))
+    window = int(os.environ.get("BENCH_BSYNC_WINDOW", "32"))
+    group = int(os.environ.get("BENCH_BSYNC_GROUP", "16"))
+    depth = int(os.environ.get("BENCH_BSYNC_DEPTH", "4"))
+    gdoc, blocks, commits, states = _build_bsync_chain(n_vals, n_blocks,
+                                                       n_txs)
+    build_s = time.time() - t_start
+
+    # verify share -> 0 (the config-4 regime): prewarm the process
+    # SigCache with every commit signature the replay will look up
+    t0 = time.time()
+    cbatch.verified_sigs = cbatch.SigCache()
+    state0 = state_from_genesis(gdoc)
+    bv = cbatch.BatchVerifier()
+    for c in commits:
+        for idx, cs in enumerate(c.signatures):
+            if cs.is_absent():
+                continue
+            bv.add(state0.validators.validators[idx].pub_key,
+                   c.vote_sign_bytes(gdoc.chain_id, idx), cs.signature)
+    all_ok, _bits = bv.verify()
+    assert all_ok, "blocksync bench chain has invalid signatures"
+    prewarm_s = time.time() - t0
+
+    tmp = tempfile.mkdtemp(prefix="bench_bsync_")
+
+    def run(kind: str) -> float:
+        commit_every = 64 if kind == "pipelined" else 1
+        bdb = SQLiteDB(os.path.join(tmp, kind + "_blocks.db"),
+                       commit_every=commit_every, synchronous="FULL")
+        sdb = SQLiteDB(os.path.join(tmp, kind + "_state.db"),
+                       commit_every=commit_every, synchronous="FULL")
+        if kind == "pipelined":
+            bdb, sdb = GroupCommitDB(bdb), GroupCommitDB(sdb)
+            blockpipe.set_config(enable=True, depth=depth,
+                                 group_commit_heights=group)
+        ex = BlockExecutor(StateStore(sdb), KVStoreApplication())
+        store = BlockStore(bdb)
+        state = state_from_genesis(gdoc)
+        t0 = time.perf_counter()
+        if kind == "strict":
+            state, n = _replay._strict_sequential(
+                ex, store, state, blocks, commits, state.chain_id)
+        else:
+            applied = 0
+            while applied < n_blocks:
+                state, n = _replay.replay_window(
+                    ex, store, state, blocks[applied:], commits[applied:],
+                    max_window=window)
+                assert n > 0
+                applied += n
+        dt = time.perf_counter() - t0
+        if kind == "pipelined":
+            blockpipe.set_config(enable=False)
+        assert state.last_block_height == n_blocks
+        assert state.app_hash == states[-1].app_hash, kind
+        bdb.close()
+        sdb.close()
+        return dt
+
+    # untimed warm-up on its OWN db files: reusing a timed leg's files
+    # would leave its store pre-populated and the idempotent
+    # crash-resume branch in _apply_one would skip every block write
+    run("warmup")
+    strict_s = run("strict")
+    coalesced_s = run("coalesced")
+    pipelined_s = run("pipelined")
+
+    line = {
+        "metric": "blocksync_replay_blocks_per_s",
+        "value": round(n_blocks / pipelined_s, 1),
+        "unit": "blocks/s",
+        "vs_baseline": round(strict_s / pipelined_s, 2),
+        "serial_blocks_per_s": round(n_blocks / strict_s, 1),
+        "coalesced_blocks_per_s": round(n_blocks / coalesced_s, 1),
+        "vs_coalesced": round(coalesced_s / pipelined_s, 2),
+        "n_vals": n_vals,
+        "n_blocks": n_blocks,
+        "n_txs": n_txs,
+        "window": window,
+        "group_commit_heights": group,
+        "pipeline_depth": depth,
+        "wall_s": round(pipelined_s, 4),
+        "note": "host-only by design: verify share ~0 (prewarmed), "
+                "measures the apply+storage floor on temp-file SQLite "
+                "with synchronous=FULL",
+        "trace": _trace_artifact("blocksync"),
+    }
+    _emit(line)
+    print(f"# blocksync bench: vals={n_vals} blocks={n_blocks} "
+          f"build_s={build_s:.1f} prewarm_s={prewarm_s:.1f} "
+          f"strict_s={strict_s:.3f} coalesced_s={coalesced_s:.3f} "
+          f"pipelined_s={pipelined_s:.3f} "
+          f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_BLOCKSYNC") == "1":
+        _blocksync_main()
+        return
     if os.environ.get("BENCH_RLC") == "1":
         _rlc_main()
         return

@@ -186,6 +186,27 @@ def config4_blocksync(n_blocks=60, n_vals=150, window=30):
                                   commits[applied:], max_window=window)
         applied += n
     noverify_s = time.perf_counter() - t0
+
+    # BlockPipeline leg (ADR-017): same replay, stable windows routed
+    # through the pipeline with group-committed storage
+    from tendermint_tpu.libs.kvdb import GroupCommitDB
+    from tendermint_tpu.state import pipeline as blockpipe
+    ex3 = BlockExecutor(StateStore(GroupCommitDB(MemDB())),
+                        KVStoreApplication())
+    store3 = BlockStore(GroupCommitDB(MemDB()))
+    state3 = state_from_genesis(gdoc)
+    blockpipe.set_config(enable=True, depth=4, group_commit_heights=16)
+    try:
+        t0 = time.perf_counter()
+        applied = 0
+        while applied < n_blocks:
+            state3, n = replay_window(ex3, store3, state3,
+                                      blocks[applied:], commits[applied:],
+                                      max_window=window)
+            applied += n
+        pipelined_s = time.perf_counter() - t0
+    finally:
+        blockpipe.set_config(enable=False)
     return {"config": f"4: blocksync replay {n_blocks}x{n_vals}",
             "build_s": round(build_s, 1),
             "replay_s": round(replay_s, 2),
@@ -194,6 +215,9 @@ def config4_blocksync(n_blocks=60, n_vals=150, window=30):
             "replay_noverify_s": round(noverify_s, 2),
             "verify_share_pct": round(
                 100 * (replay_s - noverify_s) / replay_s, 1),
+            "pipelined_s": round(pipelined_s, 2),
+            "pipelined_blocks_per_s": round(n_blocks / pipelined_s, 1),
+            "pipeline_speedup": round(replay_s / pipelined_s, 2),
             **_launch_cols(base)}
 
 

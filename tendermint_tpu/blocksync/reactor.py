@@ -237,6 +237,15 @@ class BlocksyncReactor(Reactor):
                 if self.pool.is_caught_up() and not self._switched:
                     self._switched = True
                     self.pool.stop()
+                    # persistence barrier before the consensus handoff:
+                    # every group-committed window must be durable
+                    # before consensus starts writing per height again
+                    # (ADR-017; group mode is window-scoped, so this is
+                    # a cheap no-op unless a writer is mid-flush)
+                    from tendermint_tpu.state import pipeline as _bp
+                    pipe = _bp.running()
+                    if pipe is not None:
+                        pipe.flush()
                     if self.on_caught_up is not None:
                         self.on_caught_up(self.state)
                     return
@@ -277,10 +286,24 @@ class BlocksyncReactor(Reactor):
             rate = (self.blocks_synced - self._rate_marked) / dt
             self._rate_ema = rate if self._rate_ema == 0.0 \
                 else 0.9 * self._rate_ema + 0.1 * rate
+            from tendermint_tpu.state import pipeline as _bp
+            pipe = _bp.running()
+            # label by what actually ran, not by what is installed: a
+            # pipeline whose every window declined (k<2, busy) is
+            # "serial" to the operator, matching the
+            # blocksync_blocks_applied_total{path=} metric
+            pipelined = pipe is not None and pipe.windows_pipelined > 0
             self.log.info("fast sync rate",
                           height=self.state.last_block_height,
                           max_peer_height=self.pool.max_peer_height,
-                          blocks_per_s=round(self._rate_ema, 1))
+                          blocks_per_s=round(self._rate_ema, 1),
+                          path="pipelined" if pipelined else "serial",
+                          windows_pipelined=(pipe.windows_pipelined
+                                             if pipe is not None else 0),
+                          windows_degraded=(pipe.windows_degraded
+                                            if pipe is not None else 0),
+                          durable_height=(pipe.durable_height()
+                                          if pipe is not None else None))
             self._rate_t0 = now
             self._rate_marked = self.blocks_synced
         return n > 0

@@ -15,6 +15,11 @@ blocks x ~1.7N sigs ride a single TPU kernel launch instead of 2W host
 loops.  Verified commits are recorded in the executor's pre-verified cache
 so apply_block does not re-verify.
 
+When a BlockPipeline (state/pipeline.py, ADR-017) is installed and running,
+the stable prefix routes through it instead: block N+1 stages (decode,
+part-set, signature submission) and storage group-commits while block N
+applies — same verification semantics, overlapped in time.
+
 Correctness does not rest on the optimistic batch: any batch failure (or a
 window where the stable-set condition does not hold) falls back to the
 reference's strict sequential path, which identifies the offending height
@@ -68,6 +73,64 @@ def _stable_window(state, blocks: List[Block]) -> int:
     return max(k, 1 if blocks else 0)
 
 
+def _collect_block_items(state, chain_id: str, block: Block, cert,
+                         height: int, first: bool):
+    """Structural checks + signature-item collection for one block of a
+    stable window: the >2/3 light prefix certifying it plus the full
+    LastCommit set validate_block needs.  `first` selects
+    state.last_validators for the LastCommit indices of the window's
+    first block.  Raises on any malformed peer data.
+
+    Returns (bid, parts, prefix_items, lc_items)."""
+    bid, parts = block_id_of(block)
+    prefix = state.validators.collect_commit_light(chain_id, bid, height,
+                                                   cert)
+    prefix_items = [
+        (state.validators.validators[idx].pub_key,
+         cert.vote_sign_bytes(chain_id, idx),
+         cert.signatures[idx].signature)
+        for idx in prefix]
+    lvals = state.last_validators if first else state.validators
+    lc = block.last_commit
+    lc_items = []
+    if height > state.initial_height and lc is not None:
+        if len(lc.signatures) != lvals.size():
+            raise CommitVerifyError("LastCommit size mismatch")
+        for idx, cs in enumerate(lc.signatures):
+            if cs.is_absent():
+                continue
+            lc_items.append(
+                (lvals.validators[idx].pub_key,
+                 lc.vote_sign_bytes(chain_id, idx),
+                 cs.signature))
+    return bid, parts, prefix_items, lc_items
+
+
+def _strict_sequential(executor, store, state, blocks: List[Block],
+                       certifiers: List, chain_id: str, applied0: int = 0):
+    """The reference's strict sequential path: per-height
+    VerifyCommitLight + apply, attributing the first bad height.
+    `applied0` offsets WindowSyncError.applied when a pipelined prefix
+    of the same window already applied (ADR-017 fallback ladder)."""
+    applied = applied0
+    base_h = state.last_block_height + 1
+    for i in range(len(blocks)):
+        b, cert = blocks[i], certifiers[i]
+        h = base_h + i
+        try:
+            bid, parts = block_id_of(b)
+            state.validators.verify_commit_light(chain_id, bid, h, cert)
+        except Exception as e:
+            raise WindowSyncError(h, f"bad block/certifying commit: {e}",
+                                  state, applied) from e
+        try:
+            state = _apply_one(executor, store, state, b, bid, parts, cert)
+        except Exception as e:
+            raise WindowSyncError(h, str(e), state, applied) from e
+        applied += 1
+    return state, applied
+
+
 def replay_window(executor, store, state, blocks: List[Block],
                   certifiers: List, max_window: int = 64):
     """Verify + apply up to max_window consecutive blocks.
@@ -85,6 +148,18 @@ def replay_window(executor, store, state, blocks: List[Block],
     blocks = blocks[:max_window]
     certifiers = certifiers[:len(blocks)]
 
+    # ---- pipelined path (state/pipeline.py, ADR-017) ---------------------
+    # stage/verify block N+1 and group-commit storage while N applies;
+    # declines (None) when not running, the window is trivial, or the
+    # stable prefix is < 2 — every decline lands on the paths below
+    from tendermint_tpu.state import pipeline as _pipeline
+    pipe = _pipeline.running()
+    if pipe is not None:
+        res = pipe.replay_window(executor, store, state, blocks, certifiers,
+                                 max_window=max_window)
+        if res is not None:
+            return res
+
     k = _stable_window(state, blocks)
     chain_id = state.chain_id
     base_h = state.last_block_height + 1
@@ -98,30 +173,8 @@ def replay_window(executor, store, state, blocks: List[Block],
             b, cert = blocks[i], certifiers[i]
             h = base_h + i
             try:
-                bid, parts = block_id_of(b)
-                # light >2/3 prefix certifying block i
-                prefix = state.validators.collect_commit_light(
-                    chain_id, bid, h, cert)
-                prefix_items = [
-                    (state.validators.validators[idx].pub_key,
-                     cert.vote_sign_bytes(chain_id, idx),
-                     cert.signatures[idx].signature)
-                    for idx in prefix]
-                # full LastCommit set needed by validate_block(block i)
-                lvals = (state.last_validators if i == 0
-                         else state.validators)
-                lc = b.last_commit
-                lc_items = []
-                if h > state.initial_height and lc is not None:
-                    if len(lc.signatures) != lvals.size():
-                        raise CommitVerifyError("LastCommit size mismatch")
-                    for idx, cs in enumerate(lc.signatures):
-                        if cs.is_absent():
-                            continue
-                        lc_items.append(
-                            (lvals.validators[idx].pub_key,
-                             lc.vote_sign_bytes(chain_id, idx),
-                             cs.signature))
+                bid, parts, prefix_items, lc_items = _collect_block_items(
+                    state, chain_id, b, cert, h, first=(i == 0))
             except Exception:
                 # any malformed peer data truncates the window here; if this
                 # is block 0 the strict path below raises with attribution
@@ -171,25 +224,24 @@ def replay_window(executor, store, state, blocks: List[Block],
 
     # ---- strict sequential path (reference semantics) --------------------
     n = min(len(blocks), max(k, 1))
-    for i in range(n):
-        b, cert = blocks[i], certifiers[i]
-        h = base_h + i
-        try:
-            bid, parts = block_id_of(b)
-            state.validators.verify_commit_light(chain_id, bid, h, cert)
-        except Exception as e:
-            raise WindowSyncError(h, f"bad block/certifying commit: {e}",
-                                  state, applied) from e
-        try:
-            state = _apply_one(executor, store, state, b, bid, parts, cert)
-        except Exception as e:
-            raise WindowSyncError(h, str(e), state, applied) from e
-        applied += 1
-    return state, applied
+    return _strict_sequential(executor, store, state, blocks[:n],
+                              certifiers[:n], chain_id)
 
 
 def _apply_one(executor, store, state, block, bid, parts, cert):
     if store is not None:
-        store.save_block(block, parts, cert)
+        h = block.header.height
+        if store.height() >= h:
+            # crash-recovery resume (ADR-017): a previous run's group
+            # commit already made this block durable (the state store
+            # can trail the block store by up to one commit group).
+            # Re-saving would violate store-height monotonicity; verify
+            # identity instead and skip the save.
+            meta = store.load_block_meta(h)
+            if meta is None or meta.block_id.hash != block.hash():
+                raise ValueError(
+                    f"stored block {h} does not match replayed block")
+        else:
+            store.save_block(block, parts, cert)
     new_state, _resp = executor.apply_block(state, bid, block)
     return new_state

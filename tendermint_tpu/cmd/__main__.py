@@ -225,6 +225,10 @@ def cmd_rollback(args):
     block_store = BlockStore(SQLiteDB(cfg.block_db_file()))
     state_store = StateStore(SQLiteDB(cfg.state_db_file()))
     height, app_hash = rollback(block_store, state_store)
+    # close() commits the deferred single-op window (ADR-017) — the
+    # rewritten state must be durable when the command exits
+    state_store.db.close()
+    block_store.db.close()
     print(f"Rolled back state to height {height} and "
           f"hash {app_hash.hex().upper()}")
 
@@ -300,6 +304,7 @@ def cmd_reindex_event(args):
         bl_ix.index(h, getattr(resp.begin_block, "events", []) or [],
                     getattr(resp.end_block, "events", []) or [])
         n += 1
+    ix_db.close()   # commit the deferred index writes (ADR-017)
     print(f"reindexed events for {n} heights in [{first}, {last}]")
 
 

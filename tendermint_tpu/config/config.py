@@ -185,6 +185,30 @@ class VerifySchedulerConfig:
 
 
 @dataclass
+class BlockPipelineConfig:
+    """Prefetched, group-committed block application
+    (state/pipeline.py, docs/adr/adr-017-block-pipeline.md).  When
+    enabled the node wraps the block/state DBs in kvdb.GroupCommitDB,
+    installs one BlockPipeline and blocksync replay routes stable
+    windows through it: block N+1 stages and verifies while N applies,
+    and storage commits land as one transaction per
+    `group_commit_heights` heights instead of one per height.  `depth`
+    bounds how many blocks the stage worker may run ahead of apply.
+    Disabled, replay keeps the coalesced/strict paths and every store
+    write commits per height exactly as before."""
+    enable: bool = True
+    depth: int = 4
+    group_commit_heights: int = 8
+
+    def validate_basic(self):
+        if self.depth <= 0:
+            raise ValueError("block_pipeline.depth must be positive")
+        if self.group_commit_heights <= 0:
+            raise ValueError(
+                "block_pipeline.group_commit_heights must be positive")
+
+
+@dataclass
 class SLOConfig:
     """Per-priority latency SLOs for the verify path (libs/slo.py,
     docs/adr/adr-016-latency-observatory.md).  When enabled the node
@@ -241,12 +265,15 @@ class Config:
     verify_scheduler: VerifySchedulerConfig = field(
         default_factory=VerifySchedulerConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    block_pipeline: BlockPipelineConfig = field(
+        default_factory=BlockPipelineConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
-                     "batch_verifier", "verify_scheduler", "slo"):
+                     "batch_verifier", "verify_scheduler", "slo",
+                     "block_pipeline"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -364,6 +391,11 @@ window_ms = {self.verify_scheduler.window_ms}
 max_batch = {self.verify_scheduler.max_batch}
 max_pending = {self.verify_scheduler.max_pending}
 
+[block_pipeline]
+enable = {str(self.block_pipeline.enable).lower()}
+depth = {self.block_pipeline.depth}
+group_commit_heights = {self.block_pipeline.group_commit_heights}
+
 [slo]
 enable = {str(self.slo.enable).lower()}
 window = {self.slo.window}
@@ -454,6 +486,11 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             window_ms=float(vs.get("window_ms", 2.0)),
             max_batch=int(vs.get("max_batch", 8192)),
             max_pending=int(vs.get("max_pending", 65536)))
+        bp = d.get("block_pipeline", {})
+        cfg.block_pipeline = BlockPipelineConfig(
+            enable=bool(bp.get("enable", True)),
+            depth=int(bp.get("depth", 4)),
+            group_commit_heights=int(bp.get("group_commit_heights", 8)))
         sl = d.get("slo", {})
         cfg.slo = SLOConfig(
             enable=bool(sl.get("enable", False)),

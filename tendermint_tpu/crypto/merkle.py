@@ -38,16 +38,34 @@ def _split_point(n: int) -> int:
     return b
 
 
+def _hash_level(level: List[bytes]) -> List[bytes]:
+    """One reduction level: adjacent pairs inner-hashed, an odd last
+    node promoted unchanged."""
+    nxt = [_sha256(_INNER_PREFIX + level[i] + level[i + 1])
+           for i in range(0, len(level) - 1, 2)]
+    if len(level) % 2:
+        nxt.append(level[-1])
+    return nxt
+
+
 def hash_from_byte_slices(items: List[bytes]) -> bytes:
-    """Root hash of a list of byte slices (reference crypto/merkle/tree.go:9)."""
+    """Root hash of a list of byte slices (reference crypto/merkle/tree.go:9).
+
+    Iterative level-by-level reduction: because the reference split
+    point is the largest power of two strictly below n, its recursive
+    tree is identical to pairwise reduction with the odd node promoted
+    (pinned against the recursive oracle in tests/test_pipeline.py).
+    One hashlib pass per level, no Python recursion — this runs on the
+    block pipeline's stage thread for part-set and results hashing
+    (ADR-017), where hashlib releases the GIL on large leaves.
+    """
     n = len(items)
     if n == 0:
         return _sha256(b"")
-    if n == 1:
-        return leaf_hash(items[0])
-    k = _split_point(n)
-    return inner_hash(hash_from_byte_slices(items[:k]),
-                      hash_from_byte_slices(items[k:]))
+    level = [_sha256(_LEAF_PREFIX + it) for it in items]
+    while len(level) > 1:
+        level = _hash_level(level)
+    return level[0]
 
 
 @dataclass
@@ -88,54 +106,34 @@ def _compute_from_aunts(index: int, total: int, leaf: bytes,
 
 
 def proofs_from_byte_slices(items: List[bytes]):
-    """(root, [Proof]) for every item (reference crypto/merkle/proof.go:52)."""
-    trails, root_node = _trails_from_byte_slices(items)
-    root = root_node.hash if root_node else _sha256(b"")
-    proofs = []
-    for i, trail in enumerate(trails):
-        proofs.append(Proof(total=len(items), index=i,
-                            leaf_hash=trail.hash,
-                            aunts=trail.flatten_aunts()))
-    return root, proofs
+    """(root, [Proof]) for every item (reference crypto/merkle/proof.go:52).
 
-
-class _Node:
-    __slots__ = ("hash", "parent", "left", "right")
-
-    def __init__(self, h):
-        self.hash = h
-        self.parent = None
-        self.left = None   # sibling hash on the left
-        self.right = None  # sibling hash on the right
-
-    def flatten_aunts(self) -> List[bytes]:
-        out = []
-        node = self
-        while node is not None:
-            if node.left is not None:
-                out.append(node.left)
-            elif node.right is not None:
-                out.append(node.right)
-            node = node.parent
-        return out
-
-
-def _trails_from_byte_slices(items):
+    Iterative sibling of hash_from_byte_slices: build every reduction
+    level once, then read each leaf's aunts straight off the levels
+    (the sibling at each level, bottom-up; a promoted odd node has no
+    aunt at that level).  Identical trees — and therefore identical
+    aunt lists — to the reference's recursive trail construction; the
+    part-set split on the pipeline stage thread is the hot caller.
+    """
     n = len(items)
     if n == 0:
-        return [], None
-    if n == 1:
-        node = _Node(leaf_hash(items[0]))
-        return [node], node
-    k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
-    root = _Node(inner_hash(left_root.hash, right_root.hash))
-    left_root.parent = root
-    left_root.right = right_root.hash
-    right_root.parent = root
-    right_root.left = left_root.hash
-    return lefts + rights, root
+        return _sha256(b""), []
+    levels = [[leaf_hash(it) for it in items]]
+    while len(levels[-1]) > 1:
+        levels.append(_hash_level(levels[-1]))
+    root = levels[-1][0]
+    proofs = []
+    for i in range(n):
+        aunts = []
+        idx = i
+        for level in levels[:-1]:
+            sib = idx ^ 1
+            if sib < len(level):
+                aunts.append(level[sib])
+            idx >>= 1
+        proofs.append(Proof(total=n, index=i, leaf_hash=levels[0][i],
+                            aunts=aunts))
+    return root, proofs
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,15 @@ LOCK_ORDER = {
     "tendermint_tpu/crypto/degrade.py:_runtime_lock": 5,
     "tendermint_tpu/crypto/scheduler.py:_global_lock": 10,
     "tendermint_tpu/crypto/lanepool.py:_install_lock": 12,
+    "tendermint_tpu/state/pipeline.py:_install_lock": 13,
+
+    # -- block application pipeline (ADR-017): _busy serializes whole
+    # windows and is taken before everything the window touches (the
+    # _cond bookkeeping, scheduler 20, kvdb 67-69); _cond itself is
+    # held only for bookkeeping — stores, scheduler and metrics are
+    # all called outside it
+    "tendermint_tpu/state/pipeline.py:BlockPipeline._busy": 14,
+    "tendermint_tpu/state/pipeline.py:BlockPipeline._cond": 16,
 
     # -- VerifyScheduler pipeline --
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._cond": 20,
@@ -75,6 +84,12 @@ LOCK_ORDER = {
     "tendermint_tpu/libs/fail.py:_lock": 62,
     "tendermint_tpu/libs/log.py:_lock": 64,
     "tendermint_tpu/libs/native.py:_lock": 66,
+    # GroupCommitDB: _commit_mutex is held across a whole group commit
+    # (membership check -> inner write_batch -> removal) and so nests
+    # the buffer lock and the wrapped DB's lock; the buffer lock (_lock)
+    # itself is never held while calling the inner DB
+    "tendermint_tpu/libs/kvdb.py:GroupCommitDB._commit_mutex": 65,
+    "tendermint_tpu/libs/kvdb.py:GroupCommitDB._lock": 67,
     "tendermint_tpu/libs/kvdb.py:MemDB._lock": 68,
     "tendermint_tpu/libs/kvdb.py:SQLiteDB._lock": 69,
     "tendermint_tpu/libs/autofile.py:Group._lock": 70,

@@ -69,6 +69,14 @@ REGISTERED_SITES = frozenset({
     # C verify — raise/latency/corrupt-bitmap all degrade to the
     # serial in-caller path with exact bitmaps
     "lanepool.verify",
+    # block application pipeline (state/pipeline.py, ADR-017): the
+    # stage worker's per-block entry, the async storage writer's
+    # group-commit entry, and the GroupCommitDB write seam.  raise at
+    # any of them drains the pipeline and degrades the window to the
+    # strict sequential path; latency exercises handoff backpressure
+    "pipeline.stage",
+    "pipeline.commit",
+    "kvdb.group_commit",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

@@ -306,6 +306,35 @@ class StateMetrics:
             buckets=[1, 4, 16, 64, 256, 1024, 4096, 16384, 65536])
 
 
+class BlockSyncMetrics:
+    """Block application pipeline (state/pipeline.py, ADR-017): is
+    catch-up running pipelined or degraded to the strict sequential
+    path, how far ahead the stage worker runs, what one group-committed
+    storage flush costs, and how much stage/apply/commit time the
+    pipeline actually overlaps."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.pipeline_depth = reg.gauge(
+            "blocksync", "pipeline_depth",
+            "Blocks staged ahead of apply in the block pipeline "
+            "(sampled each apply; bounded by [block_pipeline] depth).")
+        self.blocks_applied = reg.counter(
+            "blocksync", "blocks_applied_total",
+            "Blocks applied during fast sync, by path (pipelined = "
+            "ADR-017 pipeline, strict = reference sequential "
+            "fallback).", labels=("path",))
+        self.group_commit_seconds = reg.histogram(
+            "block", "group_commit_seconds",
+            "Wall time of one group-committed storage flush (block "
+            "store batch + state store batch), seconds.",
+            buckets=exp_buckets(0.0005, 4, 10))
+        self.apply_overlap_ratio = reg.gauge(
+            "block", "apply_overlap_ratio",
+            "1 - window wall / (stage + apply + commit lane seconds) "
+            "for the last pipelined window; 0 = fully serial.")
+
+
 class CryptoMetrics:
     """Device-lane degradation runtime (crypto/degrade.py): launches,
     failure classes, host fallbacks, breaker lifecycle and backend

@@ -72,6 +72,8 @@ KNOWN_SPANS = frozenset({
     "consensus.step", "consensus.vote",
     # ops/ — kernel routing
     "msm.route", "ops.ed25519.verify_batch", "table_build",
+    # state/pipeline.py — the block application pipeline (ADR-017)
+    "pipeline.apply", "pipeline.commit", "pipeline.stage",
     # crypto/scheduler.py — the VerifyScheduler pipeline
     "sched.coalesce", "sched.deadline_miss", "sched.host_lane",
     "sched.launch", "sched.resolve", "sched.shed", "sched.submit",

@@ -14,7 +14,7 @@ from tendermint_tpu.config.config import Config
 from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
-from tendermint_tpu.libs.kvdb import MemDB, SQLiteDB
+from tendermint_tpu.libs.kvdb import GroupCommitDB, MemDB, SQLiteDB
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
@@ -74,12 +74,12 @@ def handshake(app, state: State, state_store: StateStore,
     elif app_height < store_height:
         # replay stored blocks the app missed (replay.go:420-516); the
         # in-process apps here persist nothing, so this is the restart
-        # path.  The tail block is handled below (it may also need the
-        # STATE reconstructed), so replay the app only to store-1 here.
+        # path.  Heights the state store has not saved yet are handled
+        # below (they also need the STATE reconstructed), so replay the
+        # app only up to the state height here.
         import copy
         executor = BlockExecutor(None, app)
-        app_tail = store_height - 1 \
-            if state.last_block_height == store_height - 1 else store_height
+        app_tail = min(store_height, state.last_block_height)
         for h in range(app_height + 1, app_tail + 1):
             block = block_store.load_block(h)
             if block is None:
@@ -93,20 +93,20 @@ def handshake(app, state: State, state_store: StateStore,
             executor._exec_block_on_app(replay_state, block)
             app.commit()
 
-    # Tail-block state reconstruction (replay.go:284 decision table,
-    # storeHeight == stateHeight+1): a crash between the WAL EndHeight
-    # fsync and the state save leaves the state store one block behind
-    # the block store.  Rebuild state for the stored tip so consensus
-    # starts at tip+1 — otherwise catchupReplay correctly refuses with
-    # "WAL should not contain EndHeight" (reference replay.go:472-516).
+    # Tail state reconstruction (replay.go:284 decision table): a crash
+    # between the WAL EndHeight fsync and the state save leaves the
+    # state store one block behind the block store — and with ADR-017's
+    # group-committed storage, a crash between the block-store group
+    # commit and the state-store group commit can leave it up to one
+    # commit group behind (the block store is always flushed first, so
+    # the gap is never in the other direction).  Rebuild state height
+    # by height from the stored blocks so consensus/blocksync resume at
+    # tip+1 — otherwise catchupReplay correctly refuses with "WAL
+    # should not contain EndHeight" (reference replay.go:472-516).
     store_height = block_store.height()
-    if state.last_block_height == store_height - 1 and store_height > 0:
+    while state.last_block_height < store_height:
         state = _replay_tail_block(app, state, state_store, block_store,
-                                   store_height)
-    elif state.last_block_height < store_height - 1:
-        raise NodeError(
-            f"handshake: state height {state.last_block_height} is more "
-            f"than one block behind store height {store_height}")
+                                   state.last_block_height + 1)
     return state
 
 
@@ -135,13 +135,26 @@ def _replay_tail_block(app, state: State, state_store: StateStore,
         replay_state.last_validators = lvals
 
     executor = BlockExecutor(None, app)
-    if app_height == h:
+    if app_height >= h:
+        # the app already committed h (>: it is ahead inside a lost
+        # commit group) — re-executing would double-apply its txs, so
+        # only the saved ABCI responses can reconstruct state; refuse
+        # loudly when they were lost with the same crashed group
         responses = state_store.load_abci_responses(h)
         if responses is None:
             raise NodeError(
                 f"handshake: app committed block {h} but its ABCI "
                 f"responses were not persisted; cannot reconstruct state")
-        app_hash = getattr(info, "last_block_app_hash", b"") or b""
+        if app_height == h:
+            app_hash = getattr(info, "last_block_app_hash", b"") or b""
+        else:
+            # app is past h: its info hash belongs to app_height, but
+            # block h+1's header carries the app hash AFTER h
+            nxt = block_store.load_block_meta(h + 1)
+            if nxt is None:
+                raise NodeError(
+                    f"handshake: cannot recover app hash for block {h}")
+            app_hash = nxt.header.app_hash
     else:
         responses = executor._exec_block_on_app(replay_state, block)
         state_store.save_abci_responses(h, responses)
@@ -188,8 +201,21 @@ class Node(BaseService):
         else:
             os.makedirs(cfg.data_dir(), exist_ok=True)
             block_db = SQLiteDB(cfg.block_db_file())
-            state_db = SQLiteDB(cfg.state_db_file())
+            # the state store opts into the deferred single-op commit
+            # window (ADR-017): its hot path issues 4 sets per height,
+            # handshake can rebuild a rolled-back window from stored
+            # blocks, and block saves are write_batch (committed per
+            # call) so the state store can only ever TRAIL the block
+            # store.  Evidence/index DBs have no such backfill and
+            # keep per-call commits (the default).
+            state_db = SQLiteDB(cfg.state_db_file(), commit_every=64)
             ev_db = SQLiteDB(os.path.join(cfg.data_dir(), "evidence.db"))
+        if cfg.block_pipeline.enable:
+            # group-commit seam (ADR-017): pass-through until blocksync
+            # replay turns group mode on for a pipelined window, so the
+            # consensus path's per-height durability is untouched
+            block_db = GroupCommitDB(block_db)
+            state_db = GroupCommitDB(state_db)
         self.block_store = BlockStore(block_db)
         self.state_store = StateStore(state_db)
 
@@ -451,6 +477,20 @@ class Node(BaseService):
         edops.set_comb_config(
             enabled=self.config.batch_verifier.comb,
             table_cache_mb=self.config.batch_verifier.table_cache_mb)
+        # block application pipeline (state/pipeline.py, ADR-017): like
+        # the verify scheduler, the first node in the process installs
+        # it; config wins over a stale TM_TPU_BLOCK_PIPELINE env both
+        # ways (enable=False leaves another node's pipeline alone — the
+        # stores of THIS node are then plain DBs and replay declines)
+        self._block_pipeline = None
+        from tendermint_tpu.state import pipeline as blockpipe
+        bp = self.config.block_pipeline
+        if bp.enable and blockpipe.installed() is None:
+            self._block_pipeline = blockpipe.set_config(
+                enable=True, depth=bp.depth,
+                group_commit_heights=bp.group_commit_heights)
+            self.log.info("block pipeline started", depth=bp.depth,
+                          group_commit_heights=bp.group_commit_heights)
         # latency SLO estimator (libs/slo.py, ADR-016): window +
         # per-priority p99 targets from [slo]; config wins over a stale
         # TM_TPU_SLO env both ways
@@ -549,6 +589,12 @@ class Node(BaseService):
             self._verify_sched.stop()
             vsched.uninstall(self._verify_sched)
             self._verify_sched = None
+        if getattr(self, "_block_pipeline", None) is not None:
+            from tendermint_tpu.state import pipeline as blockpipe
+            self._block_pipeline.stop()   # drains + flushes buffers
+            if blockpipe.installed() is self._block_pipeline:
+                blockpipe.install(None)
+            self._block_pipeline = None
         self.indexer_service.stop()
         if self.grpc_server is not None:
             self.grpc_server.stop()
@@ -562,6 +608,18 @@ class Node(BaseService):
             self.priv_validator.close()
         self.switch.stop()  # stops all reactors (switch.go:234 OnStop)
         self.app_conns.stop()  # last: consensus/mempool use these
+        # make every accepted store write durable before the process
+        # may exit: SQLiteDB defers single-op commits into a bounded
+        # window (ADR-017), so a clean stop must flush what a crash is
+        # allowed to lose
+        for db in (self.block_store.db, self.state_store.db,
+                   getattr(self.evidence_pool, "db", None),
+                   getattr(self.tx_indexer, "db", None)):
+            if db is not None:
+                try:
+                    db.flush()
+                except Exception:  # noqa: BLE001 - best-effort shutdown
+                    pass
 
     # -- info for RPC -------------------------------------------------------
 

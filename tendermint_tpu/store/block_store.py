@@ -91,8 +91,13 @@ class BlockStore:
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         """Persist a certifying commit without its block — the statesync
-        bootstrap anchor (reference store/store.go:415 SaveSeenCommit)."""
-        self.db.set(_seen_commit_key(height), safe_codec.dumps(commit))
+        bootstrap anchor (reference store/store.go:415 SaveSeenCommit).
+        Routed through write_batch so it commits immediately like every
+        other block-store write instead of riding the deferred
+        single-op window (ADR-017): the anchor must be durable before
+        the statesync handoff reports success."""
+        self.db.write_batch(
+            [(_seen_commit_key(height), safe_codec.dumps(commit))])
 
     # -- load (reference store/store.go:93-246) ----------------------------
 

@@ -118,7 +118,7 @@ def wait_for_height(nodes: List[Node], height: int, timeout: float = 30.0):
 
 
 def build_chain(gdoc: GenesisDoc, privs, n_heights: int, txs_fn=None,
-                tamper_height: int = 0):
+                tamper_height: int = 0, absent_fn=None):
     """Deterministically build a committed chain of n_heights blocks by
     signing real precommits (no consensus rounds) and applying each block
     through a fresh BlockExecutor — the synthetic peer chain for blocksync
@@ -128,6 +128,11 @@ def build_chain(gdoc: GenesisDoc, privs, n_heights: int, txs_fn=None,
     Returns (blocks, commits, states): commits[i] certifies blocks[i];
     states[i] is the post-apply state after blocks[i].  tamper_height, if
     set, corrupts one signature in that height's certifying commit.
+    absent_fn(height, val_index) -> bool marks that validator's commit
+    signature ABSENT (the caller keeps >2/3 power present — a chain where
+    a commit lacks quorum cannot be built).  Validator-set changes ride
+    txs_fn: KVStoreApplication turns "val:<pubkey_b64>!<power>" txs into
+    EndBlock validator updates.
     """
     from tendermint_tpu.blocksync.replay import block_id_of
     from tendermint_tpu.types.basic import BlockID, BlockIDFlag, SignedMsgType
@@ -147,7 +152,10 @@ def build_chain(gdoc: GenesisDoc, privs, n_heights: int, txs_fn=None,
                                  block_time=Timestamp(1700000000 + h, 0))
         bid, _parts = block_id_of(block)
         sigs = []
-        for val in state.validators.validators:
+        for vi, val in enumerate(state.validators.validators):
+            if absent_fn is not None and absent_fn(h, vi):
+                sigs.append(CommitSig.absent())
+                continue
             priv = by_addr[val.address]
             ts = Timestamp(1700000000 + h, 500)
             sb = canonical_vote_bytes(gdoc.chain_id, SignedMsgType.PRECOMMIT,

@@ -23,6 +23,9 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     cfg.rpc.max_body_bytes = 65536
     cfg.batch_verifier.secp_lane = False   # non-default (rollback)
     cfg.batch_verifier.host_pool_workers = 6
+    cfg.block_pipeline.enable = False      # non-default (ADR-017)
+    cfg.block_pipeline.depth = 7
+    cfg.block_pipeline.group_commit_heights = 24
     cfg.slo.enable = True                  # non-default (ADR-016)
     cfg.slo.window = 2048
     cfg.slo.consensus_p99_ms = 5.0
@@ -41,6 +44,9 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     assert back.rpc.max_body_bytes == 65536
     assert back.batch_verifier.secp_lane is False
     assert back.batch_verifier.host_pool_workers == 6
+    assert back.block_pipeline.enable is False
+    assert back.block_pipeline.depth == 7
+    assert back.block_pipeline.group_commit_heights == 24
     assert back.slo.enable is True
     assert back.slo.window == 2048
     assert back.slo.consensus_p99_ms == 5.0
@@ -50,6 +56,10 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     # and the shipped defaults survive a round trip too
     assert Config(home=str(tmp_path)).batch_verifier.secp_lane is True
     assert Config(home=str(tmp_path)).slo.enable is False
+    assert Config(home=str(tmp_path)).block_pipeline.enable is True
+    assert Config(home=str(tmp_path)).block_pipeline.depth == 4
+    assert Config(home=str(tmp_path)).block_pipeline.group_commit_heights \
+        == 8
     back.validate_basic()
 
 
@@ -65,6 +75,9 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     (lambda c: setattr(c.rpc, "max_body_bytes", 0), "rpc"),
     (lambda c: setattr(c.batch_verifier, "host_pool_workers", -2),
      "batch_verifier"),
+    (lambda c: setattr(c.block_pipeline, "depth", 0), "block_pipeline"),
+    (lambda c: setattr(c.block_pipeline, "group_commit_heights", -1),
+     "block_pipeline"),
     (lambda c: setattr(c.slo, "window", 0), "slo"),
     (lambda c: setattr(c.slo, "consensus_p99_ms", -1.0), "slo"),
 ])
