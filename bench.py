@@ -777,12 +777,135 @@ def _blocksync_main():
           f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
 
 
+def _mempool_main():
+    """Sustained-ingress config (BENCH_MEMPOOL=1, bench_report
+    config10): a multi-threaded broadcast_tx-style flood driven
+    through the IngressGate (mempool/ingress.py, ADR-018) — bounded
+    admission queue, batched CheckTx with the app call outside the
+    mempool lock, MEMPOOL-class signature pre-verification through the
+    VerifyScheduler.  Reports admitted tx/s, p99 admission latency of
+    the admitted txs, and the shed fraction (busy/ratelimit
+    rejections) — the overload-degradation number, not just the happy
+    path.  Entirely host-capable: without an accelerator the
+    pre-verification runs on host lanes (rc=0, explicit note)."""
+    n_threads = int(os.environ.get("BENCH_MEMPOOL_THREADS", "6"))
+    n_per = int(os.environ.get("BENCH_MEMPOOL_TXS", "300"))
+    queue = int(os.environ.get("BENCH_MEMPOOL_QUEUE", "2048"))
+    batch = int(os.environ.get("BENCH_MEMPOOL_BATCH", "128"))
+    workers = int(os.environ.get("BENCH_MEMPOOL_WORKERS", "2"))
+
+    platform, probe_err = _probe_backend()
+    device = probe_err is None and platform != "cpu"
+    if probe_err is not None:
+        os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+        print(f"# mempool bench: backend probe failed, host-only: "
+              f"{probe_err}", file=sys.stderr)
+
+    r = run_mempool_ingress(n_threads=n_threads, n_per=n_per,
+                            queue=queue, batch=batch, workers=workers)
+    line = {
+        "metric": "mempool_ingress_admission_e2e",
+        "value": r["admitted_tx_per_s"],
+        "unit": "tx/s",
+        "p99_admission_ms": r["p99_admission_ms"],
+        "shed_pct": r["shed_pct"],
+        "admitted": r["admitted"],
+        "total": r["total"],
+        "queue": queue, "batch": batch, "workers": workers,
+        "threads": n_threads,
+        "trace": _trace_artifact("mempool"),
+    }
+    if not device:
+        line["note"] = "device unavailable, host fallback"
+    _emit(line)
+    print(f"# mempool bench: threads={n_threads} per={n_per} "
+          f"wall_s={r['wall_s']:.2f} admitted={r['admitted']} "
+          f"shed={r['shed']} stats={r['gate_stats']}", file=sys.stderr)
+
+
+def run_mempool_ingress(n_threads=6, n_per=300, queue=2048, batch=128,
+                        workers=2) -> dict:
+    """One sustained-ingress measurement through a private
+    Mempool + IngressGate + VerifyScheduler (shared by BENCH_MEMPOOL=1
+    and bench_report config10)."""
+    import threading
+
+    from tendermint_tpu.abci import types as abci_types
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.crypto import scheduler as vsched
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.mempool.ingress import IngressGate, make_signed_tx
+    from tendermint_tpu.mempool.mempool import Mempool
+
+    class AcceptApp(abci_types.Application):
+        def check_tx(self, req):
+            return abci_types.ResponseCheckTx(code=0, gas_wanted=1)
+
+    # pre-sign the flood outside the timed region (the bench measures
+    # admission, not signing)
+    npool = 16
+    privs = [edkeys.PrivKey((i + 1).to_bytes(32, "little"))
+             for i in range(npool)]
+    txs = [[make_signed_tx(privs[(k * n_per + i) % npool],
+                           b"bench payload %d/%06d" % (k, i))
+            for i in range(n_per)] for k in range(n_threads)]
+
+    mp = Mempool(AcceptApp(), size_limit=n_threads * n_per + 1,
+                 cache_size=2 * n_threads * n_per, registry=Registry())
+    sched = vsched.install(vsched.VerifyScheduler(window_s=0.002))
+    sched.start()
+    gate = IngressGate(mp, queue_size=queue, batch=batch,
+                       workers=workers).attach()
+    gate.start()
+    futs_all = []
+    try:
+        t0 = time.perf_counter()
+
+        def flood(k):
+            out = []
+            for tx in txs[k]:
+                out.append(gate.submit(tx, source=f"p2p:bench{k}"))
+            futs_all.append(out)
+
+        threads = [threading.Thread(target=flood, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = [f.result(timeout=600) for fs in futs_all for f in fs]
+        wall = time.perf_counter() - t0
+        gate_stats = gate.stats()
+    finally:
+        gate.stop()
+        sched.stop()
+        vsched.uninstall(sched)
+
+    admitted = [f for fs in futs_all for f in fs
+                if f.result(timeout=0).is_ok()]
+    shed = sum(1 for r in results
+               if r.codespace == "ingress" and "busy" in r.log)
+    lats = sorted(f.latency_s for f in admitted if f.latency_s is not None)
+    p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))] if lats else None
+    total = n_threads * n_per
+    return {
+        "admitted_tx_per_s": round(len(admitted) / wall, 1),
+        "p99_admission_ms": round(p99 * 1000, 2) if p99 else None,
+        "shed_pct": round(100.0 * shed / total, 1),
+        "admitted": len(admitted), "shed": shed, "total": total,
+        "wall_s": wall, "gate_stats": gate_stats,
+    }
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_MEMPOOL") == "1":
+        _mempool_main()
+        return
     if os.environ.get("BENCH_BLOCKSYNC") == "1":
         _blocksync_main()
         return

@@ -536,6 +536,24 @@ def config9_comb(n=8192):
             "group_ops": rec.get("group_ops")}
 
 
+def config10_mempool(n_threads=6, n_per=200):
+    """Mempool ingress (mempool/ingress.py, ADR-018): a multi-threaded
+    tx flood through the IngressGate's bounded queue + batched CheckTx
+    + MEMPOOL-class pre-verification.  Columns mirror the
+    BENCH_MEMPOOL=1 bench.py line: admitted tx/s, p99 admission
+    latency of the admitted txs, and the shed (busy/ratelimit)
+    fraction."""
+    from bench import run_mempool_ingress
+
+    r = run_mempool_ingress(n_threads=n_threads, n_per=n_per)
+    return {"config": f"10: mempool ingress {n_threads}x{n_per} flood",
+            "admitted_tx_per_s": r["admitted_tx_per_s"],
+            "p99_admission_ms": r["p99_admission_ms"],
+            "shed_pct": r["shed_pct"],
+            "admitted": r["admitted"],
+            "total": r["total"]}
+
+
 def main():
     import json
 
@@ -555,7 +573,7 @@ def main():
     print(f"# platform={platform} {cpu_line}", flush=True)
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
-           config8_scheduler, config9_comb)
+           config8_scheduler, config9_comb, config10_mempool)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

@@ -51,6 +51,19 @@ class MempoolConfig:
     # config/config.go:731 MaxTxsBytes, default 1GB)
     max_txs_bytes: int = 1 << 30
     keep_invalid_txs_in_cache: bool = False
+    # IngressGate admission pipeline (mempool/ingress.py, ADR-018).
+    # Disabled, every CheckTx caller runs the synchronous in-caller
+    # admission exactly as before the gate existed.
+    ingress_enable: bool = True
+    ingress_queue: int = 8192       # bounded admission queue (txs);
+    #                                 full = immediate busy rejection
+    ingress_workers: int = 1        # queue-draining worker threads
+    ingress_batch: int = 256        # max txs drained per worker wakeup
+    # per-source token bucket (rpc / p2p:<peer> / internal), admissions
+    # per second; 0 = unlimited.  Burst 0 = auto (max(1, rate)).
+    ingress_rate_per_s: float = 0.0
+    ingress_burst: int = 0
+    ingress_recheck_slice: int = 256  # post-block rechecks per wakeup
 
     def validate_basic(self):
         """Reference config/config.go:772-787 MempoolConfig.ValidateBasic."""
@@ -65,6 +78,15 @@ class MempoolConfig:
             raise ValueError("mempool.max_tx_bytes must be positive")
         if self.max_txs_bytes <= 0:
             raise ValueError("mempool.max_txs_bytes must be positive")
+        for k in ("ingress_queue", "ingress_workers", "ingress_batch",
+                  "ingress_recheck_slice"):
+            if getattr(self, k) <= 0:
+                raise ValueError(f"mempool.{k} must be positive")
+        # 0 = unlimited rate / auto burst; only negatives are nonsense
+        if self.ingress_rate_per_s < 0:
+            raise ValueError("mempool.ingress_rate_per_s must be >= 0")
+        if self.ingress_burst < 0:
+            raise ValueError("mempool.ingress_burst must be >= 0")
 
 
 @dataclass
@@ -353,6 +375,13 @@ cache_size = {self.mempool.cache_size}
 max_tx_bytes = {self.mempool.max_tx_bytes}
 max_txs_bytes = {self.mempool.max_txs_bytes}
 keep_invalid_txs_in_cache = {str(self.mempool.keep_invalid_txs_in_cache).lower()}
+ingress_enable = {str(self.mempool.ingress_enable).lower()}
+ingress_queue = {self.mempool.ingress_queue}
+ingress_workers = {self.mempool.ingress_workers}
+ingress_batch = {self.mempool.ingress_batch}
+ingress_rate_per_s = {self.mempool.ingress_rate_per_s}
+ingress_burst = {self.mempool.ingress_burst}
+ingress_recheck_slice = {self.mempool.ingress_recheck_slice}
 
 [rpc]
 laddr = "{self._q(self.rpc.laddr)}"
@@ -449,7 +478,15 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             max_tx_bytes=m.get("max_tx_bytes", 1048576),
             max_txs_bytes=int(m.get("max_txs_bytes", 1 << 30)),
             keep_invalid_txs_in_cache=bool(
-                m.get("keep_invalid_txs_in_cache", False)))
+                m.get("keep_invalid_txs_in_cache", False)),
+            ingress_enable=bool(m.get("ingress_enable", True)),
+            ingress_queue=int(m.get("ingress_queue", 8192)),
+            ingress_workers=int(m.get("ingress_workers", 1)),
+            ingress_batch=int(m.get("ingress_batch", 256)),
+            ingress_rate_per_s=float(m.get("ingress_rate_per_s", 0.0)),
+            ingress_burst=int(m.get("ingress_burst", 0)),
+            ingress_recheck_slice=int(
+                m.get("ingress_recheck_slice", 256)))
         r = d.get("rpc", {})
         cfg.rpc = RPCConfig(laddr=r.get("laddr", cfg.rpc.laddr),
                             enabled=r.get("enabled", True),

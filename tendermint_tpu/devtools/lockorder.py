@@ -56,6 +56,14 @@ LOCK_ORDER = {
     "tendermint_tpu/state/pipeline.py:BlockPipeline._busy": 14,
     "tendermint_tpu/state/pipeline.py:BlockPipeline._cond": 16,
 
+    # -- mempool ingress gate (ADR-018): _cond guards the admission +
+    # recheck queues only (bookkeeping); the mempool, scheduler (20),
+    # app and metrics are all called with it released.  _rl_lock
+    # (token buckets) and _stats_lock are leaves taken alone.
+    "tendermint_tpu/mempool/ingress.py:IngressGate._cond": 17,
+    "tendermint_tpu/mempool/ingress.py:IngressGate._rl_lock": 18,
+    "tendermint_tpu/mempool/ingress.py:IngressGate._stats_lock": 19,
+
     # -- VerifyScheduler pipeline --
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._cond": 20,
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._res_lock": 24,

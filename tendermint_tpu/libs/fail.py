@@ -77,6 +77,16 @@ REGISTERED_SITES = frozenset({
     "pipeline.stage",
     "pipeline.commit",
     "kvdb.group_commit",
+    # mempool ingress gate (mempool/ingress.py, ADR-018): the submit
+    # seam (raise = fall back to synchronous in-caller admission with
+    # identical ResponseCheckTx results; latency = queue-wait), the
+    # worker's batched CheckTx stage (raise = per-tx synchronous
+    # fallback inside the worker), and the post-block recheck
+    # scheduling seam (raise = recheck runs synchronously in update()
+    # on the commit path, exactly the pre-gate behavior)
+    "ingress.admit",
+    "ingress.checktx",
+    "ingress.recheck",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

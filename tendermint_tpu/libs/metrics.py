@@ -515,7 +515,12 @@ class P2PMetrics:
 
 
 class MempoolMetrics:
-    """Reference mempool/metrics.go."""
+    """Reference mempool/metrics.go, plus the IngressGate admission
+    pipeline (mempool/ingress.py, ADR-018): why txs are being turned
+    away, how deep the bounded admission queue is running, and what
+    admission costs end to end — the operator's view of whether a tx
+    flood is degrading gracefully (busy/ratelimit rejections) or the
+    pool is merely full."""
 
     def __init__(self, reg: Optional[Registry] = None):
         reg = reg or DEFAULT
@@ -528,3 +533,23 @@ class MempoolMetrics:
                                       "Rejected CheckTx.")
         self.recheck_times = reg.counter("mempool", "recheck_times",
                                          "Tx recheck invocations.")
+        self.rejected_txs = reg.counter(
+            "mempool", "rejected_txs_total",
+            "Txs rejected at admission, by reason: full (pool at "
+            "size/byte limit), busy (ingress queue full or MEMPOOL-"
+            "class verify shed — retryable overload), cache (dedup "
+            "cache hit), ratelimit (per-source token bucket), sig "
+            "(batched pre-verification refuted the signature), "
+            "app_err (the app rejected or raised), toolarge "
+            "(max_tx_bytes).", labels=("reason",))
+        self.ingress_queue_depth = reg.gauge(
+            "mempool", "ingress_queue_depth",
+            "Txs waiting in the IngressGate admission queue (bounded "
+            "by [mempool] ingress_queue; at the bound new submissions "
+            "are rejected busy).")
+        self.admission_latency = reg.histogram(
+            "mempool", "admission_latency_seconds",
+            "End-to-end admission latency of gate-processed txs, "
+            "submit to settled ResponseCheckTx (queue wait + batched "
+            "pre-verify + app CheckTx + insert).",
+            buckets=exp_buckets(0.0002, 4, 10))

@@ -67,6 +67,9 @@ KNOWN_SPANS = frozenset({
     "device.launch",
     # crypto/lanepool.py — sharded native C host verify (ADR-015)
     "lanepool.verify",
+    # mempool/ingress.py — overload-safe admission (ADR-018)
+    "ingress.admit", "ingress.batch", "ingress.checktx",
+    "ingress.recheck",
     # consensus/state.py
     "consensus.finalize_commit", "consensus.preverify",
     "consensus.step", "consensus.vote",

@@ -3,6 +3,18 @@
 FIFO mempool with CheckTx admission through the app, LRU dedup cache,
 reap-by-bytes/gas for proposals, and post-block update + recheck
 (reference mempool/v0/clist_mempool.go:201,519,577).
+
+Admission is STAGED (ADR-018): ``check_tx`` composes three pieces —
+``precheck`` (size cap, dedup cache, full pre-check), ``app_check``
+(the ABCI CheckTx call, made with NO mempool lock held), and
+``finish_check`` (limits re-validated under the lock, which now
+brackets only map mutation).  The synchronous path and the IngressGate
+worker (mempool/ingress.py) call the SAME stages, so their
+ResponseCheckTx results are identical by construction.  The reference
+ran the app call while holding the mempool lock (clist_mempool.go:201
+callers hold updateMtx), which under a tx flood serialized every RPC
+handler, every p2p receive, and the committing consensus thread on one
+lock around a blocking app round trip.
 """
 from __future__ import annotations
 
@@ -16,12 +28,11 @@ from tendermint_tpu.types.block import tx_hash
 
 DEFAULT_CACHE_SIZE = 10000
 
-
-@dataclass
-class MempoolTx:
-    tx: bytes
-    height: int      # height when validated
-    gas_wanted: int
+# CheckTx rejection code for an app that RAISED instead of answering
+# (distinct from the app's own rejection codes so callers can tell "the
+# app said no" from "the app fell over"; the tx is dropped from the
+# dedup cache either way so a retry reaches the app again)
+CODE_APP_EXCEPTION = 2
 
 
 class TxCache:
@@ -53,6 +64,13 @@ class TxCache:
             self._map.clear()
 
 
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int      # height when validated
+    gas_wanted: int
+
+
 class Mempool:
     def __init__(self, app: abci.Application, max_tx_bytes: int = 1048576,
                  size_limit: int = 5000, keep_invalid_txs_in_cache=False,
@@ -71,8 +89,23 @@ class Mempool:
         self._total_bytes = 0
         self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()
         self._lock = threading.RLock()
+        # serializes ABCI CheckTx calls only (the reference's local
+        # ABCI client holds a global mutex, local_client.go — an
+        # in-process Application shared across connections is not
+        # assumed thread-safe).  Distinct from _lock: an in-flight app
+        # call must never block mempool reads, inserts, or the commit
+        # path.  Ordering: _lock may be held when taking _app_lock
+        # (the sync _recheck); never the reverse.
+        self._app_lock = threading.Lock()
         self._height = 0
         self._notify: List[Callable[[], None]] = []
+        # post-block recheck offload (ADR-018): when the IngressGate is
+        # attached it sets this hook and update() hands the recheck to
+        # the gate's worker (bounded slices per wakeup) instead of
+        # walking every resident tx on the consensus commit path.  A
+        # hook that raises or returns False falls back to the
+        # synchronous in-caller recheck, identical to today.
+        self.recheck_offload: Optional[Callable[[int], bool]] = None
 
     def size(self) -> int:
         with self._lock:
@@ -81,49 +114,112 @@ class Mempool:
     def is_empty(self) -> bool:
         return self.size() == 0
 
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes
+
     def on_new_tx(self, fn: Callable[[], None]):
         """Register a callback fired when a tx is admitted (reactor
         broadcast hook)."""
         self._notify.append(fn)
 
     # -- CheckTx admission (reference clist_mempool.go:201) ----------------
+    #
+    # Three stages so the IngressGate worker can run the app call in a
+    # drained batch with the exact same per-tx results as this
+    # synchronous composition.
 
-    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+    def precheck(self, tx: bytes) -> Optional[abci.ResponseCheckTx]:
+        """Static admission gates BEFORE the app call: size cap, dedup
+        cache, full pre-check.  Returns the rejection, or None to
+        proceed — in which case the tx hash HAS been claimed in the
+        cache (the sync path's claim-first discipline; a later
+        rejection must release it)."""
         if len(tx) > self.max_tx_bytes:
+            self.metrics.rejected_txs.inc(reason="toolarge")
             return abci.ResponseCheckTx(code=1, log="tx too large")
         if not self.cache.push(tx):
+            self.metrics.rejected_txs.inc(reason="cache")
             return abci.ResponseCheckTx(code=1, log="tx already in cache")
-        admitted = False
         with self._lock:
-            if len(self._txs) >= self.size_limit or \
-                    self._total_bytes + len(tx) > self.max_txs_bytes:
-                self.cache.remove(tx)
-                self.log.debug("mempool full, rejecting tx",
-                               size=len(self._txs),
-                               bytes=self._total_bytes)
-                return abci.ResponseCheckTx(code=1, log="mempool is full")
-            res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
-            if res.is_ok():
-                key = tx_hash(tx)
-                if key not in self._txs:
+            full = (len(self._txs) >= self.size_limit or
+                    self._total_bytes + len(tx) > self.max_txs_bytes)
+            size, nbytes = len(self._txs), self._total_bytes
+        if full:
+            self.cache.remove(tx)
+            self.log.debug("mempool full, rejecting tx",
+                           size=size, bytes=nbytes)
+            self.metrics.rejected_txs.inc(reason="full")
+            return abci.ResponseCheckTx(code=1, log="mempool is full")
+        return None
+
+    def app_check(self, tx: bytes) -> abci.ResponseCheckTx:
+        """The ABCI CheckTx round trip, made with NO mempool lock held.
+        An app exception used to propagate out of check_tx AFTER the
+        cache claim, poisoning the dedup cache: every retry of that tx
+        was rejected as "already in cache" forever.  Now it maps to a
+        coded error and the cache entry is dropped so a retry reaches
+        the app again."""
+        try:
+            with self._app_lock:
+                return self.app.check_tx(abci.RequestCheckTx(tx=tx))
+        except Exception as e:  # noqa: BLE001 - app fault must not poison
+            self.cache.remove(tx)
+            self.metrics.rejected_txs.inc(reason="app_err")
+            return abci.ResponseCheckTx(
+                code=CODE_APP_EXCEPTION, codespace="mempool",
+                log=f"check_tx failed: {type(e).__name__}: {e}")
+
+    def finish_check(self, tx: bytes,
+                     res: abci.ResponseCheckTx) -> abci.ResponseCheckTx:
+        """Post-CheckTx bookkeeping: insert (limits RE-validated under
+        the lock — precheck's answer may have gone stale while the app
+        ran unlocked) or release the cache claim on rejection.  Notify
+        + metrics run OUTSIDE the lock: listeners (consensus
+        notify_txs_available) take the consensus mutex, and the
+        consensus thread takes the mempool lock during commit — calling
+        out while holding _lock would be an ABBA deadlock."""
+        admitted = False
+        became_full = False
+        if res.is_ok():
+            key = tx_hash(tx)
+            with self._lock:
+                if key in self._txs:
+                    admitted = True  # concurrent duplicate: same as held
+                elif (len(self._txs) >= self.size_limit or
+                        self._total_bytes + len(tx) > self.max_txs_bytes):
+                    became_full = True
+                else:
                     self._txs[key] = MempoolTx(tx, self._height,
                                                res.gas_wanted)
                     self._total_bytes += len(tx)
-                admitted = True
-            elif not self.keep_invalid_txs_in_cache:
-                self.cache.remove(tx)
-        # Notify OUTSIDE the mempool lock: listeners (consensus
-        # notify_txs_available) take the consensus mutex, and the consensus
-        # thread takes the mempool lock during commit — calling out while
-        # holding _lock would be an ABBA deadlock.
+                    admitted = True
+        if became_full:
+            self.cache.remove(tx)
+            self.metrics.rejected_txs.inc(reason="full")
+            return abci.ResponseCheckTx(code=1, log="mempool is full")
         if admitted:
             self.metrics.size.set(self.size())
             self.metrics.tx_size_bytes.observe(len(tx))
             for fn in self._notify:
                 fn()
-        elif not res.is_ok():
+        else:
+            # app_check counted + released on a real exception (its
+            # coded response carries codespace "mempool"); an app
+            # legitimately returning code 2 is a normal rejection
+            if not (res.code == CODE_APP_EXCEPTION
+                    and res.codespace == "mempool"):
+                self.metrics.rejected_txs.inc(reason="app_err")
+            if not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
             self.metrics.failed_txs.inc()
         return res
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        rej = self.precheck(tx)
+        if rej is not None:
+            return rej
+        return self.finish_check(tx, self.app_check(tx))
 
     # -- reap (reference clist_mempool.go:519) -----------------------------
 
@@ -161,21 +257,35 @@ class Mempool:
         self._lock.release()
 
     def update(self, height: int, committed_txs: List[bytes]):
-        """Caller must hold lock() (BlockExecutor._commit does)."""
+        """Caller must hold lock() (BlockExecutor._commit does).
+
+        With the IngressGate attached the post-block recheck is
+        scheduled onto the gate's worker instead of walking every
+        resident tx here, so this returns in O(committed txs) and the
+        consensus commit path is never held hostage by a slow app."""
         self._height = height
         for tx in committed_txs:
             self.cache.push(tx)  # committed: never re-admit
             mt = self._txs.pop(tx_hash(tx), None)
             if mt is not None:
                 self._total_bytes -= len(mt.tx)
+        hook = self.recheck_offload
+        if hook is not None:
+            try:
+                if hook(height):
+                    self.metrics.size.set(len(self._txs))
+                    return
+            except Exception:  # noqa: BLE001 - degrade to sync recheck
+                pass
         self._recheck()
 
     def _recheck(self):
         dead = []
         for key, mt in self._txs.items():
             self.metrics.recheck_times.inc()
-            res = self.app.check_tx(abci.RequestCheckTx(
-                tx=mt.tx, type=abci.CheckTxType.RECHECK))
+            with self._app_lock:
+                res = self.app.check_tx(abci.RequestCheckTx(
+                    tx=mt.tx, type=abci.CheckTxType.RECHECK))
             if not res.is_ok():
                 dead.append(key)
         for key in dead:
@@ -184,6 +294,41 @@ class Mempool:
             if not self.keep_invalid_txs_in_cache:
                 self.cache.remove(mt.tx)
         self.metrics.size.set(len(self._txs))
+
+    # -- async recheck slices (IngressGate worker, ADR-018) ----------------
+
+    def recheck_keys(self) -> List[bytes]:
+        """Snapshot of resident tx keys for an offloaded recheck."""
+        with self._lock:
+            return list(self._txs.keys())
+
+    def recheck_one(self, key: bytes):
+        """Recheck one resident tx: app call OUTSIDE the lock, removal
+        (if it went invalid) re-validated under it.  A tx that was
+        reaped/committed between snapshot and slice is skipped; an app
+        exception keeps the tx (the next block's recheck retries)."""
+        with self._lock:
+            mt = self._txs.get(key)
+        if mt is None:
+            return
+        self.metrics.recheck_times.inc()
+        try:
+            with self._app_lock:
+                res = self.app.check_tx(abci.RequestCheckTx(
+                    tx=mt.tx, type=abci.CheckTxType.RECHECK))
+        except Exception:  # noqa: BLE001 - keep the tx, retry next block
+            return
+        if res.is_ok():
+            return
+        with self._lock:
+            cur = self._txs.get(key)
+            if cur is not mt:
+                return
+            del self._txs[key]
+            self._total_bytes -= len(cur.tx)
+        if not self.keep_invalid_txs_in_cache:
+            self.cache.remove(mt.tx)
+        self.metrics.size.set(self.size())
 
     def flush(self):
         with self._lock:

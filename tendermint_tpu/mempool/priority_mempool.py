@@ -20,7 +20,7 @@ from typing import Callable, Dict, List, Optional
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.types.block import tx_hash
 
-from .mempool import TxCache
+from .mempool import CODE_APP_EXCEPTION, TxCache
 
 
 class _WrappedTx:
@@ -57,8 +57,12 @@ class PriorityMempool:
         self._bytes = 0
         self._order = itertools.count()
         self._lock = threading.RLock()
+        # serializes ABCI CheckTx only (see mempool.Mempool._app_lock)
+        self._app_lock = threading.Lock()
         self._height = 0
         self._notify: List[Callable[[], None]] = []
+        # post-block recheck offload (ADR-018; see mempool.Mempool)
+        self.recheck_offload: Optional[Callable[[int], bool]] = None
 
     # -- views -------------------------------------------------------------
 
@@ -78,22 +82,57 @@ class PriorityMempool:
 
     # -- admission (reference mempool/v1/mempool.go:441-545) ---------------
 
-    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
-        def reject(res):
-            self.metrics.failed_txs.inc()
-            return res
-
+    def precheck(self, tx: bytes) -> Optional[abci.ResponseCheckTx]:
+        """Static gates before the app call (staged admission, ADR-018;
+        see mempool.Mempool.precheck).  None = proceed, cache claimed.
+        The v1 pool has no fixed full pre-check: fullness is decided at
+        insert time by the priority eviction policy (_make_room)."""
         if len(tx) > self.max_tx_bytes:
-            return reject(abci.ResponseCheckTx(code=1, log="tx too large"))
+            self.metrics.rejected_txs.inc(reason="toolarge")
+            self.metrics.failed_txs.inc()
+            return abci.ResponseCheckTx(code=1, log="tx too large")
         if not self.cache.push(tx):
             # routine gossip duplicate — not a failure (v0 parity)
+            self.metrics.rejected_txs.inc(reason="cache")
             return abci.ResponseCheckTx(code=1, log="tx already in cache")
+        return None
+
+    def app_check(self, tx: bytes) -> abci.ResponseCheckTx:
+        """The ABCI CheckTx round trip, with NO mempool lock held (the
+        reference ran it under updateMtx).  An app exception drops the
+        cache claim instead of poisoning it (see Mempool.app_check)."""
+        try:
+            with self._app_lock:
+                return self.app.check_tx(abci.RequestCheckTx(tx=tx))
+        except Exception as e:  # noqa: BLE001 - app fault must not poison
+            self.cache.remove(tx)
+            self.metrics.rejected_txs.inc(reason="app_err")
+            return abci.ResponseCheckTx(
+                code=CODE_APP_EXCEPTION, codespace="mempool",
+                log=f"check_tx failed: {type(e).__name__}: {e}")
+
+    def finish_check(self, tx: bytes,
+                     res: abci.ResponseCheckTx) -> abci.ResponseCheckTx:
+        """Insert under the lock (sender exclusivity + priority
+        eviction re-decided there), notify/metrics outside it."""
+        def reject(r):
+            self.metrics.failed_txs.inc()
+            return r
+
+        if not res.is_ok():
+            # app_check already released the cache claim on a real
+            # exception (its coded response carries codespace
+            # "mempool"); an app legitimately RETURNING code 2 must
+            # still get the normal-rejection release, or a retry is
+            # poisoned with "already in cache" forever
+            app_raised = (res.code == CODE_APP_EXCEPTION
+                          and res.codespace == "mempool")
+            if not app_raised:
+                self.metrics.rejected_txs.inc(reason="app_err")
+            if not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)  # idempotent after app_check
+            return reject(res)
         with self._lock:
-            res = self.app.check_tx(abci.RequestCheckTx(tx=tx))
-            if not res.is_ok():
-                if not self.keep_invalid_txs_in_cache:
-                    self.cache.remove(tx)
-                return reject(res)
             key = tx_hash(tx)
             if key in self._txs:
                 return res
@@ -101,10 +140,12 @@ class PriorityMempool:
             # per declared sender
             if res.sender and res.sender in self._by_sender:
                 self.cache.remove(tx)
+                self.metrics.rejected_txs.inc(reason="app_err")
                 return reject(abci.ResponseCheckTx(
                     code=1, log=f"sender {res.sender} has tx in mempool"))
             if not self._make_room(len(tx), res.priority):
                 self.cache.remove(tx)
+                self.metrics.rejected_txs.inc(reason="full")
                 return reject(abci.ResponseCheckTx(
                     code=1, log="mempool is full and tx priority too low"))
             wtx = _WrappedTx(tx, key, self._height, res.gas_wanted,
@@ -118,6 +159,12 @@ class PriorityMempool:
         for fn in self._notify:
             fn()
         return res
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        rej = self.precheck(tx)
+        if rej is not None:
+            return rej
+        return self.finish_check(tx, self.app_check(tx))
 
     def _make_room(self, need_bytes: int, priority: int) -> bool:
         """Evict strictly-lower-priority txs until the pool has room, or
@@ -196,19 +243,30 @@ class PriorityMempool:
             self.cache.remove(w.tx)
 
     def update(self, height: int, committed_txs: List[bytes]):
-        """Caller must hold lock() (BlockExecutor commit path)."""
+        """Caller must hold lock() (BlockExecutor commit path).  With
+        the IngressGate attached the recheck is offloaded to the gate
+        worker (bounded slices) so this returns in O(committed txs)."""
         self._height = height
         for tx in committed_txs:
             self.cache.push(tx)  # committed: never re-admit
             self._remove(tx_hash(tx), remove_from_cache=False)
+        hook = self.recheck_offload
+        if hook is not None:
+            try:
+                if hook(height):
+                    self.metrics.size.set(len(self._txs))
+                    return
+            except Exception:  # noqa: BLE001 - degrade to sync recheck
+                pass
         self._recheck()
 
     def _recheck(self):
         dead = []
         for key, w in self._txs.items():
             self.metrics.recheck_times.inc()
-            res = self.app.check_tx(abci.RequestCheckTx(
-                tx=w.tx, type=abci.CheckTxType.RECHECK))
+            with self._app_lock:
+                res = self.app.check_tx(abci.RequestCheckTx(
+                    tx=w.tx, type=abci.CheckTxType.RECHECK))
             if not res.is_ok():
                 dead.append(key)
             else:
@@ -217,6 +275,39 @@ class PriorityMempool:
             self._remove(key, remove_from_cache=not
                          self.keep_invalid_txs_in_cache)
         self.metrics.size.set(len(self._txs))
+
+    # -- async recheck slices (IngressGate worker, ADR-018) ----------------
+
+    def recheck_keys(self) -> List[bytes]:
+        """Snapshot of resident tx keys for an offloaded recheck."""
+        with self._lock:
+            return list(self._txs.keys())
+
+    def recheck_one(self, key: bytes):
+        """Recheck one resident tx off the commit path: app call with
+        no lock held, removal (or re-prioritization, reference :713)
+        re-validated under it."""
+        with self._lock:
+            w = self._txs.get(key)
+        if w is None:
+            return
+        self.metrics.recheck_times.inc()
+        try:
+            with self._app_lock:
+                res = self.app.check_tx(abci.RequestCheckTx(
+                    tx=w.tx, type=abci.CheckTxType.RECHECK))
+        except Exception:  # noqa: BLE001 - keep the tx, retry next block
+            return
+        with self._lock:
+            cur = self._txs.get(key)
+            if cur is not w:
+                return
+            if res.is_ok():
+                cur.priority = res.priority
+                return
+            self._remove(key, remove_from_cache=not
+                         self.keep_invalid_txs_in_cache)
+        self.metrics.size.set(self.size())
 
     def flush(self):
         with self._lock:

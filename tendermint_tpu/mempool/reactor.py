@@ -42,13 +42,25 @@ wire.register_codec(MEMPOOL_CHANNEL, encode_msg, decode_msg)
 
 
 class MempoolReactor(Reactor):
-    """BaseService lifecycle via Reactor (reference mempool/reactor.go)."""
+    """BaseService lifecycle via Reactor (reference mempool/reactor.go).
 
-    def __init__(self, mempool: Mempool):
+    With an IngressGate attached (ADR-018), received gossip txs route
+    through the gate's bounded admission queue under a per-peer source
+    id, and a saturated queue THROTTLES the channel: receive() parks
+    for a bounded beat, which blocks this peer's recv loop and lets
+    TCP backpressure propagate instead of buffering a flood in RAM."""
+
+    # how long one receive() parks when the admission queue is full —
+    # long enough to drain a batch, short enough to keep the peer's
+    # other channels responsive
+    THROTTLE_S = 0.05
+
+    def __init__(self, mempool: Mempool, gate=None):
         super().__init__("MEMPOOL")
         from tendermint_tpu.libs import log as tmlog
         self.log = tmlog.logger("mempool")
         self.mempool = mempool
+        self.gate = gate
         self._peer_sent: Dict[str, set] = {}  # peer -> sent tx hashes
         self._lock = threading.Lock()
 
@@ -74,9 +86,21 @@ class MempoolReactor(Reactor):
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes):
         msg = decode_msg(msg_bytes)
-        if isinstance(msg, TxsMessage):
+        if not isinstance(msg, TxsMessage):
+            return
+        gate = self.gate
+        if gate is None or not gate.is_running():
             for tx in msg.txs:
                 self.mempool.check_tx(bytes(tx))
+            return
+        source = f"p2p:{peer.id}"
+        for tx in msg.txs:
+            gate.submit(bytes(tx), source=source)
+        if gate.saturated():
+            # backpressure: stop reading the mempool channel for a
+            # beat — gossip redelivers, and the dedup cache absorbs
+            # the replays once the queue drains
+            time.sleep(self.THROTTLE_S)
 
     def _broadcast_routine(self):
         """Per-peer broadcast of not-yet-sent txs (the clist walk in the

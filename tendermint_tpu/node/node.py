@@ -286,6 +286,20 @@ class Node(BaseService):
                                    keep_invalid_txs_in_cache=cfg.mempool
                                    .keep_invalid_txs_in_cache,
                                    cache_size=cfg.mempool.cache_size)
+        # -- ingress gate (mempool/ingress.py, ADR-018) ----------------
+        # config wins over a stale TM_TPU_INGRESS env in BOTH
+        # directions; disabled, every CheckTx caller keeps the
+        # synchronous in-caller admission byte-identically
+        from tendermint_tpu.mempool import ingress as _ingress
+        _ingress.set_enabled(cfg.mempool.ingress_enable)
+        self.ingress_gate = None
+        if _ingress.enabled():
+            mc = cfg.mempool
+            self.ingress_gate = _ingress.IngressGate(
+                self.mempool, queue_size=mc.ingress_queue,
+                batch=mc.ingress_batch, workers=mc.ingress_workers,
+                rate_per_s=mc.ingress_rate_per_s, burst=mc.ingress_burst,
+                recheck_slice=mc.ingress_recheck_slice)
         self.evidence_pool = EvidencePool(ev_db, self.state_store,
                                           self.block_store)
 
@@ -307,7 +321,8 @@ class Node(BaseService):
                              network=self.genesis.chain_id,
                              moniker=cfg.moniker, p2p_config=cfg.p2p)
         self.consensus_reactor = ConsensusReactor(self.consensus)
-        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor = MempoolReactor(self.mempool,
+                                              gate=self.ingress_gate)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         # fastSync := config.FastSyncMode && !onlyValidatorIsUs, and held
         # back entirely while statesync restores — the reactor is built
@@ -498,6 +513,15 @@ class Node(BaseService):
         slo.set_config(enabled=self.config.slo.enable,
                        window=self.config.slo.window,
                        targets=self.config.slo.targets_s())
+        # mempool ingress gate (ADR-018): start AFTER the verify
+        # scheduler so the worker's MEMPOOL-class pre-verification can
+        # route through it from the first batch
+        if self.ingress_gate is not None:
+            self.ingress_gate.attach().start()
+            self.log.info("mempool ingress gate started",
+                          queue=self.ingress_gate.queue_size,
+                          workers=self.ingress_gate.workers,
+                          batch=self.ingress_gate.batch)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,
@@ -602,6 +626,10 @@ class Node(BaseService):
             self.pprof_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if getattr(self, "ingress_gate", None) is not None:
+            # before consensus/app stop: pending admissions settle (as
+            # busy) instead of racing a dying app connection
+            self.ingress_gate.stop()
         if self._consensus_started.is_set():
             self.consensus.stop()
         if hasattr(self.priv_validator, "close"):

@@ -23,6 +23,13 @@ class RPCError(Exception):
         self.code = code
 
 
+# 429-style overload rejection (ADR-018): the IngressGate's bounded
+# admission queue is full or the caller is rate limited — the message
+# carries a Retry-After hint in seconds.  Distinct from -32603 internal
+# errors so load balancers / clients can back off instead of failing.
+RPC_BUSY_CODE = -32011
+
+
 def _b64(b: bytes) -> str:
     return base64.b64encode(b).decode()
 
@@ -644,18 +651,58 @@ class RPCServer(BaseService):
         r = self.node.app.check_tx(RequestCheckTx(tx=_parse_tx(tx)))
         return {"code": r.code, "data": _b64(r.data or b""), "log": r.log}
 
+    def _gate(self):
+        """The node's IngressGate, iff running (ADR-018)."""
+        g = getattr(self.node, "ingress_gate", None)
+        return g if g is not None and g.is_running() else None
+
+    @staticmethod
+    def _busy_error(retry_after_s) -> RPCError:
+        ms = int(max(0.0, retry_after_s or 1.0) * 1000)
+        return RPCError(RPC_BUSY_CODE,
+                        f"mempool is busy: retry after {ms} ms")
+
+    def _admit_tx(self, raw: bytes):
+        """Admission through the IngressGate when present: overload
+        (queue full / rate limited / verify shed) surfaces as a
+        429-style RPCError with a Retry-After hint instead of holding
+        the HTTP thread on a blocking app call.  Without a gate this
+        is exactly the old synchronous mempool.check_tx."""
+        g = self._gate()
+        if g is None:
+            return self.node.mempool.check_tx(raw)
+        fut = g.submit(raw, source="rpc")
+        try:
+            r = fut.result(timeout=10.0)
+        except TimeoutError:
+            # queue is moving but not fast enough for this caller:
+            # same retryable overload class as a full queue
+            raise self._busy_error(g.retry_after_s())
+        if fut.retry_after_s is not None:
+            raise self._busy_error(fut.retry_after_s)
+        return r
+
     def broadcast_tx_async(self, tx=None):
         raw = _parse_tx(tx)
-        threading.Thread(target=self._add_tx, args=(raw,),
-                         daemon=True).start()
         from tendermint_tpu.types.block import tx_hash
+        g = self._gate()
+        if g is None:
+            threading.Thread(target=self._add_tx, args=(raw,),
+                             daemon=True).start()
+        else:
+            fut = g.submit(raw, source="rpc")
+            # fire-and-forget EXCEPT overload: an immediately-settled
+            # busy/ratelimit rejection means the tx was never queued —
+            # silently returning a hash would lie to the client
+            if fut.done() and fut.retry_after_s is not None:
+                raise self._busy_error(fut.retry_after_s)
         return {"code": 0, "data": "", "log": "",
                 "hash": tx_hash(raw).hex().upper()}
 
     def broadcast_tx_sync(self, tx=None):
         raw = _parse_tx(tx)
         from tendermint_tpu.types.block import tx_hash
-        r = self.node.mempool.check_tx(raw)
+        r = self._admit_tx(raw)
         return {"code": r.code, "data": _b64(r.data or b""), "log": r.log,
                 "hash": tx_hash(raw).hex().upper()}
 
@@ -669,7 +716,7 @@ class RPCServer(BaseService):
         sub = self.node.event_bus.subscribe("Tx") \
             if self.node.event_bus else None
         try:
-            r = self.node.mempool.check_tx(raw)
+            r = self._admit_tx(raw)
             if not r.is_ok():
                 return r, None, 0
             import queue as _q

@@ -30,6 +30,13 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     cfg.slo.window = 2048
     cfg.slo.consensus_p99_ms = 5.0
     cfg.slo.mempool_p99_ms = 250.0
+    cfg.mempool.ingress_enable = False     # non-default (ADR-018)
+    cfg.mempool.ingress_queue = 321
+    cfg.mempool.ingress_workers = 3
+    cfg.mempool.ingress_batch = 17
+    cfg.mempool.ingress_rate_per_s = 125.5
+    cfg.mempool.ingress_burst = 9
+    cfg.mempool.ingress_recheck_slice = 33
     cfg.save()
     back = Config.load(str(tmp_path))
     assert back.consensus.timeout_commit == 2.5
@@ -47,6 +54,15 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     assert back.block_pipeline.enable is False
     assert back.block_pipeline.depth == 7
     assert back.block_pipeline.group_commit_heights == 24
+    assert back.mempool.ingress_enable is False
+    assert back.mempool.ingress_queue == 321
+    assert back.mempool.ingress_workers == 3
+    assert back.mempool.ingress_batch == 17
+    assert back.mempool.ingress_rate_per_s == 125.5
+    assert back.mempool.ingress_burst == 9
+    assert back.mempool.ingress_recheck_slice == 33
+    assert Config(home=str(tmp_path)).mempool.ingress_enable is True
+    assert Config(home=str(tmp_path)).mempool.ingress_queue == 8192
     assert back.slo.enable is True
     assert back.slo.window == 2048
     assert back.slo.consensus_p99_ms == 5.0
@@ -70,6 +86,12 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     (lambda c: setattr(c.mempool, "size", 0), "mempool"),
     (lambda c: setattr(c.mempool, "max_txs_bytes", -5), "mempool"),
     (lambda c: setattr(c.mempool, "version", "v9"), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_queue", 0), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_workers", -1), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_batch", 0), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_rate_per_s", -0.5), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_burst", -1), "mempool"),
+    (lambda c: setattr(c.mempool, "ingress_recheck_slice", 0), "mempool"),
     (lambda c: setattr(c.p2p, "send_rate", 0), "p2p"),
     (lambda c: setattr(c.p2p, "max_num_peers", -1), "p2p"),
     (lambda c: setattr(c.rpc, "max_body_bytes", 0), "rpc"),
