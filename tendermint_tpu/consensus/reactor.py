@@ -269,6 +269,17 @@ class ConsensusReactor(Reactor):
 
     DATA_RESEND_S = 0.5  # per-peer proposal/part-set resend throttle
 
+    # periodic NewRoundStep re-announcement.  Step broadcasts are
+    # event-driven; a partition that swallows them leaves every peer's
+    # view of us stale FOREVER once we park in a step with no timeout
+    # armed (PREVOTE short of 2/3-any).  The peers then route our
+    # gossip through the stale view — store-backed catch-up for a
+    # height we are past — and the network wedges even though the
+    # votes we need exist one hop away (found by the NetHarness
+    # no-quorum partition scenario, ADR-019).  A 1 Hz re-announce
+    # heals any stale view within a beat of the partition healing.
+    STEP_ANNOUNCE_S = 1.0
+
     # -- store-backed catch-up for peers behind our height -----------------
 
     CATCHUP_HEIGHTS_PER_TICK = 8
@@ -329,10 +340,19 @@ class ConsensusReactor(Reactor):
     def _catchup_routine(self):
         rng = random.Random()
         last_maj23 = 0.0
+        last_step_announce = 0.0
         while not self.quitting.is_set():
             time.sleep(0.1)
             if self.switch is None:
                 continue
+            if time.monotonic() - last_step_announce \
+                    >= self.STEP_ANNOUNCE_S:
+                last_step_announce = time.monotonic()
+                try:
+                    self.switch.broadcast(STATE_CHANNEL,
+                                          self._round_step_msg())
+                except Exception:  # noqa: BLE001 - keep routine alive
+                    pass
             with self._lock:
                 peer_states = dict(self._peer_state)
             if not peer_states:

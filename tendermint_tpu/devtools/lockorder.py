@@ -64,6 +64,16 @@ LOCK_ORDER = {
     "tendermint_tpu/mempool/ingress.py:IngressGate._rl_lock": 18,
     "tendermint_tpu/mempool/ingress.py:IngressGate._stats_lock": 19,
 
+    # -- network harness (networks/, ADR-019): the harness lock (11)
+    # wraps scenario bookkeeping and may drive vnet fault APIs; the
+    # vnet engine condition (15) guards heap/policies/pending and is
+    # released before inbox pushes and reactor dispatch; each endpoint
+    # inbox condition (22) is taken alone (a dispatcher holding 22 must
+    # never acquire 15 — it reads the running flag lock-free instead)
+    "tendermint_tpu/networks/harness.py:NetHarness._lock": 11,
+    "tendermint_tpu/networks/vnet.py:VirtualNetwork._cond": 15,
+    "tendermint_tpu/networks/vnet.py:_Endpoint._cond": 22,
+
     # -- VerifyScheduler pipeline --
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._cond": 20,
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._res_lock": 24,

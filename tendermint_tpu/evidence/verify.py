@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from fractions import Fraction
 
-from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.crypto import scheduler as vsched
 from tendermint_tpu.types.evidence import (DuplicateVoteEvidence,
                                            EvidenceError,
                                            LightClientAttackEvidence)
@@ -46,10 +46,15 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
         raise EvidenceError(
             f"total voting power from evidence and our set mismatch "
             f"({ev.total_voting_power} != {val_set.total_voting_power()})")
-    bv = BatchVerifier()
-    bv.add(val.pub_key, a.sign_bytes(chain_id), a.signature)
-    bv.add(val.pub_key, b.sign_bytes(chain_id), b.signature)
-    ok, bits = bv.verify()
+    # both signatures ride the process-global VerifyScheduler at COMMIT
+    # priority (one 2-lane submission coalesced with whatever else is in
+    # flight); verify_items falls back to a direct BatchVerifier with
+    # the exact same (all_ok, bitmap) contract whenever the scheduler
+    # is absent, shedding, or stopping — bitmap-exact either way
+    ok, bits = vsched.verify_items(
+        [(val.pub_key, a.sign_bytes(chain_id), a.signature),
+         (val.pub_key, b.sign_bytes(chain_id), b.signature)],
+        vsched.Priority.COMMIT)
     if not ok:
         which = "VoteA" if not bits[0] else "VoteB"
         raise EvidenceError(f"verifying {which}: invalid signature")

@@ -87,6 +87,17 @@ REGISTERED_SITES = frozenset({
     "ingress.admit",
     "ingress.checktx",
     "ingress.recheck",
+    # in-process virtual network + scenario harness (networks/,
+    # ADR-019): vnet.deliver fires on every submitted frame (raise =
+    # the frame is dropped as chaos loss, counted under reason=chaos),
+    # vnet.reorder fires whenever a reorder decision triggers,
+    # vnet.partition fires on every partition/heal transition, and
+    # harness.step fires at each scenario-step boundary (raise = the
+    # scenario fails and dumps its stitched trace artifact)
+    "vnet.deliver",
+    "vnet.partition",
+    "vnet.reorder",
+    "harness.step",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

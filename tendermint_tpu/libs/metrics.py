@@ -514,6 +514,31 @@ class P2PMetrics:
                                       "Bytes received.", labels=("ch_id",))
 
 
+class NetMetrics:
+    """In-process virtual network + scenario harness (networks/vnet.py
+    + networks/harness.py, ADR-019): what the fault schedule is doing
+    to the wire, and whether scenarios are passing their always-on
+    invariant gates."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.partitions_active = reg.gauge(
+            "net", "partitions_active",
+            "Partition groups currently enforced by the virtual "
+            "network (0 = healed).")
+        self.msgs_dropped = reg.counter(
+            "net", "msgs_dropped_total",
+            "Frames the virtual network refused to deliver, by "
+            "reason: partition (cross-group or link down), loss (iid "
+            "drop policy), backpressure (per-channel in-flight cap on "
+            "a try_send), chaos (injected fault at vnet.deliver/"
+            "vnet.reorder).", labels=("reason",))
+        self.scenario_failures = reg.counter(
+            "harness", "scenario_failures_total",
+            "Scenario runs that failed an invariant gate or step (a "
+            "stitched cross-node trace artifact is dumped for each).")
+
+
 class MempoolMetrics:
     """Reference mempool/metrics.go, plus the IngressGate admission
     pipeline (mempool/ingress.py, ADR-018): why txs are being turned

@@ -67,6 +67,8 @@ KNOWN_SPANS = frozenset({
     "device.launch",
     # crypto/lanepool.py — sharded native C host verify (ADR-015)
     "lanepool.verify",
+    # networks/ — the in-process multi-node harness (ADR-019)
+    "harness.scenario", "harness.step", "vnet.deliver",
     # mempool/ingress.py — overload-safe admission (ADR-018)
     "ingress.admit", "ingress.batch", "ingress.checktx",
     "ingress.recheck",

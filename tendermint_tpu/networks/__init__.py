@@ -1,0 +1,9 @@
+"""In-process multi-node network harness (ADR-019).
+
+`vnet` is the fault-injecting in-memory transport that plugs into the
+Switch at the MConnection seam; `harness` boots real Node objects over
+it; `scenarios` is the data-driven fault schedule suite; `invariants`
+holds the always-on agreement/validity/liveness checkers and the
+cross-node flight-recorder stitcher.
+"""
+from .vnet import LinkPolicy, VirtualNetwork, VirtualTransport  # noqa: F401

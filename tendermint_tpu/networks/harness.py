@@ -1,0 +1,639 @@
+"""NetHarness: boot 4-64 REAL Node objects (full reactors + Switch)
+over the fault-injecting in-memory transport and drive data-defined
+scenarios under always-on invariant gates
+(docs/adr/adr-019-net-harness.md).
+
+The harness scaffolds per-node home dirs (keys, shared genesis, config)
+exactly like `tendermint_tpu.cmd testnet`, wires persistent peers
+full-mesh over vnet addresses, and interprets scenario steps
+(networks/scenarios.py).  A ChainWatcher polls agreement/validity on
+every running node for the whole run; any violation, stalled liveness
+gate, or step error fails the scenario, bumps
+harness_scenario_failures_total, prints the seed and dumps a stitched
+cross-node artifact (networks/invariants.py export_artifact) so a
+failure is a replayable timeline, not a shrug.
+
+Every step fires the `harness.step` chaos seam and records a
+`harness.step` trace span, so the flight recorder carries the fault
+schedule alongside every node's consensus spans on one clock.
+"""
+from __future__ import annotations
+
+import base64
+import os
+import tempfile
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from tendermint_tpu.libs import fail, trace
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.switch import Reactor, Switch
+
+from .invariants import (ChainWatcher, Violation, committed_evidence,
+                         export_artifact)
+from .scenarios import validate_scenario
+from .vnet import LinkPolicy, VirtualNetwork
+
+def _step_value(name: str) -> int:
+    from tendermint_tpu.consensus.round_types import Step
+    return int({"propose": Step.PROPOSE, "prevote": Step.PREVOTE,
+                "precommit": Step.PRECOMMIT}[name])
+
+
+class ScenarioFailure(AssertionError):
+    """A scenario failed a gate; `artifact` holds the stitched paths."""
+
+    def __init__(self, msg: str, artifact: Optional[dict] = None,
+                 seed: int = 0):
+        super().__init__(msg)
+        self.artifact = artifact or {}
+        self.seed = seed
+
+
+class _FloodReactor(Reactor):
+    """An external Byzantine peer: registers only the mempool channel
+    and spams gossip txs at every peer it connects to.  Blocking sends
+    make it feel the vnet per-channel backpressure exactly like a real
+    socket writer."""
+
+    def __init__(self, tx_bytes: int = 128, batch: int = 64):
+        super().__init__("FLOOD")
+        self.tx_bytes = tx_bytes
+        self.batch = batch
+        self.sent = 0
+
+    def get_channels(self):
+        from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=100)]
+
+    def add_peer(self, peer):
+        self.spawn(self._flood, peer, name="flood")
+
+    def _flood(self, peer):
+        from tendermint_tpu.mempool.reactor import (MEMPOOL_CHANNEL,
+                                                    TxsMessage)
+        seq = 0
+        while not self.quitting.is_set():
+            txs = []
+            for _ in range(self.batch):
+                body = (f"flood{seq}=".encode()
+                        + os.urandom(max(1, self.tx_bytes // 2)).hex()
+                        .encode())
+                txs.append(body[:self.tx_bytes])
+                seq += 1
+            if not peer.send(MEMPOOL_CHANNEL, TxsMessage(txs)):
+                time.sleep(0.01)
+                continue
+            self.sent += len(txs)
+
+
+class HarnessNode:
+    """One slot in the network: a scaffolded home dir + the live Node
+    (rebuilt across restarts).  `priv` is the slot's validator key —
+    standbys have one too, so churn can promote them."""
+
+    def __init__(self, harness: "NetHarness", idx: int):
+        self.harness = harness
+        self.idx = idx
+        self.name = f"node{idx}"
+        self.addr = f"vnode{idx}"
+        self.home = os.path.join(harness.workdir, self.name)
+        self.node = None
+        self.pv = None
+        self.node_key = None
+        self.running = False
+
+    def scaffold(self):
+        from tendermint_tpu.config.config import Config
+        from tendermint_tpu.privval.file_pv import FilePV
+        cfg = Config(home=self.home, moniker=self.name)
+        cfg.ensure_dirs()
+        self.pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                          cfg.priv_validator_state_file())
+        self.node_key = NodeKey.load_or_generate(cfg.node_key_file())
+
+    def build(self):
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.node import Node
+        cfg = self.harness.node_config(self.idx)
+        transport = self.harness.net.transport(self.addr)
+        self.node = Node(cfg, KVStoreApplication(),
+                         in_memory=not self.harness.persist,
+                         transport=transport)
+        return self.node
+
+    def start(self):
+        if self.node is None:
+            self.build()
+        self.node.start()
+        self.running = True
+
+    def stop(self):
+        if self.node is not None and self.running:
+            self.running = False
+            try:
+                self.node.stop()
+            finally:
+                self.node = None
+
+    def restart(self):
+        """A fresh Node over the same home dir: WAL + store + privval
+        recovery, then catch-up (only meaningful with persist=True)."""
+        self.stop()
+        self.node = None
+        self.build()
+        self.start()
+
+    def height(self) -> int:
+        n = self.node
+        return n.block_store.height() if n is not None else 0
+
+
+class NetHarness:
+    """Scaffold, boot, perturb and gate an in-process network."""
+
+    def __init__(self, validators: int, standbys: int = 0, seed: int = 0,
+                 workdir: Optional[str] = None, persist: bool = False,
+                 consensus_overrides: Optional[dict] = None,
+                 mempool_overrides: Optional[dict] = None,
+                 power: int = 10, chain_id: str = "netharness-chain"):
+        self.n_validators = validators
+        self.n_nodes = validators + standbys
+        self.seed = seed
+        self.persist = persist
+        self.power = power
+        self.chain_id = chain_id
+        self.consensus_overrides = dict(consensus_overrides or {})
+        self.mempool_overrides = dict(mempool_overrides or {})
+        self.workdir = workdir or tempfile.mkdtemp(prefix="tm_netharness_")
+        self.net = VirtualNetwork(
+            seed=seed,
+            default_policy=LinkPolicy(latency_s=0.001, jitter_s=0.002))
+        self.nodes: List[HarnessNode] = [
+            HarnessNode(self, i) for i in range(self.n_nodes)]
+        self.watcher = ChainWatcher(chain_id)
+        self._lock = threading.Lock()
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._flooder: Optional[Switch] = None
+        self._flood_reactor: Optional[_FloodReactor] = None
+        self._flood_seq = 0
+        self._genesis_json: Optional[str] = None
+        self._scaffold()
+
+    # -- scaffolding -------------------------------------------------------
+
+    def _scaffold(self):
+        from tendermint_tpu.types.basic import Timestamp
+        from tendermint_tpu.types.genesis import (GenesisDoc,
+                                                  GenesisValidator)
+        for hn in self.nodes:
+            hn.scaffold()
+        gdoc = GenesisDoc(
+            chain_id=self.chain_id,
+            genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(
+                address=hn.pv.get_pub_key().address(),
+                pub_key_type=hn.pv.get_pub_key().type_name,
+                pub_key_bytes=hn.pv.get_pub_key().bytes(),
+                power=self.power)
+                for hn in self.nodes[:self.n_validators]])
+        self._genesis_json = gdoc.to_json()
+        for hn in self.nodes:
+            gpath = os.path.join(hn.home, "config", "genesis.json")
+            with open(gpath, "w") as f:
+                f.write(self._genesis_json)
+
+    def node_config(self, idx: int):
+        """A fresh Config for slot idx (rebuilt per (re)boot so config
+        mutations never leak across restarts)."""
+        from tendermint_tpu.config.config import Config
+        from tendermint_tpu.consensus.config import test_config
+        hn = self.nodes[idx]
+        cfg = Config(home=hn.home, moniker=hn.name)
+        cfg.consensus = test_config()
+        for k, v in self.consensus_overrides.items():
+            setattr(cfg.consensus, k, v)
+        for k, v in self.mempool_overrides.items():
+            setattr(cfg.mempool, k, v)
+        cfg.rpc.enabled = False
+        cfg.p2p.pex = False
+        cfg.p2p.laddr = hn.addr
+        cfg.p2p.max_num_peers = max(64, self.n_nodes + 8)
+        cfg.p2p.persistent_peers = ",".join(
+            f"{other.node_key.node_id}@{other.addr}"
+            for other in self.nodes if other.idx != idx)
+        return cfg
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "NetHarness":
+        self.net.start()
+        for hn in self.nodes:
+            hn.start()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_routine, daemon=True,
+            name="harness-monitor")
+        self._monitor.start()
+        return self
+
+    def stop(self):
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=3.0)
+        self.stop_flood()
+        for hn in self.nodes:
+            try:
+                hn.stop()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self.net.stop()
+
+    def running_nodes(self) -> List[HarnessNode]:
+        return [hn for hn in self.nodes if hn.running]
+
+    def heights(self) -> Dict[str, int]:
+        return {hn.name: hn.height() for hn in self.running_nodes()}
+
+    # -- invariant monitor -------------------------------------------------
+
+    def _monitor_routine(self):
+        while not self._monitor_stop.wait(0.25):
+            self.check_invariants()
+
+    def check_invariants(self) -> List[Violation]:
+        """One watcher pass over every running node (also called a
+        final time by run_scenario so nothing commits unchecked)."""
+        found: List[Violation] = []
+        with self._lock:
+            live = [(hn.name, hn.node) for hn in self.nodes
+                    if hn.running and hn.node is not None]
+        for name, node in live:
+            try:
+                found.extend(self.watcher.observe(name, node))
+            except Exception:  # noqa: BLE001 - a mid-stop node is not
+                continue       # an invariant violation
+        self.watcher.sample(self.heights())
+        return found
+
+    # -- faults ------------------------------------------------------------
+
+    def partition(self, *groups):
+        self.net.set_partition(*[
+            {self.nodes[i].addr for i in g} for g in groups])
+
+    def heal(self):
+        self.net.heal()
+
+    def set_link(self, src: int, dst: int, **policy):
+        self.net.set_link(self.nodes[src].addr, self.nodes[dst].addr,
+                          **policy)
+
+    def break_link(self, a: int, b: int):
+        self.net.break_link(self.nodes[a].addr, self.nodes[b].addr)
+
+    def kill(self, idx: int):
+        """Abrupt-ish death: sever every link, then stop the node (the
+        remote sides observe a reset, not a graceful goodbye)."""
+        victim = self.nodes[idx]
+        for other in self.nodes:
+            if other.idx != idx:
+                self.net.break_link(victim.addr, other.addr)
+        victim.stop()
+
+    def restart(self, idx: int):
+        self.nodes[idx].restart()
+
+    # -- workload ----------------------------------------------------------
+
+    def submit_tx(self, idx: int, tx: bytes):
+        node = self.nodes[idx].node
+        if node is None:
+            raise RuntimeError(f"node{idx} is not running")
+        resp = node.mempool.check_tx(bytes(tx))
+        return resp
+
+    def promote_tx(self, idx: int, power: int) -> bytes:
+        pub = self.nodes[idx].pv.get_pub_key()
+        b64 = base64.b64encode(pub.bytes()).decode()
+        return f"val:{b64}!{power}".encode()
+
+    def start_flood(self, target: int, tx_bytes: int = 128,
+                    batch: int = 64):
+        # one flooder at a time: a second flood step replaces the
+        # first, which must be STOPPED or its threads keep spamming
+        # with no handle left to silence them
+        self.stop_flood()
+        self._flood_seq += 1
+        addr = f"vflood{self._flood_seq}"
+        nk = NodeKey.generate()
+        transport = self.net.transport(addr)
+        sw = Switch(nk, addr, network=self.chain_id,
+                    moniker="flooder", transport=transport)
+        reactor = _FloodReactor(tx_bytes=tx_bytes, batch=batch)
+        sw.add_reactor("FLOOD", reactor)
+        sw.start()
+        tgt = self.nodes[target]
+        peer = sw.dial_peer(f"{tgt.node_key.node_id}@{tgt.addr}")
+        if peer is None:
+            sw.stop()
+            raise RuntimeError("flooder could not reach its target")
+        self._flooder, self._flood_reactor = sw, reactor
+        return reactor
+
+    def stop_flood(self):
+        if self._flooder is not None:
+            self._flooder.stop()
+            self._flooder = None
+
+    def double_sign(self, idx: int):
+        """Arm an equivocating prevoter (reference byzantine_test.go):
+        alongside every honest prevote the node signs and gossips a
+        conflicting one for a fabricated block with its RAW key (FilePV
+        correctly refuses the double sign)."""
+        from tendermint_tpu.types.basic import (BlockID, PartSetHeader,
+                                                SignedMsgType, Timestamp)
+        from tendermint_tpu.types.vote import Vote
+        hn = self.nodes[idx]
+        cs = hn.node.consensus
+        priv = hn.pv.priv_key
+        orig = cs.do_prevote
+
+        def equivocating(height, round_):
+            orig(height, round_)
+            try:
+                fake = BlockID(hash=bytes([0xEE] * 32),
+                               part_set_header=PartSetHeader(
+                                   1, bytes([0xEF] * 32)))
+                addr = priv.pub_key().address()
+                i, _ = cs.rs.validators.get_by_address(addr)
+                v = Vote(type=SignedMsgType.PREVOTE, height=height,
+                         round=round_, block_id=fake,
+                         timestamp=Timestamp.now(),
+                         validator_address=addr, validator_index=i)
+                v.signature = priv.sign(v.sign_bytes(self.chain_id))
+                for fn in cs.broadcast_vote:
+                    fn(v)
+            except Exception:  # noqa: BLE001 - byzantine code may race
+                pass
+        cs.do_prevote = equivocating
+
+    # -- gates -------------------------------------------------------------
+
+    def wait_height(self, delta: int, timeout: float = 60.0,
+                    who: Optional[List[int]] = None):
+        """Liveness gate: the watched nodes must all advance `delta`
+        above the CURRENT max watched height within `timeout`."""
+        watch = [self.nodes[i] for i in who] if who is not None \
+            else self.running_nodes()
+        watch = [hn for hn in watch if hn.running]
+        if not watch:
+            raise ScenarioFailure("liveness gate with no running nodes")
+        target = max(hn.height() for hn in watch) + delta
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            hs = [hn.height() for hn in watch]
+            if min(hs) >= target:
+                return target
+            time.sleep(0.1)
+        heights = {hn.name: hn.height() for hn in watch}
+        self.watcher.violations.append(Violation(
+            "liveness", ",".join(hn.name for hn in watch), target,
+            f"stalled below {target} after {timeout}s: {heights}"))
+        raise ScenarioFailure(
+            f"liveness gate failed: wanted {target}, got {heights}")
+
+    def expect_stall(self, for_s: float, max_advance: int = 1,
+                     who: Optional[List[int]] = None):
+        """Safety gate for no-quorum splits: any commit while no group
+        holds >2/3 would be an agreement bug in the making."""
+        watch = [self.nodes[i] for i in who] if who is not None \
+            else self.running_nodes()
+        before = max(hn.height() for hn in watch)
+        time.sleep(for_s)
+        after = max(hn.height() for hn in watch)
+        if after - before > max_advance:
+            self.watcher.violations.append(Violation(
+                "agreement", "harness", after,
+                f"chain advanced {after - before} heights during a "
+                f"no-quorum partition"))
+            raise ScenarioFailure(
+                f"no-quorum split advanced {after - before} heights")
+
+    def wait_proposer(self, at_step: str, timeout: float = 45.0) -> int:
+        """Catch a running validator being proposer at the named step
+        (propose/prevote/precommit); falls back to any proposer match
+        near the deadline so the kill still lands."""
+        want = _step_value(at_step)
+        deadline = time.monotonic() + timeout
+        fallback_after = deadline - timeout / 3.0
+        by_addr = {hn.pv.get_pub_key().address(): hn.idx
+                   for hn in self.nodes if hn.running}
+        while time.monotonic() < deadline:
+            for hn in self.running_nodes():
+                try:
+                    rs = hn.node.consensus.get_round_state()
+                    if rs.validators is None:
+                        continue
+                    prop = rs.validators.get_proposer()
+                    idx = by_addr.get(prop.address)
+                    if idx is None or not self.nodes[idx].running:
+                        continue
+                    vs = self.nodes[idx].node.consensus.get_round_state()
+                    if int(vs.step) == want \
+                            or time.monotonic() > fallback_after:
+                        return idx
+                except Exception:  # noqa: BLE001 - racing a commit
+                    continue
+            time.sleep(0.002)
+        raise ScenarioFailure(
+            f"no proposer observed at step {at_step} in {timeout}s")
+
+    def wait_evidence(self, timeout: float = 120.0) -> list:
+        """Gate: DuplicateVoteEvidence lands in a committed block."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for hn in self.running_nodes():
+                evs = committed_evidence(hn.node)
+                if evs:
+                    return evs
+            time.sleep(0.25)
+        pools = {hn.name: hn.node.evidence_pool.size()
+                 for hn in self.running_nodes()}
+        raise ScenarioFailure(
+            f"evidence never committed (pools={pools}, "
+            f"heights={self.heights()})")
+
+    # -- scenario interpreter ----------------------------------------------
+
+    def _apply_step(self, step: dict, ctx: dict):
+        fail.inject("harness.step")
+        op = step["op"]
+        if op == "wait_height":
+            self.wait_height(step.get("delta", 1),
+                             timeout=step.get("timeout", 60.0),
+                             who=step.get("who"))
+        elif op == "expect_stall":
+            self.expect_stall(step["for_s"],
+                              max_advance=step.get("max_advance", 1),
+                              who=step.get("who"))
+        elif op == "partition":
+            self.partition(*step["groups"])
+        elif op == "heal":
+            self.heal()
+        elif op == "link":
+            pol = {k: v for k, v in step.items()
+                   if k not in ("op", "src", "dst")}
+            self.set_link(step["src"], step["dst"], **pol)
+        elif op == "flap":
+            for _ in range(step.get("times", 3)):
+                self.break_link(step["a"], step["b"])
+                time.sleep(step.get("gap_s", 0.2))
+        elif op == "kill":
+            self.kill(self._node_ref(step["node"], ctx))
+        elif op == "restart":
+            self.restart(self._node_ref(step["node"], ctx))
+        elif op == "kill_proposer":
+            victim = self.wait_proposer(step.get("at_step", "propose"),
+                                        timeout=step.get("timeout", 45.0))
+            ctx["victim"] = victim
+            self.kill(victim)
+        elif op == "double_sign":
+            self.double_sign(step["node"])
+        elif op == "expect_evidence":
+            ctx["evidence"] = self.wait_evidence(
+                timeout=step.get("timeout", 120.0))
+        elif op == "flood":
+            self.start_flood(step.get("target", 0),
+                             tx_bytes=step.get("tx_bytes", 128),
+                             batch=step.get("batch", 64))
+        elif op == "stop_flood":
+            self.stop_flood()
+        elif op == "expect_rejections":
+            # mempool metrics share the process-global registry, so one
+            # running node's bundle sees the whole network's counters
+            reasons = ("busy", "ratelimit", "full")
+            seen = 0
+            for hn in self.running_nodes()[:1]:
+                m = getattr(hn.node.mempool, "metrics", None)
+                if m is not None:
+                    seen = sum(m.rejected_txs.value(reason=r)
+                               for r in reasons)
+            if seen < step.get("min", 1):
+                raise ScenarioFailure(
+                    f"IngressGate rejected {seen} flood txs, wanted "
+                    f">= {step.get('min', 1)}")
+            ctx["rejections"] = seen
+        elif op == "txs":
+            for tx in step.get("items", ()):
+                self.submit_tx(step.get("node", 0), tx)
+        elif op == "promote":
+            tx = self.promote_tx(step["node"], step.get("power", 10))
+            # submit at a running validator-slot node so the mempool
+            # reactor gossips it to whoever proposes next
+            src = min(hn.idx for hn in self.running_nodes())
+            self.submit_tx(src, tx)
+        elif op == "sleep":
+            time.sleep(step.get("s", 0.5))
+        else:  # pragma: no cover - validate_scenario gates this
+            raise ScenarioFailure(f"unknown scenario op {op!r}")
+
+    @staticmethod
+    def _node_ref(ref, ctx: dict) -> int:
+        if isinstance(ref, str):
+            if ref not in ctx:
+                raise ScenarioFailure(f"step references {ref!r} before "
+                                      "a step produced it")
+            return ctx[ref]
+        return ref
+
+    def run_scenario(self, scenario: dict) -> dict:
+        """Interpret the scenario's steps with the invariant monitor
+        armed.  Success returns {steps, ctx, heights}; any failure
+        dumps a stitched artifact and raises ScenarioFailure carrying
+        the artifact paths and the reproducing seed."""
+        validate_scenario(scenario)
+        name = scenario["name"]
+        ctx: dict = {}
+        steps_log: List[dict] = []
+        error: Optional[str] = None
+        with trace.span("harness.scenario", scenario=name,
+                        seed=self.seed):
+            try:
+                for i, step in enumerate(scenario["steps"]):
+                    t0 = time.monotonic()
+                    with trace.span("harness.step", op=step["op"],
+                                    index=i):
+                        self._apply_step(step, ctx)
+                    steps_log.append({
+                        "index": i, "step": step,
+                        "dur_s": round(time.monotonic() - t0, 3),
+                        "heights": self.heights()})
+                    vs = [v for v in self.watcher.violations
+                          if v.kind in ("agreement", "validity")]
+                    if vs:
+                        raise ScenarioFailure(
+                            "invariant violation: "
+                            + "; ".join(v.detail for v in vs))
+                # final sweep so late commits are validated too
+                self.check_invariants()
+                vs = [v for v in self.watcher.violations
+                      if v.kind in ("agreement", "validity")]
+                if vs:
+                    raise ScenarioFailure(
+                        "invariant violation: "
+                        + "; ".join(v.detail for v in vs))
+            except Exception as e:
+                error = f"{type(e).__name__}: {e}"
+                self.net.metrics.scenario_failures.inc()
+                artifact = self._dump_artifact(name, steps_log, error)
+                msg = (f"scenario {name!r} failed (seed={self.seed}, "
+                       f"replay with NetHarness(seed={self.seed})): "
+                       f"{error}\n  artifact: {artifact}")
+                if isinstance(e, ScenarioFailure):
+                    raise ScenarioFailure(msg, artifact,
+                                          self.seed) from e
+                raise ScenarioFailure(
+                    msg + "\n" + traceback.format_exc(limit=8),
+                    artifact, self.seed) from e
+        return {"scenario": name, "steps": steps_log, "ctx": ctx,
+                "heights": self.heights(),
+                "violations": list(self.watcher.violations)}
+
+    @classmethod
+    def run(cls, scenario: dict, seed: int = 0,
+            workdir: Optional[str] = None) -> dict:
+        """Build a harness shaped by the scenario (validators, standbys,
+        persistence, config tweaks), run it, and tear everything down.
+        The one-call entry the test suite and CLI use."""
+        validate_scenario(scenario)
+        h = cls(validators=scenario["validators"],
+                standbys=scenario.get("standbys", 0), seed=seed,
+                workdir=workdir, persist=scenario.get("persist", False),
+                consensus_overrides=scenario.get("consensus"),
+                mempool_overrides=scenario.get("mempool"))
+        h.start()
+        try:
+            return h.run_scenario(scenario)
+        finally:
+            h.stop()
+
+    def _dump_artifact(self, name: str, steps_log: List[dict],
+                       error: str) -> dict:
+        nodes_summary = [{
+            "name": hn.name, "running": hn.running,
+            "height": hn.height(),
+            "peers": (hn.node.switch.num_peers()
+                      if hn.node is not None else 0),
+        } for hn in self.nodes]
+        try:
+            return export_artifact(
+                self.workdir, name, self.seed, steps_log, self.watcher,
+                nodes_summary, self.net.decisions(), error=error)
+        except Exception:  # noqa: BLE001 - artifact write must not mask
+            return {}       # the scenario failure itself

@@ -1,0 +1,178 @@
+"""Always-on invariant checkers + cross-node flight-recorder stitching
+for harness runs (docs/adr/adr-019-net-harness.md).
+
+Three gates run against every scenario, continuously, not post-hoc:
+
+  agreement  no two nodes ever commit conflicting blocks at any height
+             (the safety property; a mismatch is a fork and fails the
+             run immediately);
+  validity   every committed block is internally valid: validate_basic,
+             hash-chain linkage to the previous stored block, and a
+             >2/3 certifying commit verified against that height's
+             validator set (the stored-chain analog of ValidateBlock —
+             reconstructing the full pre-state per height is not
+             possible from the stores, so validity is checked against
+             what the stores themselves certify);
+  liveness   the chain height advances within a bound after a heal /
+             restart (enforced by the harness's wait gates, which raise
+             through the same violation surface).
+
+On failure the harness stitches one artifact from all nodes: the shared
+process flight recorder (libs/trace.py — every node's spans already
+share one monotonic clock), the per-node height timeline the watcher
+sampled, the scenario step log, and the vnet decision log (the
+replayable fault schedule for the printed seed).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.libs import trace
+
+
+@dataclass
+class Violation:
+    kind: str          # "agreement" | "validity" | "liveness" | "step"
+    node: str
+    height: int
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "node": self.node,
+                "height": self.height, "detail": self.detail}
+
+
+class InvariantError(AssertionError):
+    def __init__(self, violations: List[Violation]):
+        self.violations = violations
+        super().__init__("; ".join(
+            f"[{v.kind}] {v.node}@{v.height}: {v.detail}"
+            for v in violations) or "invariant violation")
+
+
+class ChainWatcher:
+    """Incremental agreement + validity checking over live node stores.
+    `observe(name, node)` validates every height the node committed
+    since the last call; cheap enough to poll at 4 Hz during a run."""
+
+    MAX_HEIGHTS_PER_TICK = 64
+
+    def __init__(self, chain_id: str):
+        self.chain_id = chain_id
+        self._by_height: Dict[int, tuple] = {}   # h -> (hash, first node)
+        self._cursors: Dict[str, int] = {}
+        self.violations: List[Violation] = []
+        self.timeline: List[tuple] = []          # (t, {node: height})
+
+    def sample(self, heights: Dict[str, int]):
+        self.timeline.append((time.monotonic(), dict(heights)))
+
+    def observe(self, name: str, node) -> List[Violation]:
+        """Validate the node's newly committed heights; returns (and
+        records) any violations found this call."""
+        store = node.block_store
+        top = store.height()
+        cur = self._cursors.get(name, store.base() - 1 if top else 0)
+        found: List[Violation] = []
+        upper = min(top, cur + self.MAX_HEIGHTS_PER_TICK)
+        for h in range(max(cur + 1, 1), upper + 1):
+            v = self._check_height(name, node, h)
+            found.extend(v)
+        self._cursors[name] = upper
+        self.violations.extend(found)
+        return found
+
+    # -- per-height checks -------------------------------------------------
+
+    def _check_height(self, name: str, node, h: int) -> List[Violation]:
+        out: List[Violation] = []
+        store = node.block_store
+        meta = store.load_block_meta(h)
+        block = store.load_block(h)
+        if meta is None or block is None:
+            return [Violation("validity", name, h,
+                              "committed height has no stored block")]
+        bhash = bytes(meta.block_id.hash)
+        # agreement: first committer pins the hash for everyone
+        seen = self._by_height.get(h)
+        if seen is None:
+            self._by_height[h] = (bhash, name)
+        elif seen[0] != bhash:
+            out.append(Violation(
+                "agreement", name, h,
+                f"conflicting commit: {bhash.hex()[:16]} vs "
+                f"{seen[0].hex()[:16]} first committed by {seen[1]}"))
+        # validity 1: structural
+        try:
+            block.validate_basic()
+        except Exception as e:  # noqa: BLE001 - any defect is a finding
+            out.append(Violation("validity", name, h,
+                                 f"validate_basic: {e}"))
+        # validity 2: hash-chain linkage to the node's own previous block
+        if h > 1:
+            prev = store.load_block_meta(h - 1)
+            if prev is not None and \
+                    bytes(block.header.last_block_id.hash) != \
+                    bytes(prev.block_id.hash):
+                out.append(Violation(
+                    "validity", name, h,
+                    "last_block_id does not match stored parent"))
+        # validity 3: >2/3 certifying commit against that height's set
+        commit = store.load_block_commit(h) or store.load_seen_commit(h)
+        if commit is not None:
+            vals = node.state_store.load_validators(h)
+            if vals is not None:
+                try:
+                    vals.verify_commit_light(
+                        self.chain_id, meta.block_id, h, commit)
+                except Exception as e:  # noqa: BLE001
+                    out.append(Violation(
+                        "validity", name, h,
+                        f"certifying commit failed verification: {e}"))
+        return out
+
+
+def committed_evidence(node, since_height: int = 1) -> list:
+    """Every evidence item landed in the node's committed blocks."""
+    out = []
+    store = node.block_store
+    for h in range(max(since_height, 1), store.height() + 1):
+        b = store.load_block(h)
+        if b is not None and b.evidence:
+            out.extend(b.evidence)
+    return out
+
+
+def export_artifact(workdir: str, scenario: str, seed: int,
+                    steps_log: List[dict], watcher: ChainWatcher,
+                    nodes_summary: List[dict], decisions: list,
+                    error: Optional[str] = None) -> dict:
+    """Stitch the run into replay artifacts.  Returns the paths dict;
+    the JSON timeline is always written, the Chrome-trace span dump
+    only when the flight recorder is enabled."""
+    os.makedirs(workdir, exist_ok=True)
+    base = os.path.join(workdir, f"scenario-{scenario}-seed{seed}")
+    timeline_path = base + ".json"
+    payload = {
+        "scenario": scenario,
+        "seed": seed,
+        "error": error,
+        "steps": steps_log,
+        "violations": [v.as_dict() for v in watcher.violations],
+        "nodes": nodes_summary,
+        "timeline": [
+            {"t": t, "heights": hs} for t, hs in watcher.timeline],
+        # the replayable fault schedule: (src, dst, link msg idx,
+        # channel, size, verdict, delay_us)
+        "vnet_decisions": [list(d) for d in decisions],
+    }
+    with open(timeline_path, "w") as f:
+        json.dump(payload, f, default=str)
+    paths = {"timeline": timeline_path}
+    if trace.is_enabled():
+        paths["trace"] = trace.export_file(base + ".trace.json")
+    return paths

@@ -1,0 +1,271 @@
+"""The scenario suite — fault schedules as data, not code
+(docs/adr/adr-019-net-harness.md; Twins / Jepsen-style compositions).
+
+A scenario is a plain dict: network shape (validators, standbys,
+persistence), optional per-node config tweaks, and an ordered list of
+steps the harness interprets (networks/harness.py `run_scenario`).
+Every step passes through the `harness.step` chaos seam and is recorded
+in the step log; liveness gates are themselves steps (`wait_height`),
+so a stall fails the run with a stitched artifact instead of a shrug.
+
+Step vocabulary (harness._apply_step):
+
+  {"op": "wait_height", "delta": D, ...}   liveness gate: the watched
+      nodes must advance D heights within "timeout" (default 60 s);
+      "who": [indices] restricts the watch set (default: running nodes)
+  {"op": "expect_stall", "for_s": S}       safety gate for no-quorum
+      splits: max height advance over S seconds must be <= "max_advance"
+  {"op": "partition", "groups": [[..]]}    / {"op": "heal"}
+  {"op": "link", "src": i, "dst": j, ...}  directed LinkPolicy override
+  {"op": "flap", "a": i, "b": j, "times": n, "gap_s": g}
+  {"op": "kill", "node": i} / {"op": "restart", "node": i}
+  {"op": "kill_proposer", "at_step": "propose"|"prevote"|"precommit"}
+      kills whichever validator is proposer when caught at that step
+      (records the victim; {"op": "restart", "node": "victim"} revives)
+  {"op": "double_sign", "node": i}         arm an equivocating prevoter
+  {"op": "expect_evidence", "timeout": s}  gate: DuplicateVoteEvidence
+      lands in a committed block on a quorum of honest nodes
+  {"op": "flood", "target": i, ...}        attach an external flooding
+      peer spamming mempool gossip at node i until "stop_flood"
+  {"op": "stop_flood"}
+  {"op": "expect_rejections", "min": n}    gate: the IngressGate turned
+      away at least n flood txs (busy/ratelimit/full reasons)
+  {"op": "txs", "node": i, "items": [..]}  submit raw txs
+  {"op": "promote", "node": i, "power": p} validator-set churn via the
+      kvstore "val:<pubkey_b64>!<power>" tx (power 0 demotes)
+  {"op": "sleep", "s": x}
+"""
+from __future__ import annotations
+
+import copy
+from typing import List
+
+_STEP_OPS = frozenset({
+    "wait_height", "expect_stall", "partition", "heal", "link", "flap",
+    "kill", "restart", "kill_proposer", "double_sign",
+    "expect_evidence", "flood", "stop_flood", "expect_rejections",
+    "txs", "promote", "sleep",
+})
+
+
+def validate_scenario(sc: dict) -> dict:
+    """Schema check: every scenario is data the harness can interpret.
+    Returns the scenario for chaining; raises ValueError on rot."""
+    for key in ("name", "validators", "steps"):
+        if key not in sc:
+            raise ValueError(f"scenario missing {key!r}")
+    n = sc["validators"] + sc.get("standbys", 0)
+    if not 2 <= n <= 64:
+        raise ValueError(f"scenario {sc['name']}: node count {n} "
+                         "outside the harness's 2..64 envelope")
+    for i, step in enumerate(sc["steps"]):
+        op = step.get("op")
+        if op not in _STEP_OPS:
+            raise ValueError(
+                f"scenario {sc['name']} step {i}: unknown op {op!r}")
+        for ref in ("node", "target", "src", "dst", "a", "b"):
+            v = step.get(ref)
+            if isinstance(v, int) and not 0 <= v < n:
+                raise ValueError(
+                    f"scenario {sc['name']} step {i}: {ref}={v} out of "
+                    f"range for {n} nodes")
+        if op == "partition":
+            for g in step.get("groups", ()):
+                for m in g:
+                    if not 0 <= m < n:
+                        raise ValueError(
+                            f"scenario {sc['name']} step {i}: partition "
+                            f"member {m} out of range")
+    return sc
+
+
+# ---------------------------------------------------------------------------
+# the suite.  `persist` scenarios run file-backed stores so kill/restart
+# recovers through WAL + handshake + blocksync (the BlockPipeline path);
+# in-memory scenarios trade that for speed.  `smoke` marks the one
+# tier-1 scenario (host-only verification, no XLA shapes).
+# ---------------------------------------------------------------------------
+
+SCENARIOS: List[dict] = [validate_scenario(s) for s in (
+    {
+        "name": "partition_heal_majority",
+        "smoke": True,
+        "validators": 4,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "partition", "groups": [[0, 1, 2], [3]]},
+            {"op": "wait_height", "delta": 2, "timeout": 60,
+             "who": [0, 1, 2]},
+            {"op": "heal"},
+            {"op": "wait_height", "delta": 2, "timeout": 90},
+        ],
+    },
+    {
+        "name": "partition_no_quorum",
+        "validators": 4,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "partition", "groups": [[0, 1], [2, 3]]},
+            # neither half has >2/3: the chain MUST stall (a commit in
+            # either half would be a safety bug) ...
+            {"op": "expect_stall", "for_s": 3.0, "max_advance": 1},
+            {"op": "heal"},
+            # ... and recover once quorum reassembles
+            {"op": "wait_height", "delta": 2, "timeout": 90},
+        ],
+    },
+    {
+        "name": "proposer_crash_propose",
+        "validators": 4,
+        "persist": True,
+        "consensus": {"timeout_propose": 0.8},
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "kill_proposer", "at_step": "propose"},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+            {"op": "restart", "node": "victim"},
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+        ],
+    },
+    {
+        "name": "proposer_crash_prevote",
+        "validators": 4,
+        "persist": True,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "kill_proposer", "at_step": "prevote"},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+            {"op": "restart", "node": "victim"},
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+        ],
+    },
+    {
+        "name": "proposer_crash_precommit",
+        "validators": 4,
+        "persist": True,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "kill_proposer", "at_step": "precommit"},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+            {"op": "restart", "node": "victim"},
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+        ],
+    },
+    {
+        "name": "validator_churn",
+        "validators": 4,
+        "standbys": 2,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            # promote both standbys, then demote an original — three
+            # validator-set changes while the chain keeps committing
+            {"op": "promote", "node": 4, "power": 10},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+            {"op": "promote", "node": 5, "power": 10},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+            {"op": "promote", "node": 3, "power": 0},
+            {"op": "wait_height", "delta": 3, "timeout": 90},
+        ],
+    },
+    {
+        "name": "double_sign_evidence",
+        "validators": 4,
+        "steps": [
+            {"op": "wait_height", "delta": 1, "timeout": 60},
+            {"op": "double_sign", "node": 3},
+            {"op": "expect_evidence", "timeout": 120},
+            {"op": "wait_height", "delta": 1, "timeout": 60},
+        ],
+    },
+    {
+        "name": "flood_vs_ingress",
+        "validators": 4,
+        "mempool": {"ingress_queue": 128, "ingress_rate_per_s": 200.0,
+                    "ingress_burst": 64},
+        "steps": [
+            {"op": "wait_height", "delta": 1, "timeout": 60},
+            {"op": "flood", "target": 0, "tx_bytes": 128,
+             "batch": 64},
+            # consensus must keep committing THROUGH the flood
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+            {"op": "stop_flood"},
+            {"op": "expect_rejections", "min": 1},
+            {"op": "wait_height", "delta": 1, "timeout": 60},
+        ],
+    },
+    {
+        "name": "laggard_catchup",
+        "validators": 4,
+        "standbys": 1,
+        "persist": True,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "kill", "node": 4},
+            {"op": "wait_height", "delta": 4, "timeout": 120,
+             "who": [0, 1, 2, 3]},
+            # the laggard rejoins and must catch up (handshake gap
+            # replay + blocksync/BlockPipeline + consensus catch-up)
+            # while the rest keep committing
+            {"op": "restart", "node": 4},
+            {"op": "wait_height", "delta": 3, "timeout": 180},
+        ],
+    },
+    {
+        "name": "churn_at_scale",
+        "slow_matrix": True,
+        "validators": 8,
+        "standbys": 4,
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 120},
+            {"op": "promote", "node": 8, "power": 10},
+            {"op": "promote", "node": 9, "power": 10},
+            {"op": "wait_height", "delta": 3, "timeout": 180},
+            {"op": "promote", "node": 10, "power": 10},
+            {"op": "promote", "node": 11, "power": 10},
+            {"op": "promote", "node": 0, "power": 0},
+            {"op": "promote", "node": 1, "power": 0},
+            {"op": "wait_height", "delta": 3, "timeout": 180},
+        ],
+    },
+    {
+        "name": "partition_heal_16",
+        "slow_matrix": True,
+        "validators": 16,
+        # 16 in-process nodes contend hard for the GIL on small CI
+        # hosts: the sub-second test timeouts expire spuriously and
+        # every height burns round escalations.  Scale the consensus
+        # clock with the network so timeouts measure the network, not
+        # the host's thread scheduler.
+        "consensus": {
+            "timeout_propose": 1.2, "timeout_propose_delta": 0.6,
+            "timeout_prevote": 0.6, "timeout_prevote_delta": 0.3,
+            "timeout_precommit": 0.6, "timeout_precommit_delta": 0.3,
+            "timeout_commit": 0.1,
+        },
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 240},
+            {"op": "partition",
+             "groups": [list(range(11)), list(range(11, 16))]},
+            {"op": "wait_height", "delta": 2, "timeout": 240,
+             "who": list(range(11))},
+            {"op": "heal"},
+            {"op": "wait_height", "delta": 2, "timeout": 300},
+        ],
+    },
+)]
+
+
+def by_name(name: str) -> dict:
+    for sc in SCENARIOS:
+        if sc["name"] == name:
+            return copy.deepcopy(sc)
+    raise KeyError(f"unknown scenario {name!r}")
+
+
+def smoke_scenarios() -> List[dict]:
+    return [copy.deepcopy(s) for s in SCENARIOS if s.get("smoke")]
+
+
+def standard_scenarios() -> List[dict]:
+    return [copy.deepcopy(s) for s in SCENARIOS
+            if not s.get("smoke") and not s.get("slow_matrix")]

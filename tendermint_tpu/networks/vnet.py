@@ -1,0 +1,651 @@
+"""Fault-injecting in-memory transport for multi-node harness runs
+(docs/adr/adr-019-net-harness.md; reference test/e2e perturbations +
+Jepsen/Twins-style partition schedules in spirit).
+
+The VirtualNetwork replaces TCP/SecretConnection at the MConnection
+seam: each Switch gets a VirtualTransport; dialing creates a pair of
+VirtualConnections (one per side, same send/try_send/start/stop surface
+as MConnection) whose frames route through one process-wide delivery
+engine.  Every directed link carries a LinkPolicy — partition/down,
+iid drop, latency+jitter, duplication, reordering, bandwidth cap — and
+every per-message fault decision is drawn from a per-link RNG stream
+derived from (seed, src, dst), so a scenario replayed with the same
+seed makes the same drop/delay/duplicate decisions in the same per-link
+order.  The decision log (`decisions()`) is the replayable schedule.
+
+Delivery is two-stage: a timer thread pops due messages off a heap and
+hands them to the destination endpoint's inbox; one dispatcher thread
+per endpoint invokes the receiving connection's on_receive, so one
+stalled node cannot freeze the rest of the network.  Per-channel
+in-flight caps mirror MConnection's bounded send queues: try_send
+returns False at the cap (and the drop is counted), a blocking send
+parks until the receiver drains — which is exactly the backpressure a
+flooding peer must feel.
+
+Chaos seams (libs/fail.py): `vnet.deliver` fires on every submitted
+frame (raise = the frame is dropped as chaos loss), `vnet.reorder`
+fires whenever a reorder decision triggers, `vnet.partition` fires on
+every partition/heal transition.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import heapq
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tendermint_tpu.libs import fail, trace
+from tendermint_tpu.libs.metrics import NetMetrics
+
+SEND_TIMEOUT_S = 10.0       # blocking-send park bound (MConnection parity)
+DEFAULT_CAPACITY = 100      # per-channel in-flight cap without a descriptor
+
+
+@dataclass
+class LinkPolicy:
+    """Per-directed-link fault policy.  All fields compose; `down`
+    short-circuits everything else."""
+    down: bool = False
+    drop: float = 0.0            # iid drop probability [0, 1]
+    latency_s: float = 0.0       # fixed one-way delay
+    jitter_s: float = 0.0        # + uniform(0, jitter) per message
+    dup: float = 0.0             # duplicate-delivery probability
+    reorder: float = 0.0         # probability of +reorder_window_s delay
+    reorder_window_s: float = 0.05
+    bandwidth_bps: float = 0.0   # bytes/s serialization cap; 0 = infinite
+
+    def merged(self, **overrides) -> "LinkPolicy":
+        vals = {f.name: getattr(self, f.name) for f in fields(self)}
+        vals.update(overrides)
+        return LinkPolicy(**vals)
+
+
+class _Endpoint:
+    """One attachable network address: the registered switch (rebinds
+    across node restarts), its inbox, and the live connections."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.switch = None
+        self.ready = False
+        self._cond = threading.Condition()
+        self.inbox: collections.deque = collections.deque()
+        self.conns: set = set()
+        self.dispatcher_started = False
+
+    def push(self, item):
+        with self._cond:
+            self.inbox.append(item)
+            self._cond.notify()
+
+
+class VirtualConnection:
+    """One side of an in-memory peer link.  Mirrors the MConnection
+    surface the Switch/Peer/reactors use (send/try_send/start/stop);
+    `remote` is the twin on the other endpoint.  All mutable transfer
+    state (in-flight counts) lives in the VirtualNetwork under its
+    condition; this object only carries identity + handlers."""
+
+    _ids = itertools.count(1)
+
+    # frames arriving before bind() buffer here (the dial window where
+    # the remote switch's add_peer hooks already send while the dialer
+    # side has not bound its handlers yet); beyond the bound they drop
+    PREBIND_BUFFER = 1024
+
+    def __init__(self, net: "VirtualNetwork", src: _Endpoint,
+                 dst: _Endpoint, channels):
+        self.net = net
+        self.src = src
+        self.dst = dst
+        self.conn_id = next(self._ids)
+        self.caps: Dict[int, int] = {
+            c.id: c.send_queue_capacity for c in channels}
+        self.pending: Dict[int, int] = {c.id: 0 for c in channels}
+        self.remote: Optional["VirtualConnection"] = None
+        self._closed = threading.Event()
+        self._bind_lock = threading.Lock()
+        self._started = False
+        self._prebind: List[tuple] = []
+        self._on_receive: Optional[Callable[[int, bytes], None]] = None
+        self._on_error: Optional[Callable[[Exception], None]] = None
+
+    def bind(self, on_receive, on_error) -> "VirtualConnection":
+        with self._bind_lock:
+            self._on_error = on_error
+            self._on_receive = on_receive
+        return self
+
+    # -- MConnection surface ----------------------------------------------
+
+    def start(self):
+        """Open live delivery and flush frames buffered since the dial
+        window.  The Switch calls start() only AFTER the peer is in its
+        table and every reactor saw add_peer — the MConnection
+        'sends queue until start drains them' contract — so a frame
+        that raced the handshake is delivered to a fully-known peer,
+        never dropped.  Flush happens under the bind lock, which
+        _deliver also takes, so a live frame cannot overtake the
+        backlog."""
+        with self._bind_lock:
+            cb = self._on_receive
+            if cb is not None:
+                for ch_id, msg in self._prebind:
+                    cb(ch_id, msg)
+            self._prebind = []
+            self._started = True
+
+    def stop(self):
+        """Local close: stop accepting sends and (once in-flight frames
+        drain) fail the remote side, the in-memory analog of FIN."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self.net._conn_closed(self)
+
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def send(self, ch_id: int, msg: bytes, block: bool = True) -> bool:
+        if self._closed.is_set():
+            return False
+        if ch_id not in self.caps:
+            raise ValueError(f"unknown channel {ch_id:#x}")
+        return self.net._submit(self, ch_id, bytes(msg), block)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.send(ch_id, msg, block=False)
+
+    # -- delivery side (dispatcher thread) --------------------------------
+
+    def _deliver(self, ch_id: int, msg: bytes):
+        if self._closed.is_set():
+            return
+        with self._bind_lock:
+            if not self._started:
+                if len(self._prebind) < self.PREBIND_BUFFER:
+                    self._prebind.append((ch_id, msg))
+                return
+            cb = self._on_receive
+        if cb is not None:
+            cb(ch_id, msg)
+
+    def _fail(self, exc: Exception):
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self.net._forget(self)
+        cb = self._on_error
+        if cb is not None:
+            cb(exc)
+
+
+class VirtualTransport:
+    """The Switch-facing handle: `listen` binds a switch to the address,
+    `dial` performs the in-memory handshake (NodeInfo checks + peer
+    registration on BOTH switches)."""
+
+    def __init__(self, net: "VirtualNetwork", addr: str):
+        self.net = net
+        self.addr = addr
+
+    def listen(self, switch):
+        self.net._bind(self.addr, switch)
+
+    def close(self):
+        self.net._unbind(self.addr)
+
+    def dial(self, switch, addr: str, persistent: bool = False):
+        return self.net._dial(switch, self.addr, addr, persistent)
+
+
+class VirtualNetwork:
+    """The process-wide delivery engine.  start()/stop() bracket the
+    timer + dispatcher threads; endpoints persist across node restarts
+    so a restarted Node can rebind the same address."""
+
+    def __init__(self, seed: int = 0, metrics_registry=None,
+                 record_decisions: bool = True,
+                 default_policy: Optional[LinkPolicy] = None):
+        self.seed = seed
+        self.metrics = NetMetrics(metrics_registry)
+        self._cond = threading.Condition()
+        self._endpoints: Dict[str, _Endpoint] = {}
+        self._policies: Dict[Tuple[str, str], LinkPolicy] = {}
+        self._default = default_policy or LinkPolicy()
+        self._groups: Optional[List[set]] = None
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._msg_idx: Dict[Tuple[str, str], int] = {}
+        self._link_free_t: Dict[Tuple[str, str], float] = {}
+        self._link_last_due: Dict[Tuple[str, str], float] = {}
+        self._pair_locks: Dict[Tuple[str, str], threading.Lock] = {}
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._running = False
+        self._stopped = False
+        self._threads: List[threading.Thread] = []
+        self._decisions = (collections.deque(maxlen=262144)
+                           if record_decisions else None)
+        self.dropped: Dict[str, int] = collections.Counter()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        with self._cond:
+            if self._running:
+                return self
+            if self._stopped:
+                # dispatcher threads died with stop() and are not
+                # revived; build a fresh engine instead of restarting
+                raise RuntimeError("VirtualNetwork is one-shot: "
+                                   "stopped engines do not restart")
+            self._running = True
+        self._spawn(self._timer_routine, name="vnet-timer")
+        return self
+
+    def stop(self):
+        with self._cond:
+            self._running = False
+            self._stopped = True
+            self._cond.notify_all()
+        for ep in list(self._endpoints.values()):
+            with ep._cond:
+                ep._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _spawn(self, fn, *args, name: str = "") -> threading.Thread:
+        t = threading.Thread(target=fn, args=args, daemon=True,
+                             name=name or "vnet")
+        self._threads.append(t)
+        t.start()
+        return t
+
+    # -- endpoints ---------------------------------------------------------
+
+    def transport(self, addr: str) -> VirtualTransport:
+        with self._cond:
+            ep = self._endpoints.get(addr)
+            if ep is None:
+                ep = self._endpoints[addr] = _Endpoint(addr)
+            start_dispatcher = not ep.dispatcher_started
+            ep.dispatcher_started = True
+        if start_dispatcher:
+            self._spawn(self._dispatch_routine, ep,
+                        name=f"vnet-dispatch-{addr}")
+        return VirtualTransport(self, addr)
+
+    def _bind(self, addr: str, switch):
+        with self._cond:
+            ep = self._endpoints.get(addr)
+            if ep is None:
+                raise ValueError(f"no endpoint {addr!r} (use transport())")
+            ep.switch = switch
+            ep.ready = True
+
+    def _unbind(self, addr: str):
+        with self._cond:
+            ep = self._endpoints.get(addr)
+            if ep is None:
+                return
+            ep.ready = False
+            ep.switch = None
+
+    # -- faults ------------------------------------------------------------
+
+    def set_link(self, src: str, dst: str, **policy):
+        """Set the directed src -> dst policy (asymmetric faults: set
+        only one direction for a one-way drop)."""
+        with self._cond:
+            self._policies[(src, dst)] = self._default.merged(**policy)
+            self._cond.notify_all()
+
+    def clear_links(self):
+        with self._cond:
+            self._policies.clear()
+            self._cond.notify_all()
+
+    def set_partition(self, *groups):
+        """Partition the network into address groups: frames flow only
+        within a group.  Addresses in no group form one implicit
+        residual group together."""
+        fail.inject("vnet.partition")
+        with self._cond:
+            self._groups = [set(g) for g in groups] if groups else None
+            n = len(self._groups) if self._groups else 0
+            self.metrics.partitions_active.set(n)
+
+    def heal(self):
+        """Lift the partition (link policies set via set_link stay)."""
+        fail.inject("vnet.partition")
+        with self._cond:
+            self._groups = None
+            self.metrics.partitions_active.set(0)
+            self._cond.notify_all()
+
+    def partitioned(self, a: str, b: str) -> bool:
+        with self._cond:
+            return self._cut_locked(a, b)
+
+    def _cut_locked(self, a: str, b: str) -> bool:
+        if self._groups is None:
+            return False
+
+        def group_of(x):
+            for i, g in enumerate(self._groups):
+                if x in g:
+                    return i
+            return -1  # residual group
+        return group_of(a) != group_of(b)
+
+    def break_link(self, a: str, b: str):
+        """Abruptly fail every live connection between two addresses
+        (both directions) — the crash/reset fault, as opposed to a
+        partition which leaves connections up but silent."""
+        conns = []
+        with self._cond:
+            for addr in (a, b):
+                ep = self._endpoints.get(addr)
+                if ep is None:
+                    continue
+                other = b if addr == a else a
+                conns.extend(c for c in list(ep.conns)
+                             if c.dst.addr == other)
+        for c in conns:
+            self._drop_conn(c, ConnectionResetError("vnet link broken"))
+
+    def _drop_conn(self, conn: VirtualConnection, exc: Exception):
+        with self._cond:
+            conn.src.conns.discard(conn)
+        conn.src.push(("fail", conn, exc))
+
+    # -- dialing -----------------------------------------------------------
+
+    def _dial(self, switch, src_addr: str, dst_addr: str,
+              persistent: bool):
+        # serialize dials per unordered pair: a simultaneous cross-dial
+        # (A dials B while B dials A — guaranteed at a full-mesh boot)
+        # would otherwise interleave the two registrations so that BOTH
+        # outbound sides hit the duplicate-peer check and BOTH unwinds
+        # tear down the other's surviving inbound peer, leaving zero
+        # connections.  Serialized, the winner completes both
+        # registrations and the loser fails cleanly at its FIRST
+        # (remote) registration with nothing to unwind.
+        pair = (min(src_addr, dst_addr), max(src_addr, dst_addr))
+        with self._cond:
+            plock = self._pair_locks.get(pair)
+            if plock is None:
+                plock = self._pair_locks[pair] = threading.Lock()
+        with plock:
+            return self._dial_locked(switch, src_addr, dst_addr,
+                                     persistent)
+
+    def _dial_locked(self, switch, src_addr: str, dst_addr: str,
+                     persistent: bool):
+        with self._cond:
+            remote_ep = self._endpoints.get(dst_addr)
+            local_ep = self._endpoints.get(src_addr)
+            if remote_ep is None or not remote_ep.ready \
+                    or remote_ep.switch is None:
+                raise ConnectionRefusedError(
+                    f"vnet: nothing listening on {dst_addr!r}")
+            if local_ep is None:
+                raise ConnectionRefusedError(
+                    f"vnet: dialer has no endpoint {src_addr!r}")
+            if self._cut_locked(src_addr, dst_addr):
+                raise ConnectionRefusedError(
+                    f"vnet: {src_addr!r} -> {dst_addr!r} partitioned")
+            remote_sw = remote_ep.switch
+        out_conn = VirtualConnection(self, local_ep, remote_ep,
+                                     switch._descriptors)
+        in_conn = VirtualConnection(self, remote_ep, local_ep,
+                                    remote_sw._descriptors)
+        out_conn.remote = in_conn
+        in_conn.remote = out_conn
+        # inbound side first; unwind it if the dialer-side registration
+        # fails (duplicate peer, max peers)
+        rpeer = remote_sw._register_peer(
+            switch.node_info(), lambda r, e: in_conn.bind(r, e),
+            outbound=False, persistent=False)
+        try:
+            peer = switch._register_peer(
+                remote_sw.node_info(), lambda r, e: out_conn.bind(r, e),
+                outbound=True, persistent=persistent)
+        except Exception:
+            remote_sw.stop_peer_for_error(rpeer, "vnet dial unwound")
+            raise
+        with self._cond:
+            local_ep.conns.add(out_conn)
+            remote_ep.conns.add(in_conn)
+        return peer
+
+    def connect_raw(self, a_addr: str, b_addr: str, channels,
+                    on_a=None, on_b=None):
+        """A bound connection pair with no Switch — the scripted-traffic
+        entry tests and benches use to exercise link policies and prove
+        schedule determinism without booting nodes."""
+        ta, tb = self.transport(a_addr), self.transport(b_addr)
+        with self._cond:
+            ea = self._endpoints[ta.addr]
+            eb = self._endpoints[tb.addr]
+        conn_a = VirtualConnection(self, ea, eb, channels)
+        conn_b = VirtualConnection(self, eb, ea, channels)
+        conn_a.remote, conn_b.remote = conn_b, conn_a
+        conn_a.bind(on_a or (lambda c, m: None), lambda e: None)
+        conn_b.bind(on_b or (lambda c, m: None), lambda e: None)
+        conn_a.start()
+        conn_b.start()
+        with self._cond:
+            ea.conns.add(conn_a)
+            eb.conns.add(conn_b)
+        return conn_a, conn_b
+
+    # -- transfer ----------------------------------------------------------
+
+    def _link_rng(self, key: Tuple[str, str]) -> random.Random:
+        rng = self._rngs.get(key)
+        if rng is None:
+            h = hashlib.sha256(
+                f"{self.seed}|{key[0]}|{key[1]}".encode()).digest()
+            rng = self._rngs[key] = random.Random(
+                int.from_bytes(h[:8], "big"))
+        return rng
+
+    def _record(self, key, idx, ch_id, size, verdict, delay_s):
+        if self._decisions is not None:
+            self._decisions.append(
+                (key[0], key[1], idx, ch_id, size, verdict,
+                 round(delay_s * 1e6)))
+
+    def decisions(self) -> list:
+        """The replayable schedule: per-link fault decisions in link
+        order — identical across runs with the same seed and the same
+        per-link send sequences."""
+        return list(self._decisions or ())
+
+    def _forget(self, conn: VirtualConnection):
+        """Drop a dead connection from its endpoint's live set (stop()
+        and _fail() both route here, so a conn that died via remote
+        reset cannot linger in _Endpoint.conns forever)."""
+        with self._cond:
+            conn.src.conns.discard(conn)
+
+    def _drop(self, key, idx, ch_id, size, reason):
+        self._record(key, idx, ch_id, size, f"drop:{reason}", 0.0)
+        with self._cond:  # re-entrant: _submit's branches hold the cond
+            self.dropped[reason] += 1
+        self.metrics.msgs_dropped.inc(reason=reason)
+
+    def _submit(self, conn: VirtualConnection, ch_id: int, msg: bytes,
+                block: bool) -> bool:
+        key = (conn.src.addr, conn.dst.addr)
+        try:
+            # outside the condition: a latency-mode injection stalls only
+            # this sender, never the delivery engine
+            fail.inject("vnet.deliver")
+        except fail.InjectedFault:
+            with self._cond:
+                idx = self._msg_idx[key] = self._msg_idx.get(key, 0) + 1
+                # consume this message's four rolls anyway so chaos
+                # does not shift the stream for later messages
+                rng = self._link_rng(key)
+                for _ in range(4):
+                    rng.random()
+            self._drop(key, idx, ch_id, len(msg), "chaos")
+            return True
+        deadline = time.monotonic() + SEND_TIMEOUT_S
+        with self._cond:
+            # index assignment and EVERY rng draw happen atomically
+            # here, before anything can release the condition: message
+            # idx on a link always consumes the same four rolls of its
+            # (seed, src, dst) stream, so the decision schedule is a
+            # pure function of per-link send order — the seed-replay
+            # contract — regardless of how sender threads interleave
+            # around the backpressure wait below
+            idx = self._msg_idx[key] = self._msg_idx.get(key, 0) + 1
+            policy = self._policies.get(key, self._default)
+            rng = self._link_rng(key)
+            drop_roll, jitter_roll, dup_roll, reorder_roll = (
+                rng.random(), rng.random(), rng.random(), rng.random())
+            if policy.down or self._cut_locked(*key):
+                # a partitioned link swallows frames silently (TCP into
+                # the void); the sender keeps believing it queued them
+                self._drop(key, idx, ch_id, len(msg), "partition")
+                return True
+            if policy.drop > 0.0 and drop_roll < policy.drop:
+                self._drop(key, idx, ch_id, len(msg), "loss")
+                return True
+            copies = 2 if (policy.dup > 0.0
+                           and dup_roll < policy.dup) else 1
+            reorder_hit = (policy.reorder > 0.0
+                           and reorder_roll < policy.reorder)
+        if reorder_hit:
+            try:
+                fail.inject("vnet.reorder")
+            except fail.InjectedFault:
+                self._drop(key, idx, ch_id, len(msg), "chaos")
+                return True
+        with self._cond:
+            # capacity wait, delay finalization and the pending
+            # increment share ONE critical section: re-checking the cap
+            # in a separate acquisition would let N concurrent senders
+            # all pass and push in-flight counts past the cap
+            cap = conn.caps.get(ch_id, DEFAULT_CAPACITY)
+            while conn.pending.get(ch_id, 0) >= cap:
+                if not block:
+                    self._drop(key, idx, ch_id, len(msg), "backpressure")
+                    return False
+                if conn.closed() or not self._running:
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.2))
+            delay = policy.latency_s
+            if policy.jitter_s > 0.0:
+                delay += policy.jitter_s * jitter_roll
+            now = time.monotonic()
+            if policy.bandwidth_bps > 0.0:
+                free = max(self._link_free_t.get(key, now), now)
+                free += len(msg) / policy.bandwidth_bps
+                self._link_free_t[key] = free
+                delay += free - now
+            if reorder_hit:
+                delay += policy.reorder_window_s
+            conn.pending[ch_id] = conn.pending.get(ch_id, 0) + copies
+            last_due = now + delay + (copies - 1) * 1e-4
+            self._link_last_due[key] = max(
+                self._link_last_due.get(key, 0.0), last_due)
+            for c in range(copies):
+                heapq.heappush(
+                    self._heap,
+                    (now + delay + c * 1e-4, next(self._seq),
+                     conn, ch_id, msg))
+            self._cond.notify_all()
+        verdict = "deliver" + ("+dup" if copies == 2 else "") \
+            + ("+reorder" if reorder_hit else "")
+        self._record(key, idx, ch_id, len(msg), verdict, delay)
+        return True
+
+    def _conn_closed(self, conn: VirtualConnection):
+        with self._cond:
+            conn.src.conns.discard(conn)
+        remote = conn.remote
+        if remote is None or remote.closed():
+            return
+        # ordered after anything already scheduled on this link: the
+        # FIN must not overtake an in-flight frame that drew extra
+        # jitter/reorder/bandwidth delay, so it lands strictly after
+        # the link's last scheduled delivery
+        key = (conn.src.addr, conn.dst.addr)
+        with self._cond:
+            now = time.monotonic()
+            policy = self._policies.get(key, self._default)
+            due = max(now + policy.latency_s,
+                      self._link_last_due.get(key, 0.0) + 1e-4)
+            heapq.heappush(self._heap, (due, next(self._seq), conn, -1,
+                                        b""))
+            self._cond.notify_all()
+
+    # -- delivery threads --------------------------------------------------
+
+    def _timer_routine(self):
+        while True:
+            batch = []
+            with self._cond:
+                if not self._running:
+                    return
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    batch.append(heapq.heappop(self._heap))
+                if not batch:
+                    timeout = 0.2
+                    if self._heap:
+                        timeout = min(timeout, self._heap[0][0] - now)
+                    self._cond.wait(max(timeout, 0.0005))
+                    continue
+            for _due, _seq, conn, ch_id, msg in batch:
+                if ch_id < 0:
+                    remote = conn.remote
+                    if remote is not None:
+                        conn.dst.push(
+                            ("fail", remote,
+                             ConnectionResetError("vnet peer closed")))
+                else:
+                    conn.dst.push(("msg", conn, ch_id, msg))
+
+    def _dispatch_routine(self, ep: _Endpoint):
+        while True:
+            with ep._cond:
+                while not ep.inbox:
+                    # lock-free running check: never acquire the engine
+                    # condition (rank 15) under the inbox condition (22)
+                    if not self._running:
+                        return
+                    ep._cond.wait(0.2)
+                item = ep.inbox.popleft()
+            if item[0] == "fail":
+                _, conn, exc = item
+                try:
+                    conn._fail(exc)
+                except Exception:  # noqa: BLE001 - engine must survive
+                    pass
+                continue
+            _, conn, ch_id, msg = item
+            remote = conn.remote
+            with trace.span("vnet.deliver", src=conn.src.addr,
+                            dst=conn.dst.addr, ch=ch_id, size=len(msg)):
+                try:
+                    if remote is not None:
+                        remote._deliver(ch_id, msg)
+                except Exception:  # noqa: BLE001 - receiver errors are
+                    pass           # the switch's job, not the network's
+            with self._cond:
+                conn.pending[ch_id] = max(
+                    0, conn.pending.get(ch_id, 0) - 1)
+                self._cond.notify_all()

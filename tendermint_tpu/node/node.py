@@ -175,7 +175,7 @@ class Node(BaseService):
     a BaseService like the reference's node)."""
 
     def __init__(self, config: Config, app, genesis: Optional[GenesisDoc]
-                 = None, in_memory: bool = False):
+                 = None, in_memory: bool = False, transport=None):
         super().__init__("node")
         from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.proxy import AppConns, ClientCreator
@@ -319,7 +319,8 @@ class Node(BaseService):
         # -- p2p switch + reactors (node.go:908-936) -------------------
         self.switch = Switch(self.node_key, cfg.p2p.laddr,
                              network=self.genesis.chain_id,
-                             moniker=cfg.moniker, p2p_config=cfg.p2p)
+                             moniker=cfg.moniker, p2p_config=cfg.p2p,
+                             transport=transport)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.mempool_reactor = MempoolReactor(self.mempool,
                                               gate=self.ingress_gate)

@@ -8,6 +8,7 @@ persistent peers with exponential backoff.
 """
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
@@ -146,10 +147,22 @@ class Peer:
 
 
 class Switch(BaseService):
+    # persistent-peer reconnect schedule: exponential backoff from BASE
+    # to MAX with multiplicative jitter in [0.5, 1.5) so a whole mesh
+    # restarting never re-dials in lockstep (reference p2p/switch.go
+    # reconnectToPeer's randomized backoff)
+    RECONNECT_BASE_S = 1.0
+    RECONNECT_MAX_S = 60.0
+
     def __init__(self, node_key: NodeKey, listen_addr: str, network: str,
                  moniker: str = "", version: str = "0.1.0",
-                 metrics_registry=None, p2p_config=None):
+                 metrics_registry=None, p2p_config=None, transport=None):
         super().__init__("switch")
+        # in-memory transport seam (networks/vnet.py, ADR-019): when
+        # set, the switch never touches sockets — listen registers with
+        # the virtual network and dials route through transport.dial,
+        # which lands back in _register_peer like a TCP handshake
+        self._transport = transport
         # operator knobs (reference config/config.go P2PConfig); None
         # keeps the defaults for direct construction in tests
         self._send_rate = getattr(p2p_config, "send_rate", 5_120_000)
@@ -203,9 +216,10 @@ class Switch(BaseService):
         switch can be cleanly retried.  A reactor already started by its
         owner keeps running (start here would be an AlreadyStarted
         error)."""
-        host, port = self.listen_addr.rsplit(":", 1)
-        self._listener = socket.create_server((host, int(port)))
-        self._listener.settimeout(0.5)
+        if self._transport is None:
+            host, port = self.listen_addr.rsplit(":", 1)
+            self._listener = socket.create_server((host, int(port)))
+            self._listener.settimeout(0.5)
         started = []
         try:
             for r in self.reactors.values():
@@ -215,17 +229,27 @@ class Switch(BaseService):
         except Exception:
             for r in started:
                 r.stop()
-            self._listener.close()
-            self._listener = None
+            if self._listener is not None:
+                self._listener.close()
+                self._listener = None
             raise
-        self.spawn(self._accept_routine, name="switch-accept")
+        if self._transport is not None:
+            # bind LAST: an inbound virtual dial must find every
+            # reactor running, mirroring the TCP bind-then-accept order
+            self._transport.listen(self)
+        else:
+            self.spawn(self._accept_routine, name="switch-accept")
 
     def actual_listen_addr(self) -> str:
+        if self._transport is not None:
+            return self._transport.addr
         host, port = self._listener.getsockname()[:2]
         return f"{host}:{port}"
 
     def on_stop(self):
         """Reference p2p/switch.go:234 OnStop: stop peers, then reactors."""
+        if self._transport is not None:
+            self._transport.close()
         if self._listener is not None:
             self._listener.close()
         with self._lock:
@@ -253,12 +277,9 @@ class Switch(BaseService):
         expected_id = None
         if "@" in addr:
             expected_id, addr = addr.split("@", 1)
-        host, port = addr.rsplit(":", 1)
         try:
-            sock = socket.create_connection((host, int(port)),
-                                            timeout=self._dial_timeout)
-            peer = self._handshake(sock, outbound=True, persistent=persistent)
-        except Exception as e:  # noqa: BLE001
+            peer = self._dial_once(addr, persistent=persistent)
+        except Exception:  # noqa: BLE001
             if persistent:
                 self._schedule_reconnect(addr, expected_id)
             return None
@@ -270,6 +291,17 @@ class Switch(BaseService):
             peer.data["dial_addr"] = addr
         return peer
 
+    def _dial_once(self, addr: str, persistent: bool = False) \
+            -> Optional[Peer]:
+        """One dial attempt over the active transport (raises on
+        failure): virtual network when injected, TCP otherwise."""
+        if self._transport is not None:
+            return self._transport.dial(self, addr, persistent=persistent)
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self._dial_timeout)
+        return self._handshake(sock, outbound=True, persistent=persistent)
+
     def _schedule_reconnect(self, addr: str, expected_id):
         key = f"{expected_id}@{addr}" if expected_id else addr
         with self._lock:
@@ -278,18 +310,26 @@ class Switch(BaseService):
             self._reconnecting.add(key)
 
         def routine():
-            backoff = 1.0
+            rng = random.Random()
+            backoff = self.RECONNECT_BASE_S
             try:
                 while not self.quitting.is_set():
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 60.0)
-                    peer = None
+                    # jittered sleep, capped: a flapping link must not
+                    # converge a whole mesh onto one re-dial beat, and
+                    # backoff must never grow past RECONNECT_MAX_S
+                    if self.quitting.wait(backoff * (0.5 + rng.random())):
+                        return
+                    backoff = min(backoff * 2, self.RECONNECT_MAX_S)
+                    # the peer may have reconnected INBOUND while this
+                    # routine slept: dialing again would only bounce off
+                    # the duplicate-peer check forever (a leaked entry
+                    # that re-dials every backoff) — observe and retire
+                    if expected_id is not None:
+                        with self._lock:
+                            if expected_id in self.peers:
+                                return
                     try:
-                        host, port = addr.rsplit(":", 1)
-                        sock = socket.create_connection(
-                            (host, int(port)), timeout=10)
-                        peer = self._handshake(sock, outbound=True,
-                                               persistent=True)
+                        peer = self._dial_once(addr, persistent=True)
                     except Exception:  # noqa: BLE001
                         continue
                     if peer is not None:
@@ -297,7 +337,8 @@ class Switch(BaseService):
             finally:
                 with self._lock:
                     self._reconnecting.discard(key)
-        threading.Thread(target=routine, daemon=True).start()
+        threading.Thread(target=routine, daemon=True,
+                         name="switch-reconnect").start()
 
     def _handshake_inbound(self, sock: socket.socket):
         try:
@@ -318,16 +359,27 @@ class Switch(BaseService):
         sock.settimeout(None)
         if their_info.node_id != sconn.remote_node_id:
             raise ValueError("node id does not match secret-connection key")
+
+        def make_conn(on_receive, on_error):
+            return MConnection(sconn, self._descriptors, on_receive,
+                               on_error, send_rate=self._send_rate,
+                               recv_rate=self._recv_rate)
+        return self._register_peer(their_info, make_conn, outbound,
+                                   persistent)
+
+    def _register_peer(self, their_info: NodeInfo, make_conn,
+                       outbound: bool, persistent: bool) -> Peer:
+        """The post-handshake half of peer admission, shared by the TCP
+        path and in-memory transports (networks/vnet.py): compatibility
+        and identity checks, connection construction via `make_conn
+        (on_receive, on_error)`, peer-table insert (dup/max re-checked
+        under the lock AT insert, so two racing handshakes with the same
+        peer cannot both land), reactor introductions, then start."""
         incompat = self.node_info().compatible_with(their_info)
         if incompat is not None:
             raise ValueError(f"incompatible peer: {incompat}")
         if their_info.node_id == self.node_key.node_id:
             raise ValueError("self connection")
-        with self._lock:
-            if their_info.node_id in self.peers:
-                raise ValueError("duplicate peer")
-            if len(self.peers) >= self.max_peers:
-                raise ValueError("too many peers")
 
         peer_box: List[Optional[Peer]] = [None]
 
@@ -348,14 +400,20 @@ class Switch(BaseService):
             if peer is not None:
                 self.stop_peer_for_error(peer, e)
 
-        mconn = MConnection(sconn, self._descriptors, on_receive, on_error,
-                            send_rate=self._send_rate,
-                            recv_rate=self._recv_rate)
+        mconn = make_conn(on_receive, on_error)
         peer = Peer(their_info, mconn, outbound, persistent)
         peer_box[0] = peer
         with self._lock:
-            self.peers[peer.id] = peer
-            self._metrics.peers.set(len(self.peers))
+            dup = peer.id in self.peers
+            full = not dup and len(self.peers) >= self.max_peers
+            if not dup and not full:
+                self.peers[peer.id] = peer
+                self._metrics.peers.set(len(self.peers))
+        if dup or full:
+            # outside the lock: closing the connection may reach into
+            # the transport engine (vnet) or block on a socket close
+            mconn.stop()
+            raise ValueError("duplicate peer" if dup else "too many peers")
         self.log.info("added peer", peer=peer.id,
                       addr=their_info.listen_addr, outbound=outbound)
         # introduce the peer to every reactor BEFORE the recv thread can

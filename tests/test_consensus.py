@@ -244,3 +244,74 @@ def test_late_own_precommit_from_earlier_round_not_fatal():
         assert tmpl.type == SignedMsgType.PRECOMMIT
     finally:
         node.stop()
+
+
+# ---------------------------------------------------------------------------
+# TimeoutTicker: schedule-replaces-schedule + stop-while-armed (the
+# harness's proposer-kill scenarios lean on "newest schedule wins")
+# ---------------------------------------------------------------------------
+
+def _ti(duration, height=1, round_=0):
+    from tendermint_tpu.consensus.round_types import Step, TimeoutInfo
+    return TimeoutInfo(duration=duration, height=height, round=round_,
+                       step=Step.PROPOSE)
+
+
+def test_ticker_newer_schedule_replaces_older():
+    """Two schedules racing: only the NEWER TimeoutInfo may deliver,
+    even though the older timer had the shorter duration and was armed
+    first."""
+    from tendermint_tpu.consensus.ticker import TimeoutTicker
+    fired = []
+    t = TimeoutTicker(fired.append)
+    try:
+        t.schedule(_ti(0.3, height=1))      # stale: replaced below
+        t.schedule(_ti(0.05, height=2))     # newest wins
+        deadline = time.time() + 5
+        while not fired and time.time() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.4)  # past the stale timer's duration
+        assert [ti.height for ti in fired] == [2], fired
+    finally:
+        t.stop()
+
+
+def test_ticker_stale_fire_is_dropped():
+    """The cancel() race made deterministic: a timer whose callback has
+    already been invoked cannot be cancelled, so _fire must drop by
+    generation.  Simulate the raced thread by calling _fire with the
+    superseded generation directly."""
+    from tendermint_tpu.consensus.ticker import TimeoutTicker
+    fired = []
+    t = TimeoutTicker(fired.append)
+    try:
+        t.schedule(_ti(60.0, height=1))
+        stale_gen = t._gen
+        t.schedule(_ti(60.0, height=2))
+        # the stale timer's callback finally runs, after replacement
+        t._fire(_ti(60.0, height=1), stale_gen)
+        assert fired == []
+        # the current generation still delivers
+        t._fire(_ti(60.0, height=2), t._gen)
+        assert [ti.height for ti in fired] == [2]
+    finally:
+        t.stop()
+
+
+def test_ticker_stop_while_armed():
+    """stop() with a pending timer: nothing fires, even via the
+    already-queued-callback race, and later schedules are no-ops."""
+    from tendermint_tpu.consensus.ticker import TimeoutTicker
+    fired = []
+    t = TimeoutTicker(fired.append)
+    t.schedule(_ti(0.05, height=1))
+    armed_gen = t._gen
+    t.stop()
+    time.sleep(0.2)
+    assert fired == []
+    # a callback that was already past cancel() when stop() ran
+    t._fire(_ti(0.05, height=1), armed_gen)
+    assert fired == []
+    t.schedule(_ti(0.01, height=3))  # schedule-after-stop: no-op
+    time.sleep(0.1)
+    assert fired == [] and t._timer is None

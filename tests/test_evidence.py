@@ -251,3 +251,43 @@ def test_reactor_gates_evidence_on_peer_height():
                           .max_age_num_blocks)
     reactor._send_pending(far)
     assert not far.got
+
+
+def test_duplicate_vote_verify_scheduler_parity():
+    """ISSUE 11 satellite: the two vote signatures route through
+    crypto/scheduler.verify_items at COMMIT priority — the verdict and
+    the which-vote-failed attribution must be bitmap-exact vs the
+    scheduler-less direct path, for good and for tampered votes."""
+    from tendermint_tpu.crypto import scheduler as vsched
+
+    gdoc, privs = make_genesis(4)
+    state = state_from_genesis(gdoc)
+    vals = state.validators
+    v1, v2 = _dup_votes(privs[0])
+
+    def outcomes():
+        out = []
+        ev = DuplicateVoteEvidence.from_votes(
+            v1, v2, Timestamp(1700000005, 0), vals)
+        verify_duplicate_vote(ev, CHAIN, vals)  # both good: no raise
+        out.append("ok")
+        for tamper, expect in (("vote_a", "VoteA"), ("vote_b", "VoteB")):
+            bad = copy.deepcopy(ev)
+            getattr(bad, tamper).signature = bytes(64)
+            with pytest.raises(EvidenceError) as ei:
+                verify_duplicate_vote(bad, CHAIN, vals)
+            assert expect in str(ei.value)
+            out.append(str(ei.value))
+        return out
+
+    assert vsched.running() is None
+    direct = outcomes()  # scheduler absent: direct BatchVerifier path
+
+    sched = vsched.install(vsched.VerifyScheduler(window_s=0.002))
+    sched.start()
+    try:
+        via_sched = outcomes()  # same triples through the scheduler
+    finally:
+        sched.stop()
+        vsched.uninstall(sched)
+    assert via_sched == direct

@@ -393,7 +393,7 @@ def f(rt):
 
 CHAOS_TEST_FILES = ("test_chaos_matrix.py", "test_comb.py",
                     "test_degrade.py", "test_ingress.py",
-                    "test_latency_observatory.py",
+                    "test_latency_observatory.py", "test_netharness.py",
                     "test_pipeline.py", "test_scheduler.py")
 
 
