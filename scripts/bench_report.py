@@ -554,6 +554,33 @@ def config10_mempool(n_threads=6, n_per=200):
             "total": r["total"]}
 
 
+def config11_consensus(validators=4, heights=8):
+    """Consensus block interval (consensus/observatory.py, ADR-020):
+    a real 4-node vnet network committing real blocks, host-only by
+    design.  Columns mirror the BENCH_CONSENSUS=1 bench.py line:
+    interval p50/p99 plus the dominant stage decomposition, so a
+    proposer/gossip regression shows up as a column move, not a
+    mystery."""
+    from bench import run_consensus_interval
+
+    r = run_consensus_interval(validators=validators, heights=heights)
+    st = r["stages"]
+
+    def _p99(stage):
+        return st.get(stage, {}).get("p99_ms")
+
+    return {"config": f"11: consensus interval {validators} nodes",
+            "interval_p50_ms": r["interval_p50_ms"],
+            "interval_p99_ms": r["interval_p99_ms"],
+            "propose_p99_ms": _p99("propose"),
+            "gossip_p99_ms": _p99("gossip"),
+            "prevote_wait_p99_ms": _p99("prevote_wait"),
+            "precommit_wait_p99_ms": _p99("precommit_wait"),
+            "commit_p99_ms": _p99("commit"),
+            "apply_p99_ms": _p99("apply"),
+            "commit_skew_max_ms": r["commit_skew_max_ms"]}
+
+
 def main():
     import json
 
@@ -573,7 +600,8 @@ def main():
     print(f"# platform={platform} {cpu_line}", flush=True)
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
-           config8_scheduler, config9_comb, config10_mempool)
+           config8_scheduler, config9_comb, config10_mempool,
+           config11_consensus)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

@@ -229,6 +229,8 @@ def replay_window(executor, store, state, blocks: List[Block],
 
 
 def _apply_one(executor, store, state, block, bid, parts, cert):
+    from tendermint_tpu.consensus import observatory as obsv
+
     if store is not None:
         h = block.header.height
         if store.height() >= h:
@@ -244,4 +246,9 @@ def _apply_one(executor, store, state, block, bid, parts, cert):
         else:
             store.save_block(block, parts, cert)
     new_state, _resp = executor.apply_block(state, bid, block)
+    # drain the observatory's deferred publication per applied height:
+    # during catch-up the consensus receive loop (the usual drainer)
+    # isn't running yet, and apply_block just completed this height's
+    # record (ADR-020)
+    obsv.publish_pending()
     return new_state

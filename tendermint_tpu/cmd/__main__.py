@@ -411,6 +411,43 @@ def cmd_debug_latency(args):
         print(json.dumps(json.loads(body), indent=2))
 
 
+def cmd_debug_consensus(args):
+    """Snapshot the running node's consensus observatory
+    (consensus/observatory.py, ADR-020) via its pprof listener's
+    GET /debug/consensus — the last N heights' block-lifecycle stage
+    decompositions (propose / gossip / prevote-wait / precommit-wait /
+    commit / apply / persist), per-peer part/vote receipt accounting,
+    and the cross-node skew report when several in-process nodes share
+    the recorder."""
+    import urllib.request
+
+    addr = args.pprof_laddr
+    if not addr:
+        cfg = Config.load(_home(args))
+        cfg.home = _home(args)
+        addr = cfg.rpc.pprof_laddr
+    if not addr:
+        raise SystemExit(
+            "no pprof listener: pass --pprof-laddr or set [rpc] "
+            "pprof_laddr in config.toml (the observatory records by "
+            "default; TM_TPU_OBSERVATORY=0 disables it)")
+    url = f"http://{addr}/debug/consensus?last={args.last}"
+    if args.node:
+        url += f"&node={args.node}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        n = sum(len(v) for v in (doc.get("nodes") or {}).values())
+        print(f"wrote consensus observatory report ({n} height "
+              f"records) to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
 def cmd_debug_kill(args):
     """Reference cmd debug kill: take a dump, then kill the node."""
     import signal
@@ -708,6 +745,18 @@ def main(argv=None):
                     help="pprof listener (default: [rpc] pprof_laddr)")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_latency)
+    sp = sub.add_parser("debug-consensus",
+                        help="snapshot the node's consensus "
+                             "observatory (per-height stage "
+                             "decomposition + cross-node skew)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--last", type=int, default=16,
+                    help="newest N height records per node")
+    sp.add_argument("--node", default="",
+                    help="restrict to one node name (harness runs)")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_consensus)
     sp = sub.add_parser("debug-kill",
                         help="collect a diagnostic tarball, then SIGTERM "
                              "the node")

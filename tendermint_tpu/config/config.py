@@ -239,17 +239,27 @@ class SLOConfig:
     windowed p50/p99 and (when a target is set) the error-budget burn
     rate.  Targets are p99 objectives in MILLISECONDS; 0 = track the
     quantiles but no target (no burn-rate gauge)."""
+    # the per-priority verify streams (ADR-016) plus the consensus
+    # observatory's height-lifecycle streams (ADR-020: block_interval,
+    # propose, quorum_prevote, apply)
+    STREAMS = ("consensus", "commit", "blocksync", "mempool",
+               "block_interval", "propose", "quorum_prevote", "apply")
+
     enable: bool = False
     window: int = 1024
     consensus_p99_ms: float = 0.0
     commit_p99_ms: float = 0.0
     blocksync_p99_ms: float = 0.0
     mempool_p99_ms: float = 0.0
+    block_interval_p99_ms: float = 0.0
+    propose_p99_ms: float = 0.0
+    quorum_prevote_p99_ms: float = 0.0
+    apply_p99_ms: float = 0.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
         out = {}
-        for stream in ("consensus", "commit", "blocksync", "mempool"):
+        for stream in self.STREAMS:
             ms = getattr(self, f"{stream}_p99_ms")
             if ms > 0:
                 out[stream] = ms / 1000.0
@@ -258,7 +268,7 @@ class SLOConfig:
     def validate_basic(self):
         if self.window <= 0:
             raise ValueError("slo.window must be positive")
-        for stream in ("consensus", "commit", "blocksync", "mempool"):
+        for stream in self.STREAMS:
             if getattr(self, f"{stream}_p99_ms") < 0:
                 raise ValueError(f"slo.{stream}_p99_ms must be >= 0")
 
@@ -432,6 +442,10 @@ consensus_p99_ms = {self.slo.consensus_p99_ms}
 commit_p99_ms = {self.slo.commit_p99_ms}
 blocksync_p99_ms = {self.slo.blocksync_p99_ms}
 mempool_p99_ms = {self.slo.mempool_p99_ms}
+block_interval_p99_ms = {self.slo.block_interval_p99_ms}
+propose_p99_ms = {self.slo.propose_p99_ms}
+quorum_prevote_p99_ms = {self.slo.quorum_prevote_p99_ms}
+apply_p99_ms = {self.slo.apply_p99_ms}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -532,10 +546,8 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
         cfg.slo = SLOConfig(
             enable=bool(sl.get("enable", False)),
             window=int(sl.get("window", 1024)),
-            consensus_p99_ms=float(sl.get("consensus_p99_ms", 0.0)),
-            commit_p99_ms=float(sl.get("commit_p99_ms", 0.0)),
-            blocksync_p99_ms=float(sl.get("blocksync_p99_ms", 0.0)),
-            mempool_p99_ms=float(sl.get("mempool_p99_ms", 0.0)))
+            **{f"{s}_p99_ms": float(sl.get(f"{s}_p99_ms", 0.0))
+               for s in SLOConfig.STREAMS})
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

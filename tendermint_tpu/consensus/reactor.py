@@ -21,6 +21,7 @@ from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.basic import SignedMsgType
 from tendermint_tpu.types.vote import Vote
 
+from . import observatory as obsv
 from .messages import (DATA_CHANNEL, STATE_CHANNEL, VOTE_CHANNEL,
                        VOTE_SET_BITS_CHANNEL,
                        BlockPartGossip, HasVoteMessage, NewRoundStepMessage,
@@ -225,10 +226,19 @@ class ConsensusReactor(Reactor):
             if isinstance(msg, ProposalGossip):
                 self.cs.set_proposal(msg.proposal, peer_id=peer.id)
             elif isinstance(msg, BlockPartGossip):
+                # receipt accounting at the wire seam (before the
+                # receive queue, so queue wait is visible against the
+                # state machine's own stamps): which peer delivered
+                # which height's parts/votes (ADR-020).  The reference
+                # block_parts{peer_id} counter increments in the state
+                # machine, gated on the part actually being ADDED
+                obsv.receipt(self.cs.name, msg.height, "part", peer.id)
                 self.cs.add_block_part(msg.height, msg.round, msg.part,
                                        peer_id=peer.id)
         elif ch_id == VOTE_CHANNEL:
             if isinstance(msg, VoteGossip):
+                obsv.receipt(self.cs.name, msg.vote.height, "vote",
+                             peer.id)
                 self.cs.add_vote(msg.vote, peer_id=peer.id)
 
     def _vote_set_size(self, height: int) -> int:

@@ -40,6 +40,7 @@ from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.types.vote_set import (
     ConflictingVoteError, VoteSet, VoteSetError)
 
+from . import observatory as obsv
 from .config import ConsensusConfig
 from .round_types import (
     BlockPartMessage, HeightVoteSet, ProposalMessage, RoundState, Step,
@@ -70,6 +71,9 @@ class ConsensusState(BaseService):
                              if priv_validator else None)
         self.event_bus = event_bus
         self.name = name or "consensus"
+        # the executor's apply stamps must land on the same observatory
+        # node key this state machine stamps under (ADR-020)
+        block_exec.obs_node = self.name
         from tendermint_tpu.libs import log as tmlog
         self.log = tmlog.logger("consensus").with_(node=name) if name \
             else tmlog.logger("consensus")
@@ -79,6 +83,10 @@ class ConsensusState(BaseService):
 
         self._peer_queue: "queue.Queue" = queue.Queue(maxsize=5000)
         self._internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        # per-height memo of quorum stamps already taken (mutated only
+        # under _mtx; cleared at every height transition) — post-quorum
+        # vote storms skip the observatory entirely
+        self._obs_stamped: set = set()
         self._ticker = TimeoutTicker(self._on_ticker_timeout)
         self._thread: Optional[threading.Thread] = None
         self._mtx = threading.RLock()
@@ -238,6 +246,13 @@ class ConsensusState(BaseService):
                 with self._mtx:
                     for msg, peer_id in batch:
                         self._handle_msg(msg, peer_id)
+                # observatory publication happens HERE, after the state
+                # mutex releases: stamps taken while handling only
+                # record (one leaf lock); histograms/SLO/gauges for
+                # heights completed this iteration publish outside any
+                # consensus-critical lock (the scheduler's PR 6
+                # discipline, docs/adr/adr-020)
+                obsv.publish_pending()
             except Exception:  # noqa: BLE001 - consensus failure is fatal
                 traceback.print_exc()
                 # reference panics with "CONSENSUS FAILURE!!!"
@@ -456,6 +471,11 @@ class ConsensusState(BaseService):
         new_rs.last_commit = last_precommits
         self.rs = new_rs
         self.state = state
+        # the height's lifecycle record opens here: everything from
+        # this stamp to the commit stamp is the block interval the
+        # observatory decomposes (consensus/observatory.py, ADR-020)
+        self._obs_stamped.clear()
+        obsv.stamp(self.name, height, "new_height")
 
     def _enter_new_round(self, height: int, round_: int):
         rs = self.rs
@@ -520,6 +540,9 @@ class ConsensusState(BaseService):
         rs.round = round_
         rs.step = Step.PROPOSE
         self._new_step()
+        if obsv.is_enabled():
+            obsv.stamp(self.name, height, "propose_start", round_=round_,
+                       proposer=rs.validators.get_proposer().address.hex())
         self._schedule_timeout(self.config.propose(round_), height, round_,
                                Step.PROPOSE)
         if self.priv_validator is None or self.priv_pub_key is None:
@@ -571,6 +594,8 @@ class ConsensusState(BaseService):
         for fn in self.broadcast_block_part:
             for i in range(parts.header().total):
                 fn(height, round_, parts.get_part(i))
+        obsv.stamp(self.name, height, "proposal_signed", round_=round_,
+                   parts_total=parts.header().total)
 
     def _commit_for_proposal(self, height: int) -> Optional[Commit]:
         if height == self.state.initial_height:
@@ -618,6 +643,10 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(psh)
+        ts = proposal.timestamp
+        obsv.stamp(self.name, rs.height, "proposal", round_=rs.round,
+                   proposal_ts=ts.seconds + ts.nanos * 1e-9,
+                   proposal_round=rs.round)
 
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str):
         rs = self.rs
@@ -628,12 +657,25 @@ class ConsensusState(BaseService):
         added = rs.proposal_block_parts.add_part(msg.part)
         if not added:
             return
+        if peer_id:
+            # reference consensus/metrics.go BlockParts: counted when
+            # the part is actually ADDED, per delivering peer — a
+            # replayed duplicate or wrong-height part moves nothing
+            self.metrics.block_parts.inc(peer_id=peer_id)
+        if ("first_part",) not in self._obs_stamped:
+            # one-shot via the same memo the quorum stamps use: parts
+            # 2..N of a block must not pay even the leaf lock
+            self._obs_stamped.add(("first_part",))
+            obsv.stamp(self.name, rs.height, "first_part",
+                       round_=msg.round)
         if (rs.proposal_block_parts.byte_size
                 > self.state.consensus_params.block.max_bytes):
             raise ValueError(
                 f"total size of proposal block parts exceeds maximum "
                 f"({self.state.consensus_params.block.max_bytes})")
         if rs.proposal_block_parts.is_complete():
+            obsv.stamp(self.name, rs.height, "parts_complete",
+                       round_=msg.round)
             data = rs.proposal_block_parts.assemble()
             block = Block.from_proto(data)
             if (rs.proposal is not None
@@ -801,6 +843,7 @@ class ConsensusState(BaseService):
         rs.commit_round = commit_round
         rs.commit_time = time.time()
         self._new_step()
+        obsv.stamp(self.name, height, "commit", round_=commit_round)
 
         if rs.locked_block is not None \
                 and rs.locked_block.hash() == block_id.hash:
@@ -936,9 +979,32 @@ class ConsensusState(BaseService):
             self.event_bus.publish_vote(vote)
 
         height = rs.height
+        # quorum stamps: stamp() is first-write-wins per stage, so the
+        # vote that tips 2/3 records exactly once (with ITS wall
+        # timestamp — the reference QuorumPrevoteDelay origin
+        # semantics).  _obs_stamped memoizes per (kind, round) under
+        # the state mutex so the storm of post-quorum votes skips even
+        # the observatory's leaf lock
+        obs_on = obsv.is_enabled()
         if vote.type == SignedMsgType.PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)
             block_id, has_maj = prevotes.two_thirds_majority()
+            if obs_on and ("pv_any", vote.round) not in \
+                    self._obs_stamped and prevotes.has_two_thirds_any():
+                self._obs_stamped.add(("pv_any", vote.round))
+                obsv.stamp(self.name, height, "prevote_any",
+                           round_=vote.round)
+            if obs_on and has_maj and not block_id.is_zero() \
+                    and ("pv_q", vote.round) not in self._obs_stamped:
+                self._obs_stamped.add(("pv_q", vote.round))
+                ts = vote.timestamp
+                if obsv.stamp(self.name, height, "prevote_quorum",
+                              round_=vote.round,
+                              prevote_quorum_ts=ts.seconds
+                              + ts.nanos * 1e-9,
+                              prevote_quorum_round=vote.round):
+                    trace.instant("consensus.quorum", type="prevote",
+                                  height=height, round=vote.round)
             if has_maj:
                 # POL unlock (reference :2130-2147)
                 if (rs.locked_block is not None
@@ -979,6 +1045,13 @@ class ConsensusState(BaseService):
         elif vote.type == SignedMsgType.PRECOMMIT:
             precommits = rs.votes.precommits(vote.round)
             block_id, has_maj = precommits.two_thirds_majority()
+            if obs_on and has_maj and not block_id.is_zero() \
+                    and ("pc_q", vote.round) not in self._obs_stamped:
+                self._obs_stamped.add(("pc_q", vote.round))
+                if obsv.stamp(self.name, height, "precommit_quorum",
+                              round_=vote.round):
+                    trace.instant("consensus.quorum", type="precommit",
+                                  height=height, round=vote.round)
             if has_maj:
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit(height, vote.round)

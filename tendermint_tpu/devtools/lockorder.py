@@ -112,6 +112,11 @@ LOCK_ORDER = {
     "tendermint_tpu/libs/kvdb.py:SQLiteDB._lock": 69,
     "tendermint_tpu/libs/autofile.py:Group._lock": 70,
     "tendermint_tpu/libs/flowrate.py:Monitor._lock": 72,
+    # consensus observatory ring (consensus/observatory.py, ADR-020):
+    # a leaf — stamp()/receipt() take it alone (fail.inject runs
+    # BEFORE acquisition), and publish_pending() releases it before
+    # touching slo (76) or the metrics locks (80/84)
+    "tendermint_tpu/consensus/observatory.py:Observatory._lock": 74,
     # SLO estimator ring (libs/slo.py, ADR-016): a leaf like the
     # metrics locks — observe() takes it alone, and the read side
     # (stream_report) sorts a snapshot OUTSIDE it

@@ -98,6 +98,12 @@ REGISTERED_SITES = frozenset({
     "vnet.partition",
     "vnet.reorder",
     "harness.step",
+    # consensus observatory (consensus/observatory.py, ADR-020): fires
+    # on every stamp/receipt.  raise = the recording sheds (counted in
+    # consensus_observatory_shed_total{reason=chaos}) while consensus
+    # proceeds untouched — lifecycle telemetry must never be able to
+    # take down the state machine it observes
+    "observatory.record",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

@@ -290,6 +290,20 @@ class ConsensusMetrics:
         self.quorum_prevote_delay = reg.gauge(
             "consensus", "quorum_prevote_delay",
             "Seconds from proposal time to 2/3 prevotes.")
+        # consensus observatory (consensus/observatory.py, ADR-020):
+        # where the block interval goes, per lifecycle stage
+        self.height_stage = reg.histogram(
+            "consensus", "height_stage_seconds",
+            "Per-height block-lifecycle stage durations (propose / "
+            "gossip / prevote_wait / precommit_wait / commit / apply / "
+            "persist / interval), from the consensus observatory.",
+            labels=("stage",),
+            buckets=exp_buckets(0.001, 10 ** 0.5, 10))
+        self.observatory_shed = reg.counter(
+            "consensus", "observatory_shed_total",
+            "Observatory records shed (reason=chaos: a recording fault "
+            "was swallowed; reason=evict: ring overflow).",
+            labels=("reason",))
 
 
 class StateMetrics:
@@ -537,6 +551,22 @@ class NetMetrics:
             "harness", "scenario_failures_total",
             "Scenario runs that failed an invariant gate or step (a "
             "stitched cross-node trace artifact is dumped for each).")
+
+
+class TraceMetrics:
+    """Flight recorder self-observability (libs/trace.py, ADR-011):
+    a wrapped ring silently overwrites its oldest spans by design, but
+    the OVERWRITE must be visible — a trace consumer reading a quiet
+    buffer needs to know whether the system was quiet or the ring
+    lapped it (ISSUE 12 satellite)."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.dropped_spans = reg.counter(
+            "trace", "dropped_spans_total",
+            "Finished spans overwritten by flight-recorder ring "
+            "wraparound since process start (the ring keeps the newest "
+            "window; this counts what it forgot).")
 
 
 class MempoolMetrics:

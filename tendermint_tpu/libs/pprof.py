@@ -22,6 +22,12 @@ image) and with near-zero overhead when idle:
                                windowed SLO quantiles/burn rates and
                                the most recent verify window's
                                per-request lifecycle decomposition
+  GET /debug/consensus?last=N  consensus observatory
+                               (consensus/observatory.py, ADR-020):
+                               the last N heights' block-lifecycle
+                               records and stage decompositions, plus
+                               the cross-node skew report when several
+                               in-process nodes share the recorder
 
 SIGUSR1 installs the same stack dump onto the process logger, so a hung
 node can be inspected with plain `kill -USR1` even when the HTTP
@@ -152,6 +158,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(trace.chrome_trace(since),
                                            default=str),
                            ctype="application/json")
+            elif url.path == "/debug/consensus":
+                # the consensus observatory (ADR-020): the last N
+                # heights' lifecycle records + stage decompositions,
+                # and (when several in-process nodes share the module
+                # global) the cross-node skew report.  Reading flushes
+                # deferred publication so the metrics surfaces agree
+                # with the JSON.  Lazy import: the pprof listener must
+                # stay importable without the consensus stack
+                from tendermint_tpu.consensus import observatory as obsv
+                q = parse_qs(url.query)
+                last = int(q.get("last", ["16"])[0])
+                node = q.get("node", [None])[0]
+                obsv.publish_pending()
+                body = obsv.report(node=node, last=last)
+                if len(body.get("nodes", {})) > 1:
+                    body["skew"] = obsv.skew_report()
+                self._send(200, json.dumps(body, default=str),
+                           ctype="application/json")
             elif url.path == "/debug/latency":
                 # the latency observatory (ADR-016): windowed SLO
                 # quantiles/burn rates + the most recent scheduler
@@ -173,7 +197,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, "pprof routes: /debug/stacks "
                                 "/debug/threads /debug/profile?seconds=N "
                                 "/debug/gc /debug/trace?since=N "
-                                "/debug/latency\n")
+                                "/debug/latency "
+                                "/debug/consensus?last=N\n")
         except Exception as e:  # noqa: BLE001 - debug surface never fatal
             self._send(500, f"error: {e}\n")
 

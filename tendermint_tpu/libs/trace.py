@@ -74,7 +74,7 @@ KNOWN_SPANS = frozenset({
     "ingress.recheck",
     # consensus/state.py
     "consensus.finalize_commit", "consensus.preverify",
-    "consensus.step", "consensus.vote",
+    "consensus.quorum", "consensus.step", "consensus.vote",
     # ops/ — kernel routing
     "msm.route", "ops.ed25519.verify_batch", "table_build",
     # state/pipeline.py — the block application pipeline (ADR-017)
@@ -174,6 +174,8 @@ class Tracer:
         self._lock = threading.Lock()
         self._buf: "collections.deque" = collections.deque(maxlen=capacity)
         self._seq = 0
+        self._dropped = 0          # spans lost to ring wraparound
+        self._drop_counter = None  # lazy TraceMetrics handle
         self._ids = itertools.count(1)
         self._tls = threading.local()
 
@@ -248,11 +250,40 @@ class Tracer:
                 parent_id, attrs):
         with self._lock:
             self._seq += 1
+            wrapped = len(self._buf) == self._buf.maxlen
+            if wrapped:
+                self._dropped += 1
             self._buf.append({
                 "seq": self._seq, "name": name, "ph": ph, "ts_ns": t0_ns,
                 "dur_ns": dur_ns, "tid": tid, "tname": tname,
                 "id": span_id, "parent": parent_id, "attrs": attrs,
             })
+        if wrapped:
+            # counter inc AFTER releasing: the metric locks rank BELOW
+            # the tracer lock (lockorder 80/84 < 90), so publishing
+            # under self._lock would be a real inversion
+            self._publish_drop()
+
+    def _publish_drop(self):
+        c = self._drop_counter
+        if c is None:
+            try:
+                from tendermint_tpu.libs.metrics import TraceMetrics
+                c = TraceMetrics().dropped_spans
+            except Exception:  # noqa: BLE001 - observability of the
+                c = False       # observer must never take down a span
+            self._drop_counter = c
+        if c is not False:
+            try:
+                c.inc()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def dropped(self) -> int:
+        """Spans lost to ring wraparound since construction (a wrapped
+        ring can no longer masquerade as a quiet system)."""
+        with self._lock:
+            return self._dropped
 
     # -- export ------------------------------------------------------------
 
@@ -297,7 +328,7 @@ class Tracer:
                 ev["s"] = "t"
             events.append(ev)
         return {"traceEvents": events, "displayTimeUnit": "ms",
-                "last_seq": last}
+                "last_seq": last, "dropped_spans": self.dropped()}
 
     def export_file(self, path: str, since: int = 0) -> str:
         """Write the Chrome-trace JSON to `path`; returns `path`.
@@ -358,6 +389,10 @@ def snapshot(since: int = 0):
 
 def last_seq() -> int:
     return TRACER.last_seq()
+
+
+def dropped() -> int:
+    return TRACER.dropped()
 
 
 def chrome_trace(since: int = 0):

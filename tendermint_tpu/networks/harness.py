@@ -232,6 +232,18 @@ class NetHarness:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "NetHarness":
+        # fresh observatory rings for this run: node names (node0..N)
+        # and heights restart per scenario, and the process-global
+        # recorder is the harness's per-node timeline source now
+        # (ADR-020) — stale records from a previous scenario would
+        # first-write-win over this run's stamps.  Force-enable: the
+        # failure artifact's timeline and the block-interval bench both
+        # READ these records, so an inherited TM_TPU_OBSERVATORY=0
+        # must not silently empty them
+        from tendermint_tpu.consensus import observatory as obsv
+        self._obs_was_enabled = obsv.is_enabled()
+        obsv.reset()
+        obsv.enable()
         self.net.start()
         for hn in self.nodes:
             hn.start()
@@ -253,6 +265,12 @@ class NetHarness:
             except Exception:  # noqa: BLE001 - best-effort teardown
                 pass
         self.net.stop()
+        # restore the observatory's pre-start enabled flag: the
+        # force-enable is scoped to the run, not the process (records
+        # stay readable until the next harness start resets them)
+        if not getattr(self, "_obs_was_enabled", True):
+            from tendermint_tpu.consensus import observatory as obsv
+            obsv.disable()
 
     def running_nodes(self) -> List[HarnessNode]:
         return [hn for hn in self.nodes if hn.running]
@@ -278,7 +296,6 @@ class NetHarness:
                 found.extend(self.watcher.observe(name, node))
             except Exception:  # noqa: BLE001 - a mid-stop node is not
                 continue       # an invariant violation
-        self.watcher.sample(self.heights())
         return found
 
     # -- faults ------------------------------------------------------------

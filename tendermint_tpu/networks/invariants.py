@@ -27,8 +27,7 @@ from __future__ import annotations
 
 import json
 import os
-import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tendermint_tpu.libs import trace
@@ -66,10 +65,6 @@ class ChainWatcher:
         self._by_height: Dict[int, tuple] = {}   # h -> (hash, first node)
         self._cursors: Dict[str, int] = {}
         self.violations: List[Violation] = []
-        self.timeline: List[tuple] = []          # (t, {node: height})
-
-    def sample(self, heights: Dict[str, int]):
-        self.timeline.append((time.monotonic(), dict(heights)))
 
     def observe(self, name: str, node) -> List[Violation]:
         """Validate the node's newly committed heights; returns (and
@@ -153,10 +148,21 @@ def export_artifact(workdir: str, scenario: str, seed: int,
                     error: Optional[str] = None) -> dict:
     """Stitch the run into replay artifacts.  Returns the paths dict;
     the JSON timeline is always written, the Chrome-trace span dump
-    only when the flight recorder is enabled."""
+    only when the flight recorder is enabled.
+
+    The per-node height timelines come from the consensus observatory
+    (consensus/observatory.py, ADR-020) — every node's per-height
+    lifecycle stamps on one monotonic clock, replacing the 4 Hz
+    store-height polling PR 11 shipped — together with the cross-node
+    skew report (the same height's stamps compared across nodes: how
+    far apart did the proposal land, the parts complete, the commit
+    fire)."""
+    from tendermint_tpu.consensus import observatory as obsv
+
     os.makedirs(workdir, exist_ok=True)
     base = os.path.join(workdir, f"scenario-{scenario}-seed{seed}")
     timeline_path = base + ".json"
+    obsv.publish_pending()
     payload = {
         "scenario": scenario,
         "seed": seed,
@@ -164,8 +170,11 @@ def export_artifact(workdir: str, scenario: str, seed: int,
         "steps": steps_log,
         "violations": [v.as_dict() for v in watcher.violations],
         "nodes": nodes_summary,
-        "timeline": [
-            {"t": t, "heights": hs} for t, hs in watcher.timeline],
+        # per-node block-lifecycle timelines: every height the
+        # observatory ring still holds, stamps + stage decomposition
+        "observatory": {
+            n: obsv.records(n) for n in obsv.OBS.nodes()},
+        "skew": obsv.skew_report(),
         # the replayable fault schedule: (src, dst, link msg idx,
         # channel, size, verdict, delay_us)
         "vnet_decisions": [list(d) for d in decisions],

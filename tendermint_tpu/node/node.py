@@ -505,6 +505,10 @@ class Node(BaseService):
             self._block_pipeline = blockpipe.set_config(
                 enable=True, depth=bp.depth,
                 group_commit_heights=bp.group_commit_heights)
+            # the writer's group-commit durable acks must land on the
+            # same consensus-observatory node key the state machine
+            # stamps under (ADR-020 persist stage)
+            self._block_pipeline.obs_node = self.consensus.name
             self.log.info("block pipeline started", depth=bp.depth,
                           group_commit_heights=bp.group_commit_heights)
         # latency SLO estimator (libs/slo.py, ADR-016): window +
@@ -514,6 +518,13 @@ class Node(BaseService):
         slo.set_config(enabled=self.config.slo.enable,
                        window=self.config.slo.window,
                        targets=self.config.slo.targets_s())
+        # register the flight-recorder bundle up front so
+        # trace_dropped_spans_total renders 0 on /metrics from boot —
+        # the tracer itself only touches it lazily on the first ring
+        # wraparound, and "no such series" must not be confusable with
+        # "no drops" (ADR-020 satellite)
+        from tendermint_tpu.libs.metrics import TraceMetrics
+        TraceMetrics()
         # mempool ingress gate (ADR-018): start AFTER the verify
         # scheduler so the worker's MEMPOOL-class pre-verification can
         # route through it from the first batch

@@ -57,6 +57,11 @@ def validator_updates_to_validators(updates) -> List[Validator]:
 
 
 class BlockExecutor:
+    # node name the consensus observatory keys this executor's apply
+    # stamps under (node.py sets the moniker; bare test executors
+    # record under "" — harmless, the ring is bounded)
+    obs_node = ""
+
     def __init__(self, state_store, app: abci.Application, mempool=None,
                  evidence_pool=None, event_bus=None, block_store=None,
                  metrics_registry=None):
@@ -177,10 +182,18 @@ class BlockExecutor:
 
     def apply_block(self, state: State, block_id: BlockID,
                     block: Block) -> Tuple[State, ABCIResponses]:
-        with trace.span("state.apply_block",
-                        height=block.header.height,
+        # observatory apply stamps bracket the same region as the
+        # trace span (the acceptance test pins them against each
+        # other); apply_done on clean exit only, like
+        # block_processing_time
+        from tendermint_tpu.consensus import observatory as obsv
+        h = block.header.height
+        obsv.stamp(self.obs_node, h, "apply_start")
+        with trace.span("state.apply_block", height=h,
                         txs=len(block.data.txs)):
-            return self._apply_block(state, block_id, block)
+            out = self._apply_block(state, block_id, block)
+        obsv.stamp(self.obs_node, h, "apply_done")
+        return out
 
     def _apply_block(self, state: State, block_id: BlockID,
                      block: Block) -> Tuple[State, ABCIResponses]:

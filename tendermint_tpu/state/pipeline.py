@@ -101,12 +101,15 @@ class _Staged:
 
 
 class _WriteJob:
-    __slots__ = ("gen", "height", "groups")
+    __slots__ = ("gen", "height", "groups", "base")
 
-    def __init__(self, gen, height, groups):
+    def __init__(self, gen, height, groups, base=None):
         self.gen = gen
         self.height = height          # last height covered by the job
         self.groups = groups          # ordered [(GroupCommitDB, group)]
+        # first height covered (durable-stamp attribution; defaults to
+        # the last height for callers that don't track a window base)
+        self.base = height if base is None else base
 
 
 class BlockPipeline(BaseService):
@@ -157,6 +160,10 @@ class BlockPipeline(BaseService):
         self._stage_timeout_s = _STAGE_TIMEOUT_S
         self.windows_pipelined = 0
         self.windows_degraded = 0
+        # node name the consensus observatory keys the writer's
+        # group-commit durable stamps under (node.py sets the moniker;
+        # bare test pipelines record under "" — harmless)
+        self.obs_node = ""
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -247,10 +254,13 @@ class BlockPipeline(BaseService):
                     applied += 1
                     since_commit += 1
                     if gdbs and since_commit >= self.group_commit_heights:
-                        self._enqueue_group(gen, gdbs, h)
+                        self._enqueue_group(gen, gdbs, h,
+                                            base=h - since_commit + 1)
                         since_commit = 0
                 if not faulted:
-                    self._finish_window(gen, gdbs, base_h + applied - 1)
+                    last_h = base_h + applied - 1
+                    self._finish_window(gen, gdbs, last_h,
+                                        base=last_h - since_commit + 1)
             except PipelineFault:
                 faulted = True
             if not faulted:
@@ -346,7 +356,8 @@ class BlockPipeline(BaseService):
                 staged.ok = False
         return staged.ok
 
-    def _enqueue_group(self, gen: int, gdbs, height: int):
+    def _enqueue_group(self, gen: int, gdbs, height: int,
+                       base: Optional[int] = None):
         """Hand the current buffered generation of every store to the
         async writer as one ordered job.  Writer fault or backpressure
         timeout degrades the window (caller drains synchronously)."""
@@ -361,7 +372,7 @@ class BlockPipeline(BaseService):
                 groups.append((gdb, g))
         if not groups:
             return
-        job = _WriteJob(gen, height, groups)
+        job = _WriteJob(gen, height, groups, base=base)
         try:
             self._write_q.put(job, timeout=_WRITE_ENQ_TIMEOUT_S)
         except queue.Full:
@@ -369,13 +380,14 @@ class BlockPipeline(BaseService):
         with self._cond:
             self._jobs_enqueued += 1
 
-    def _finish_window(self, gen: int, gdbs, last_height: int):
+    def _finish_window(self, gen: int, gdbs, last_height: int,
+                       base: Optional[int] = None):
         """End-of-window barrier: enqueue the tail group, wait for the
         writer to drain, surface any writer fault as a PipelineFault
         (the finally-drain then recovers synchronously)."""
         if not gdbs:
             return
-        self._enqueue_group(gen, gdbs, last_height)
+        self._enqueue_group(gen, gdbs, last_height, base=base)
         deadline = time.monotonic() + _WRITE_ENQ_TIMEOUT_S
         with self._cond:
             while (self._jobs_done < self._jobs_enqueued
@@ -505,6 +517,7 @@ class BlockPipeline(BaseService):
                 self._jobs_done += 1
                 if err is not None and self._write_fault is None:
                     self._write_fault = err
+                prev_durable = self._durable_height
                 if err is None and not faulted:
                     self._durable_height = max(self._durable_height,
                                                job.height)
@@ -512,6 +525,19 @@ class BlockPipeline(BaseService):
                 self._cond.notify_all()
             if err is None and not faulted:
                 self._metrics.group_commit_seconds.observe(dt)
+                # group-commit durable ack for every height this job
+                # newly made durable (the observatory's `persist`
+                # stage, ADR-020) — stamped and published holding
+                # nothing.  job.base bounds attribution to the heights
+                # the group actually covered: prev_durable alone would
+                # mint junk records below the first group of a run
+                from tendermint_tpu.consensus import observatory as obsv
+                if obsv.is_enabled():
+                    t_ack = time.monotonic()
+                    for h in range(max(prev_durable + 1, job.base),
+                                   job.height + 1):
+                        obsv.stamp(self.obs_node, h, "durable", t=t_ack)
+                    obsv.publish_pending()
         # shutdown: surrender queued jobs without committing — their
         # groups stay tracked in the gdbs and the window's drain/flush
         # owns them now; marking them done unblocks the drain barrier

@@ -30,6 +30,8 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     cfg.slo.window = 2048
     cfg.slo.consensus_p99_ms = 5.0
     cfg.slo.mempool_p99_ms = 250.0
+    cfg.slo.block_interval_p99_ms = 1500.0  # observatory streams
+    cfg.slo.apply_p99_ms = 40.0             # (ADR-020)
     cfg.mempool.ingress_enable = False     # non-default (ADR-018)
     cfg.mempool.ingress_queue = 321
     cfg.mempool.ingress_workers = 3
@@ -67,8 +69,12 @@ def test_toml_roundtrip_preserves_new_knobs(tmp_path):
     assert back.slo.window == 2048
     assert back.slo.consensus_p99_ms == 5.0
     assert back.slo.mempool_p99_ms == 250.0
+    assert back.slo.block_interval_p99_ms == 1500.0
+    assert back.slo.apply_p99_ms == 40.0
     # only the set targets appear, converted ms -> seconds
-    assert back.slo.targets_s() == {"consensus": 0.005, "mempool": 0.25}
+    assert back.slo.targets_s() == {"consensus": 0.005, "mempool": 0.25,
+                                    "block_interval": 1.5,
+                                    "apply": 0.04}
     # and the shipped defaults survive a round trip too
     assert Config(home=str(tmp_path)).batch_verifier.secp_lane is True
     assert Config(home=str(tmp_path)).slo.enable is False
