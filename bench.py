@@ -124,7 +124,29 @@ def history_record(line: dict, source: str) -> dict:
 def _emit(line: dict):
     """Print the config's ONE JSON line (the driver contract) and
     capture it into bench_history.jsonl immediately — partial-run
-    capture: if a later config wedges, this one is already on disk."""
+    capture: if a later config wedges, this one is already on disk.
+
+    Every line grows a `device` decomposition block (ADR-021): the
+    process's launch walls split into stage/transfer/compute/collect,
+    the compile share of the measured wall (bench_trend's compile-
+    inflation exclusion reads it), the chunk-overlap ratio, the
+    compile-cache entry count and the HBM ledger — so a capture
+    explains where its wall went instead of being one number.  The
+    block covers the whole process deliberately (one config per bench
+    process): a host-only run carries launches=0, and a fallback line
+    emitted AFTER a partial device run keeps the dead attempt's
+    launches — both are the signal (trend exclusion keys on the
+    host-fallback note first, so a dead attempt's compile_frac never
+    reclassifies the line)."""
+    if "device" not in line:
+        try:
+            from tendermint_tpu.crypto import devobs
+            blk = devobs.device_block()
+            if blk:
+                line["device"] = blk
+        except Exception as e:  # noqa: BLE001 - the decomposition is
+            # best-effort garnish; the measured number must still emit
+            print(f"# devobs device block failed: {e}", file=sys.stderr)
     print(json.dumps(line))
     append_history(history_record(line, "bench"))
 

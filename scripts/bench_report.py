@@ -610,11 +610,21 @@ def main():
     # configs (partial-run capture, ROADMAP item 5)
     from bench import append_history, history_record, load_history
     from bench_trend import with_prev_round_delta
+    from tendermint_tpu.crypto import devobs
     history = load_history()
     for fn in fns:
         if only and only not in fn.__name__:
             continue
+        # per-config device decomposition block (ADR-021): only the
+        # launches THIS config dispatched (totals diffed against a
+        # cursor snapshot — interval-exact even past ring rotation), so
+        # a config whose verifies all resolved on the host carries
+        # launches=0 instead of inheriting the previous config's
+        cur0 = devobs.cursor()
         line = with_prev_round_delta(fn(), history)
+        blk = devobs.device_block(since=cur0)
+        if blk.get("launches"):
+            line["device"] = blk
         print(json.dumps(line), flush=True)
         append_history(history_record(line, "bench_report"))
 

@@ -156,15 +156,38 @@ def build_series(rounds: list, history: list) -> dict:
             "rc": 0,
             "vs_baseline": rec.get("vs_baseline"),
             "note": rec.get("note"),
+            # the ADR-021 device decomposition block (when the capture
+            # carried one): compile_frac feeds the compile-inflation
+            # exclusion in trend_rows
+            "device": rec.get("device"),
         })
     return series
+
+
+# first-launch compile share of the measured wall above which a round
+# measures the compiler, not the pipeline (ISSUE 13 satellite: the
+# decomposition finally makes this detectable — compiles run 40-300 s
+# through the tunnel and used to silently deflate a round's number)
+COMPILE_INFLATION_FRAC = 0.10
+
+
+def _compile_frac(o: dict):
+    dev = o.get("device")
+    if isinstance(dev, dict):
+        return dev.get("compile_frac")
+    return None
 
 
 def trend_rows(obs: list, threshold: float) -> list:
     """Delta-vs-previous and regression-vs-best flags for one series.
     Host-fallback captures never count as the best-known value (they
     measure the host, not the pipeline) and are not flagged as
-    regressions — they are capture failures, already called out."""
+    regressions — they are capture failures, already called out.
+    Compile-inflated captures (first-launch compile > 10% of the
+    measured device wall, read from the ADR-021 `device` block) are
+    excluded the same way: they measure the compiler, not the
+    pipeline, and must neither set the best-known bar nor be flagged
+    as regressions against it."""
     rows = []
     best = None
     prev_v = None
@@ -172,6 +195,8 @@ def trend_rows(obs: list, threshold: float) -> list:
         flag = ""
         v = o["value"]
         fallback = o.get("note") and "host fallback" in str(o["note"])
+        cfrac = _compile_frac(o)
+        inflated = cfrac is not None and cfrac > COMPILE_INFLATION_FRAC
         delta = None
         if v is not None and prev_v:
             delta = 100.0 * (v - prev_v) / prev_v
@@ -180,6 +205,9 @@ def trend_rows(obs: list, threshold: float) -> list:
                 else "no value"
         elif fallback:
             flag = "host-fallback (excluded from best)"
+        elif inflated:
+            flag = (f"compile-inflated {100.0 * cfrac:.0f}% of wall "
+                    f"(excluded from best)")
         else:
             if best is not None and v < best * (1.0 - threshold):
                 flag = (f"REGRESSION {100.0 * (1 - v / best):.1f}% "
@@ -189,7 +217,7 @@ def trend_rows(obs: list, threshold: float) -> list:
                 flag = (flag + " " if flag else "") + "best"
         rows.append(dict(o, delta_vs_prev_pct=(
             round(delta, 1) if delta is not None else None), flag=flag))
-        if v is not None and not fallback:
+        if v is not None and not fallback and not inflated:
             prev_v = v
     return rows
 

@@ -349,13 +349,10 @@ def cmd_debug_dump(args):
     print(f"wrote debug dump to {out}")
 
 
-def cmd_debug_trace(args):
-    """Snapshot the running node's flight recorder (libs/trace.py) via
-    its pprof listener's GET /debug/trace and print (or write) the
-    Chrome-trace JSON — load the output into chrome://tracing or
-    ui.perfetto.dev to see the vote -> verify -> commit timeline."""
-    import urllib.request
-
+def _pprof_addr(args, hint: str = "") -> str:
+    """Resolve the pprof listener address for the debug-* commands:
+    --pprof-laddr wins, else the home config's [rpc] pprof_laddr; no
+    listener is a SystemExit with the command's usage hint."""
     addr = args.pprof_laddr
     if not addr:
         cfg = Config.load(_home(args))
@@ -364,8 +361,19 @@ def cmd_debug_trace(args):
     if not addr:
         raise SystemExit(
             "no pprof listener: pass --pprof-laddr or set [rpc] "
-            "pprof_laddr in config.toml (and TM_TPU_TRACE=1 or "
-            "trace.enable() on the node to record spans)")
+            "pprof_laddr in config.toml" + (f" ({hint})" if hint else ""))
+    return addr
+
+
+def cmd_debug_trace(args):
+    """Snapshot the running node's flight recorder (libs/trace.py) via
+    its pprof listener's GET /debug/trace and print (or write) the
+    Chrome-trace JSON — load the output into chrome://tracing or
+    ui.perfetto.dev to see the vote -> verify -> commit timeline."""
+    import urllib.request
+
+    addr = _pprof_addr(args, "and TM_TPU_TRACE=1 or trace.enable() on "
+                             "the node to record spans")
     url = f"http://{addr}/debug/trace?since={args.since}"
     with urllib.request.urlopen(url, timeout=10) as r:
         body = r.read().decode()
@@ -387,16 +395,9 @@ def cmd_debug_latency(args):
     -> stage -> launch -> settle decomposition."""
     import urllib.request
 
-    addr = args.pprof_laddr
-    if not addr:
-        cfg = Config.load(_home(args))
-        cfg.home = _home(args)
-        addr = cfg.rpc.pprof_laddr
-    if not addr:
-        raise SystemExit(
-            "no pprof listener: pass --pprof-laddr or set [rpc] "
-            "pprof_laddr in config.toml (and enable the SLO estimator "
-            "with [slo] enable or TM_TPU_SLO=1 for windowed quantiles)")
+    addr = _pprof_addr(args, "and enable the SLO estimator with [slo] "
+                             "enable or TM_TPU_SLO=1 for windowed "
+                             "quantiles")
     url = f"http://{addr}/debug/latency"
     with urllib.request.urlopen(url, timeout=10) as r:
         body = r.read().decode()
@@ -421,16 +422,8 @@ def cmd_debug_consensus(args):
     the recorder."""
     import urllib.request
 
-    addr = args.pprof_laddr
-    if not addr:
-        cfg = Config.load(_home(args))
-        cfg.home = _home(args)
-        addr = cfg.rpc.pprof_laddr
-    if not addr:
-        raise SystemExit(
-            "no pprof listener: pass --pprof-laddr or set [rpc] "
-            "pprof_laddr in config.toml (the observatory records by "
-            "default; TM_TPU_OBSERVATORY=0 disables it)")
+    addr = _pprof_addr(args, "the observatory records by default; "
+                             "TM_TPU_OBSERVATORY=0 disables it")
     url = f"http://{addr}/debug/consensus?last={args.last}"
     if args.node:
         url += f"&node={args.node}"
@@ -446,6 +439,45 @@ def cmd_debug_consensus(args):
               f"records) to {out}")
     else:
         print(json.dumps(json.loads(body), indent=2))
+
+
+def cmd_debug_device(args):
+    """Snapshot the running node's device observatory
+    (crypto/devobs.py, ADR-021) via its pprof listener's
+    GET /debug/device — the last N device launches' stage/transfer/
+    compute/collect decomposition with chunk-overlap ratios and
+    per-shard row counts, the compile-cache inventory ((kernel, bucket
+    shape) -> compile wall + hit count), and the HBM residency ledger
+    (comb tables / pubkey rows / static comb / in-flight staging)."""
+    import urllib.request
+
+    addr = _pprof_addr(args, "the device observatory records by "
+                             "default; TM_TPU_DEVOBS=0 disables it")
+    url = f"http://{addr}/debug/device?last={args.last}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        print(f"wrote device observatory report "
+              f"({len(doc.get('launches') or [])} launch records, "
+              f"{len(doc.get('compile_cache') or [])} compile-cache "
+              f"entries) to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
+def cmd_debug_index(args):
+    """Print the pprof listener's GET /debug index — every registered
+    debug endpoint with a one-line description, so operators stop
+    guessing URLs."""
+    import urllib.request
+
+    addr = _pprof_addr(args)
+    with urllib.request.urlopen(f"http://{addr}/debug", timeout=10) as r:
+        print(r.read().decode(), end="")
 
 
 def cmd_debug_kill(args):
@@ -757,6 +789,22 @@ def main(argv=None):
                     help="restrict to one node name (harness runs)")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_consensus)
+    sp = sub.add_parser("debug-device",
+                        help="snapshot the node's device observatory "
+                             "(launch decomposition + compile cache + "
+                             "HBM ledger)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--last", type=int, default=16,
+                    help="newest N launch records")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_device)
+    sp = sub.add_parser("debug-index",
+                        help="list the pprof listener's registered "
+                             "debug endpoints")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.set_defaults(fn=cmd_debug_index)
     sp = sub.add_parser("debug-kill",
                         help="collect a diagnostic tarball, then SIGTERM "
                              "the node")

@@ -231,6 +231,24 @@ class BlockPipelineConfig:
 
 
 @dataclass
+class DevObsConfig:
+    """Device observatory (crypto/devobs.py, ADR-021): per-launch
+    transfer/compute/compile decomposition, the compile-cache
+    inventory, and the HBM residency ledger.  ON by default — a few
+    dict stores per launch is noise against a millisecond-scale launch
+    wall; `enable = false` (or TM_TPU_DEVOBS=0 for node-less tooling)
+    makes every record a guaranteed sub-microsecond no-op and removes
+    the explicit H2D/compute brackets from the monolithic launch
+    paths.  `capacity` bounds the launch-record ring."""
+    enable: bool = True
+    capacity: int = 256
+
+    def validate_basic(self):
+        if self.capacity <= 0:
+            raise ValueError("devobs.capacity must be positive")
+
+
+@dataclass
 class SLOConfig:
     """Per-priority latency SLOs for the verify path (libs/slo.py,
     docs/adr/adr-016-latency-observatory.md).  When enabled the node
@@ -241,9 +259,11 @@ class SLOConfig:
     quantiles but no target (no burn-rate gauge)."""
     # the per-priority verify streams (ADR-016) plus the consensus
     # observatory's height-lifecycle streams (ADR-020: block_interval,
-    # propose, quorum_prevote, apply)
+    # propose, quorum_prevote, apply) plus the device observatory's
+    # per-launch wall stream (ADR-021: device_launch)
     STREAMS = ("consensus", "commit", "blocksync", "mempool",
-               "block_interval", "propose", "quorum_prevote", "apply")
+               "block_interval", "propose", "quorum_prevote", "apply",
+               "device_launch")
 
     enable: bool = False
     window: int = 1024
@@ -255,6 +275,7 @@ class SLOConfig:
     propose_p99_ms: float = 0.0
     quorum_prevote_p99_ms: float = 0.0
     apply_p99_ms: float = 0.0
+    device_launch_p99_ms: float = 0.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
@@ -299,13 +320,14 @@ class Config:
     slo: SLOConfig = field(default_factory=SLOConfig)
     block_pipeline: BlockPipelineConfig = field(
         default_factory=BlockPipelineConfig)
+    devobs: DevObsConfig = field(default_factory=DevObsConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
                      "batch_verifier", "verify_scheduler", "slo",
-                     "block_pipeline"):
+                     "block_pipeline", "devobs"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -435,6 +457,10 @@ enable = {str(self.block_pipeline.enable).lower()}
 depth = {self.block_pipeline.depth}
 group_commit_heights = {self.block_pipeline.group_commit_heights}
 
+[devobs]
+enable = {str(self.devobs.enable).lower()}
+capacity = {self.devobs.capacity}
+
 [slo]
 enable = {str(self.slo.enable).lower()}
 window = {self.slo.window}
@@ -446,6 +472,7 @@ block_interval_p99_ms = {self.slo.block_interval_p99_ms}
 propose_p99_ms = {self.slo.propose_p99_ms}
 quorum_prevote_p99_ms = {self.slo.quorum_prevote_p99_ms}
 apply_p99_ms = {self.slo.apply_p99_ms}
+device_launch_p99_ms = {self.slo.device_launch_p99_ms}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -542,6 +569,10 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             enable=bool(bp.get("enable", True)),
             depth=int(bp.get("depth", 4)),
             group_commit_heights=int(bp.get("group_commit_heights", 8)))
+        do = d.get("devobs", {})
+        cfg.devobs = DevObsConfig(
+            enable=bool(do.get("enable", True)),
+            capacity=int(do.get("capacity", 256)))
         sl = d.get("slo", {})
         cfg.slo = SLOConfig(
             enable=bool(sl.get("enable", False)),

@@ -121,6 +121,12 @@ LOCK_ORDER = {
     # metrics locks — observe() takes it alone, and the read side
     # (stream_report) sorts a snapshot OUTSIDE it
     "tendermint_tpu/libs/slo.py:SloEstimator._lock": 76,
+    # device observatory ring (crypto/devobs.py, ADR-021): a leaf —
+    # record()/ledger_* take it alone (fail.inject runs BEFORE
+    # acquisition), and publish_pending() releases it before touching
+    # slo (76... metrics 80/84 — publication runs with the ring lock
+    # dropped, so the lower slo rank is never acquired under it)
+    "tendermint_tpu/crypto/devobs.py:DevObs._lock": 78,
 
     # -- observability: always acquired last, hold nothing --
     "tendermint_tpu/libs/metrics.py:Registry._lock": 80,

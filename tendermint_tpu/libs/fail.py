@@ -104,6 +104,12 @@ REGISTERED_SITES = frozenset({
     # proceeds untouched — lifecycle telemetry must never be able to
     # take down the state machine it observes
     "observatory.record",
+    # device observatory (crypto/devobs.py, ADR-021): fires on every
+    # launch-record store.  raise = the record sheds (counted in
+    # crypto_devobs_shed_total{reason=chaos}) while the device launch
+    # and its bitmap proceed untouched; latency is absorbed into the
+    # recording — the same contract observatory.record proved
+    "devobs.record",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

@@ -516,6 +516,81 @@ class CryptoMetrics:
             "fast as the SLO allows.", labels=("stream",))
 
 
+class DevObsMetrics:
+    """Device observatory (crypto/devobs.py, ADR-021): where a device
+    launch's wall clock goes (host staging / H2D transfer / compute /
+    D2H collect), whether the double-buffered chunk paths actually hide
+    transfer behind compute, what is resident in HBM per pool, and how
+    many (kernel, bucket shape) entries the process has compiled."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.device_stage = reg.histogram(
+            "crypto", "device_stage_seconds",
+            "Host staging share of a device launch (pack / pad / "
+            "challenge hashing), seconds, by dispatch path.",
+            labels=("path",), buckets=exp_buckets(0.0002, 4, 10))
+        self.device_transfer = reg.histogram(
+            "crypto", "device_transfer_seconds",
+            "Host->device transfer share of a device launch, seconds, "
+            "by dispatch path (monolithic paths bracket the device_put "
+            "with block_until_ready; pipelined paths record the summed "
+            "device_put walls).", labels=("path",),
+            buckets=exp_buckets(0.0002, 4, 10))
+        self.device_compute = reg.histogram(
+            "crypto", "device_compute_seconds",
+            "Kernel compute share of a device launch (dispatch -> "
+            "block_until_ready on the results), seconds, by path.",
+            labels=("path",), buckets=exp_buckets(0.0005, 4, 10))
+        self.device_collect = reg.histogram(
+            "crypto", "device_collect_seconds",
+            "Device->host bitmap readback share of a launch, seconds, "
+            "by path.", labels=("path",),
+            buckets=exp_buckets(0.0002, 4, 10))
+        self.device_drain = reg.histogram(
+            "crypto", "device_drain_seconds",
+            "Final blocking wait of a double-buffered launch (residual "
+            "un-hidden compute + D2H readback, merged — these paths "
+            "cannot split compute from collect without serializing the "
+            "pipeline they exist to overlap), seconds, by path.",
+            labels=("path",), buckets=exp_buckets(0.0005, 4, 10))
+        self.chunk_overlap = reg.gauge(
+            "crypto", "device_chunk_overlap_ratio",
+            "Fraction of the most recent double-buffered launch's "
+            "host->device DMA wall issued while a previous chunk's "
+            "kernel was in flight (1 = transfer fully hidden behind "
+            "compute, 0 = serial).")
+        self.shard_imbalance = reg.gauge(
+            "crypto", "device_shard_imbalance",
+            "max/mean real rows per shard of the most recent mesh "
+            "launch (1 = balanced; pad-only shards drag the mean "
+            "down).")
+        self.hbm_resident = reg.gauge(
+            "crypto", "hbm_resident_bytes",
+            "Device-resident bytes per pool (table_cache = comb window "
+            "tables, pub_cache = pubkey rows, base_comb = the static "
+            "basepoint comb, staging = launch staging buffers — "
+            "charged as the double-buffered in-flight window for the "
+            "duration of the launch call; a caller that keeps results "
+            "in flight after a non-blocking launch returns is not "
+            "charged past the call).", labels=("pool",))
+        self.hbm_peak = reg.gauge(
+            "crypto", "hbm_resident_peak_bytes",
+            "High-water mark of crypto_hbm_resident_bytes per pool "
+            "since process start (or the last devobs reset).",
+            labels=("pool",))
+        self.compile_cache_entries = reg.gauge(
+            "crypto", "compile_cache_entries",
+            "Distinct (kernel path, lane bucket, shards) entries in "
+            "the device observatory's compile-cache inventory — the "
+            "shapes this process has paid an XLA/Mosaic compile for.")
+        self.devobs_shed = reg.counter(
+            "crypto", "devobs_shed_total",
+            "Device-observatory records shed (reason=chaos: a "
+            "recording fault was swallowed, the launch proceeded; "
+            "reason=evict: ring/queue overflow).", labels=("reason",))
+
+
 class P2PMetrics:
     """Reference p2p/metrics.go."""
 

@@ -28,6 +28,14 @@ image) and with near-zero overhead when idle:
                                records and stage decompositions, plus
                                the cross-node skew report when several
                                in-process nodes share the recorder
+  GET /debug/device?last=N     device observatory (crypto/devobs.py,
+                               ADR-021): the last N device launches'
+                               transfer/compute/compile decomposition,
+                               the compile-cache inventory, and the
+                               HBM residency ledger
+  GET /debug                   index: every registered debug endpoint
+                               with a one-line description, so
+                               operators stop guessing URLs
 
 SIGUSR1 installs the same stack dump onto the process logger, so a hung
 node can be inspected with plain `kill -USR1` even when the HTTP
@@ -54,6 +62,39 @@ from tendermint_tpu.libs import log as tmlog
 from tendermint_tpu.libs.service import BaseService
 
 _logger = tmlog.logger("pprof")
+
+# the endpoint registry the GET /debug index page (and the debug-index
+# CLI) renders: every route this listener serves, with the one-line
+# description an operator needs to pick the right one.  New endpoints
+# register here — tests assert the index and the handler agree.
+DEBUG_ENDPOINTS = (
+    ("/debug", "this index: every registered debug endpoint"),
+    ("/debug/stacks", "all-thread stack dump (text)"),
+    ("/debug/threads", "thread table (name, ident, daemon, alive)"),
+    ("/debug/profile?seconds=N",
+     "statistical CPU profile: folded stacks for flamegraph tools"),
+    ("/debug/gc", "gc generation counts + uncollectable total"),
+    ("/debug/trace?since=N",
+     "flight recorder snapshot as Chrome-trace/Perfetto JSON (ADR-011)"),
+    ("/debug/latency",
+     "latency observatory: windowed SLO quantiles + verify lifecycle "
+     "decomposition (ADR-016)"),
+    ("/debug/consensus?last=N",
+     "consensus observatory: per-height block-lifecycle stages + "
+     "cross-node skew (ADR-020)"),
+    ("/debug/device?last=N",
+     "device observatory: per-launch transfer/compute/compile "
+     "decomposition, compile-cache inventory, HBM ledger (ADR-021)"),
+)
+
+
+def debug_index_text() -> str:
+    """The index page body: one line per registered endpoint."""
+    width = max(len(p) for p, _ in DEBUG_ENDPOINTS)
+    lines = ["registered debug endpoints:", ""]
+    for path, desc in DEBUG_ENDPOINTS:
+        lines.append(f"  {path.ljust(width)}  {desc}")
+    return "\n".join(lines) + "\n"
 
 
 def format_stacks() -> str:
@@ -129,7 +170,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         try:
-            if url.path == "/debug/stacks":
+            if url.path in ("/debug", "/debug/"):
+                self._send(200, debug_index_text())
+            elif url.path == "/debug/stacks":
                 self._send(200, format_stacks())
             elif url.path == "/debug/threads":
                 rows = [f"{t.ident}\t{t.name}\t"
@@ -176,6 +219,20 @@ class _Handler(BaseHTTPRequestHandler):
                     body["skew"] = obsv.skew_report()
                 self._send(200, json.dumps(body, default=str),
                            ctype="application/json")
+            elif url.path == "/debug/device":
+                # the device observatory (ADR-021): the last N device
+                # launches' phase decomposition, the compile-cache
+                # inventory, and the HBM residency ledger.  Reading
+                # flushes deferred publication so /metrics agrees with
+                # the JSON.  Lazy import: the pprof listener must stay
+                # importable without the verify stack
+                from tendermint_tpu.crypto import devobs
+                q = parse_qs(url.query)
+                last = int(q.get("last", ["16"])[0])
+                devobs.publish_pending()
+                self._send(200, json.dumps(devobs.report(last=last),
+                                           default=str),
+                           ctype="application/json")
             elif url.path == "/debug/latency":
                 # the latency observatory (ADR-016): windowed SLO
                 # quantiles/burn rates + the most recent scheduler
@@ -194,11 +251,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(body, default=str),
                            ctype="application/json")
             else:
-                self._send(404, "pprof routes: /debug/stacks "
-                                "/debug/threads /debug/profile?seconds=N "
-                                "/debug/gc /debug/trace?since=N "
-                                "/debug/latency "
-                                "/debug/consensus?last=N\n")
+                self._send(404, "unknown route; GET /debug for the "
+                                "index of registered debug endpoints\n")
         except Exception as e:  # noqa: BLE001 - debug surface never fatal
             self._send(500, f"error: {e}\n")
 

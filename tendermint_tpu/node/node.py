@@ -518,6 +518,12 @@ class Node(BaseService):
         slo.set_config(enabled=self.config.slo.enable,
                        window=self.config.slo.window,
                        targets=self.config.slo.targets_s())
+        # device observatory (crypto/devobs.py, ADR-021): per-launch
+        # transfer/compute/compile decomposition + HBM ledger; config
+        # wins over a stale TM_TPU_DEVOBS env both ways
+        from tendermint_tpu.crypto import devobs
+        devobs.set_config(enabled=self.config.devobs.enable,
+                          capacity=self.config.devobs.capacity)
         # register the flight-recorder bundle up front so
         # trace_dropped_spans_total renders 0 on /metrics from boot —
         # the tracer itself only touches it lazily on the first ring

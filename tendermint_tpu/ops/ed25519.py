@@ -116,7 +116,13 @@ def _base_comb():
     with _base_comb_lock:
         if _base_comb_cache is None:
             _base_comb_cache = tuple(jnp.asarray(t) for t in _base_comb_np())
-        return _base_comb_cache
+        cache = _base_comb_cache
+    # HBM residency ledger (ADR-021): refreshed on every access, not
+    # just the build — a comb user in a process whose tables another
+    # consumer built must still see the pool accounted
+    from tendermint_tpu.crypto import devobs
+    devobs.ledger_set("base_comb", sum(int(t.nbytes) for t in cache))
+    return cache
 
 
 # ---------------------------------------------------------------------------
@@ -622,11 +628,23 @@ def _set_last_launch(rec: dict):
     snapshot carries a monotonically increasing "seq" so a reader that
     bracketed its own dispatch can tell whether the record it sees is
     its launch or a concurrent verifier's (crypto/scheduler's route
-    span attr)."""
+    span attr).
+
+    This is also THE funnel into the device observatory (ADR-021):
+    every launch record — ladder/comb/split/mesh via _record_launch
+    and the RLC route mirror from ops/msm._set_route — is stored into
+    crypto/devobs's ring here, and the deferred publication drains
+    right after, with _launch_lock already released (devobs records
+    under its own leaf lock and never publishes — the PR 12
+    discipline)."""
     global _last_launch, _launch_seq
     with _launch_lock:
         _launch_seq += 1
-        _last_launch = MappingProxyType(dict(rec, seq=_launch_seq))
+        snap = dict(rec, seq=_launch_seq)
+        _last_launch = MappingProxyType(snap)
+    from tendermint_tpu.crypto import devobs
+    devobs.record(snap)
+    devobs.publish_pending()
 
 
 def _record_launch(path: str, n: int, nb: int, wall_s: float,
@@ -656,6 +674,29 @@ def _record_launch(path: str, n: int, nb: int, wall_s: float,
                         first_launch=first)
 
 
+def _overlap_phases(probe: dict) -> dict:
+    """Normalize a DMA probe (verify_packed_pipelined /
+    split_chunked_launch) into launch-record phase keys for the device
+    observatory: h2d_s is the summed device_put wall, chunk_overlap the
+    fraction of that wall issued while an earlier chunk's kernel was in
+    flight — the first put has nothing to hide behind, every later one
+    is bracketed between a dispatch and the final block, so it overlaps
+    compute by construction (an issued-while-in-flight fraction; see
+    crypto/devobs.py for why a tighter number would require serializing
+    the pipeline being measured)."""
+    out = {}
+    if probe.get("stage_s") is not None:
+        out["stage_s"] = probe["stage_s"]
+    dma = probe.get("dma_s")
+    if dma is not None:
+        out["h2d_s"] = dma
+        first = probe.get("dma_first_s", 0.0)
+        out["chunk_overlap"] = max(0.0, (dma - first) / dma) \
+            if dma > 0 else 0.0
+        out["chunks"] = probe.get("chunks")
+    return out
+
+
 def bucket_size(n: int) -> int:
     """Round a batch size up to the next power of two (>= MIN_BUCKET) so the
     jitted kernel sees few distinct shapes (one compile per bucket)."""
@@ -671,7 +712,7 @@ def _pad_dev(dev: dict, n: int, nb: int) -> dict:
 
 
 def verify_packed_pipelined(packed: np.ndarray, nsub: int = 4,
-                            tile: int = None):
+                            tile: int = None, probe: dict = None):
     """Launch the packed Pallas verify over `nsub` sub-batches, explicitly
     pipelining host->device transfer against kernel execution: sub-batch
     j+1's device_put is issued right after sub-batch j's kernel dispatch,
@@ -679,8 +720,16 @@ def verify_packed_pipelined(packed: np.ndarray, nsub: int = 4,
     the tunneled chip even under congestion — scripts/exp_overlap.py).
 
     packed: (128, B) int8 with B % nsub == 0 and (B//nsub) % tile == 0.
-    Returns a list of device arrays (caller blocks/concatenates)."""
+    Returns a list of device arrays (caller blocks/concatenates).
+
+    `probe` (optional dict, ADR-021): filled with the per-chunk DMA
+    walls — dma_s (sum of device_put call durations), dma_first_s (the
+    unoverlapped first put) and chunks — so the caller can record the
+    chunk-overlap ratio without ever serializing the pipeline with an
+    extra block."""
     import jax
+
+    from tendermint_tpu.crypto import devobs
 
     from . import pallas_ed25519 as pe
 
@@ -690,19 +739,37 @@ def verify_packed_pipelined(packed: np.ndarray, nsub: int = 4,
     sub = B // nsub
     dev = jax.devices()[0]
     outs = []
-    nxt = jax.device_put(np.ascontiguousarray(packed[:, :sub]), dev)
-    for j in range(nsub):
-        cur = nxt
-        # dispatch the kernel FIRST, then issue the next transfer: the
-        # kernel only depends on `cur`, so the j+1 DMA proceeds while it
-        # runs; putting first would queue the transfer ahead of the kernel
-        # and serialize the pipeline (scheme C in scripts/exp_overlap.py)
-        outs.append(pe.verify_packed_pallas(cur, tile=tile))
-        if j + 1 < nsub:
-            nxt = jax.device_put(
-                np.ascontiguousarray(packed[:, (j + 1) * sub:(j + 2) * sub]),
-                dev)
-    return outs
+    # the double-buffered window keeps at most TWO sub-chunks in
+    # flight on the device (cur + nxt) — charging the whole host batch
+    # would overstate the device-resident peak nsub/2-fold
+    inflight = packed.nbytes if nsub == 1 else 2 * (packed.nbytes // nsub)
+    devobs.ledger_add("staging", inflight)
+    try:
+        put_walls = []
+        t_put = time.perf_counter()
+        nxt = jax.device_put(np.ascontiguousarray(packed[:, :sub]), dev)
+        put_walls.append(time.perf_counter() - t_put)
+        for j in range(nsub):
+            cur = nxt
+            # dispatch the kernel FIRST, then issue the next transfer: the
+            # kernel only depends on `cur`, so the j+1 DMA proceeds while it
+            # runs; putting first would queue the transfer ahead of the kernel
+            # and serialize the pipeline (scheme C in scripts/exp_overlap.py)
+            outs.append(pe.verify_packed_pallas(cur, tile=tile))
+            if j + 1 < nsub:
+                t_put = time.perf_counter()
+                nxt = jax.device_put(
+                    np.ascontiguousarray(
+                        packed[:, (j + 1) * sub:(j + 2) * sub]),
+                    dev)
+                put_walls.append(time.perf_counter() - t_put)
+        if probe is not None:
+            probe["dma_s"] = sum(put_walls)
+            probe["dma_first_s"] = put_walls[0]
+            probe["chunks"] = nsub
+        return outs
+    finally:
+        devobs.ledger_add("staging", -inflight)
 
 
 # ---------------------------------------------------------------------------
@@ -842,7 +909,10 @@ def _pub_cache_get(pub_rows: np.ndarray, nsub: int):
     chunks = [jax.device_put(jnp.asarray(np.ascontiguousarray(
         pub_rows[:, j * sub:(j + 1) * sub]).view(np.int8)))
         for j in range(nsub)]
-    return _pub_cache.put(key, chunks)
+    chunks = _pub_cache.put(key, chunks, nbytes=int(pub_rows.nbytes))
+    from tendermint_tpu.crypto import devobs
+    devobs.ledger_set("pub_cache", _pub_cache.total_bytes)
+    return chunks
 
 
 # -- comb table cache (ADR-013): per-validator fixed-base window tables,
@@ -941,8 +1011,10 @@ def _table_evicted(set_hash, entry):
             else:
                 del _table_key_index[kb]
     from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.crypto import devobs
     degrade.publish_table_cache(bytes_=_table_cache.total_bytes,
                                 evicted=True)
+    devobs.ledger_set("table_cache", _table_cache.total_bytes)
 
 
 _table_cache = DeviceLRU(max_bytes=None, on_evict=_table_evicted)
@@ -994,6 +1066,8 @@ def _table_build(uniq: np.ndarray, set_hash: bytes, replicas: int = 1):
         for kb, i in entry.index.items():
             _table_key_index[kb] = set_hash
     degrade.publish_table_cache(bytes_=_table_cache.total_bytes)
+    from tendermint_tpu.crypto import devobs
+    devobs.ledger_set("table_cache", _table_cache.total_bytes)
     return entry
 
 
@@ -1074,6 +1148,8 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
     # dispatch (the ladder is NOT retried in-process — the degradation
     # runtime owns the fallback, preserving bitmap identity)
     fail.inject("ops.ed25519.comb")
+    from tendermint_tpu.crypto import devobs
+    obs_on = devobs.is_enabled()
     vidx = remap[inverse].astype(np.int32)
     t0 = time.perf_counter()
     _, r_b, s_b, kscal, host_ok = _stage_rows(
@@ -1082,6 +1158,7 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
     k_digits = scalars_to_digits(kscal)
     use_mesh = plane is not None and plane.worth_sharding(n)
     path = "mesh-comb" if use_mesh else "comb"
+    phases = {"stage_s": time.perf_counter() - t0} if obs_on else {}
     # chunk like every other device path (split_chunked_launch, the
     # nb > MAX_CHUNK pipelined sub-batching): one unbounded launch for
     # a huge batch would mint a fresh XLA bucket shape per size class
@@ -1104,18 +1181,46 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
                 kc = np.pad(kc, pad)
                 vc = np.pad(vc, (0, cnb - m))
             by, bm, bt = _base_comb()
-            out = comb_kernel(jnp.asarray(rc), jnp.asarray(sc),
-                              jnp.asarray(kc), jnp.asarray(vc),
-                              entry.tables.ypx, entry.tables.ymx,
-                              entry.tables.z, entry.tables.t2d,
-                              entry.dec_ok, by, bm, bt)
-            part = np.asarray(out)[:m]
+            if obs_on:
+                # per-launch operand transfer bracket — opened BEFORE
+                # the jnp.asarray conversions, which are what actually
+                # issue the host->device copy (the tables are device-
+                # resident already: they are the cache, not the
+                # transfer); then dispatch->block is the compute share
+                t_put = time.perf_counter()
+                args = (jnp.asarray(rc), jnp.asarray(sc),
+                        jnp.asarray(kc), jnp.asarray(vc))
+                for arg in args:
+                    arg.block_until_ready()
+                t_h2d = time.perf_counter()
+                phases["h2d_s"] = phases.get("h2d_s", 0.0) + \
+                    (t_h2d - t_put)
+                out = comb_kernel(*args,
+                                  entry.tables.ypx, entry.tables.ymx,
+                                  entry.tables.z, entry.tables.t2d,
+                                  entry.dec_ok, by, bm, bt)
+                out.block_until_ready()
+                phases["compute_s"] = phases.get("compute_s", 0.0) + \
+                    (time.perf_counter() - t_h2d)
+                t_col = time.perf_counter()
+                part = np.asarray(out)[:m]
+                phases["collect_s"] = phases.get("collect_s", 0.0) + \
+                    (time.perf_counter() - t_col)
+            else:
+                out = comb_kernel(jnp.asarray(rc), jnp.asarray(sc),
+                                  jnp.asarray(kc), jnp.asarray(vc),
+                                  entry.tables.ypx, entry.tables.ymx,
+                                  entry.tables.z, entry.tables.t2d,
+                                  entry.dec_ok, by, bm, bt)
+                part = np.asarray(out)[:m]
         parts.append(np.asarray(part))
         nb += cnb
     res = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    if obs_on and use_mesh:
+        phases.update(devobs.shard_fields(n, nb, shards))
     _record_launch(path, n, nb, time.perf_counter() - t0, shards=shards,
-                   extra={"table_build": built, "set_k": entry.k,
-                          "k_pad": entry.k_pad})
+                   extra=dict(phases, table_build=built, set_k=entry.k,
+                              k_pad=entry.k_pad))
     res = fail.corrupt_bitmap("ops.ed25519.comb",
                               np.asarray(res[:n], dtype=bool))
     return res & host_ok
@@ -1132,7 +1237,7 @@ def _msgs_slice(msgs, a: int, b: int):
     return msgs[a:b]
 
 
-def split_chunked_launch(pubkeys, msgs, sigs):
+def split_chunked_launch(pubkeys, msgs, sigs, probe: dict = None):
     """Cache-path launcher with a three-stage pipeline: while the kernel
     runs chunk j, the host stages chunk j+1 (C challenge hashing +
     packing) and its DMA proceeds — so for big batches (100k-validator
@@ -1143,8 +1248,15 @@ def split_chunked_launch(pubkeys, msgs, sigs):
     NON-BLOCKING: returns (outs, host_ok, n) where outs is the list of
     per-chunk device result arrays still in flight — callers that
     pipeline multiple batches (bench.py) block once at the end; the
-    verify_batch wrapper below blocks immediately."""
+    verify_batch wrapper below blocks immediately.
+
+    `probe` (optional dict, ADR-021): filled with the summed per-chunk
+    staging walls (stage_s) and DMA walls (dma_s / dma_first_s /
+    chunks), measured without adding any synchronization — the
+    decomposition must never serialize the pipeline it measures."""
     import jax
+
+    from tendermint_tpu.crypto import devobs
 
     from . import pallas_ed25519 as pe
 
@@ -1164,9 +1276,13 @@ def split_chunked_launch(pubkeys, msgs, sigs):
     pub_chunks = _pub_cache_get(pub_rows, nsub)
     host_ok = np.zeros(nb, dtype=bool)
 
+    stage_walls = []
+
     def stage(j):
+        t_st = time.perf_counter()
         a, b = j * chunk, min((j + 1) * chunk, n)
         if a >= n:  # pure padding chunk: zeroed inputs fail on-device
+            stage_walls.append(time.perf_counter() - t_st)
             return np.zeros((96, chunk), dtype=np.int8)
         _, r_b, s_b, k, ok = _stage_rows(pub_m[a:b], sig_m[a:b],
                                          _msgs_slice(msgs, a, b))
@@ -1175,20 +1291,42 @@ def split_chunked_launch(pubkeys, msgs, sigs):
         rsk[0:32, : b - a] = r_b.T
         rsk[32:64, : b - a] = s_b.T
         rsk[64:96, : b - a] = k.T
+        stage_walls.append(time.perf_counter() - t_st)
         return rsk.view(np.int8)
 
     dev = jax.devices()[0]
     outs = []
-    nxt = jax.device_put(stage(0), dev)
-    for j in range(nsub):
-        cur = nxt
-        outs.append(pe.verify_packed_split_pallas(pub_chunks[j], cur,
-                                                  tile=PALLAS_TILE))
-        if j + 1 < nsub:
-            # stage j+1 on the host while the kernel runs chunk j; its
-            # device_put is issued after the dispatch so the DMA also
-            # overlaps (same scheme as verify_packed_pipelined)
-            nxt = jax.device_put(stage(j + 1), dev)
+    put_walls = []
+    # two rsk chunks in flight at the peak (cur being consumed + nxt
+    # staged-and-transferring) — the double-buffered window, same
+    # accounting as verify_packed_pipelined
+    inflight = (2 if nsub > 1 else 1) * 96 * chunk
+    devobs.ledger_add("staging", inflight)
+    try:
+        t_put = time.perf_counter()
+        nxt = jax.device_put(stage(0), dev)
+        put_walls.append(time.perf_counter() - t_put)
+        for j in range(nsub):
+            cur = nxt
+            outs.append(pe.verify_packed_split_pallas(pub_chunks[j], cur,
+                                                      tile=PALLAS_TILE))
+            if j + 1 < nsub:
+                # stage j+1 on the host while the kernel runs chunk j; its
+                # device_put is issued after the dispatch so the DMA also
+                # overlaps (same scheme as verify_packed_pipelined)
+                t_put = time.perf_counter()
+                nxt = jax.device_put(stage(j + 1), dev)
+                put_walls.append(time.perf_counter() - t_put)
+    finally:
+        devobs.ledger_add("staging", -inflight)
+    if probe is not None:
+        # the put wall here includes the chunk's host staging (staged
+        # inline inside the put expression): report the DMA share with
+        # staging subtracted so stage_s + dma_s don't double-count
+        probe["stage_s"] = sum(stage_walls)
+        probe["dma_s"] = max(0.0, sum(put_walls) - sum(stage_walls))
+        probe["dma_first_s"] = max(0.0, put_walls[0] - stage_walls[0])
+        probe["chunks"] = nsub
     return outs, host_ok[:n], n
 
 
@@ -1258,12 +1396,28 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
             return out
         if plane is not None and plane.worth_sharding(len(pubkeys)):
             return plane.verify_batch(pubkeys, msgs, sigs)
+        from tendermint_tpu.crypto import devobs
+
+        # launch decomposition (ADR-021): with the observatory enabled
+        # the monolithic paths bracket staging / H2D / compute / D2H
+        # explicitly (one extra block_until_ready on the staged buffers
+        # — these paths are already device_put -> dispatch -> full
+        # block, so nothing is serialized that wasn't), and the
+        # double-buffered paths record the non-serializing DMA probe
+        # instead.  Disabled, the code path is byte-identical to the
+        # pre-ADR-021 shape.
+        obs_on = devobs.is_enabled()
+        phases = {}
         t0 = time.perf_counter()
         if _use_pallas():
             from . import pallas_ed25519 as pe
             if cache_pubs and len(pubkeys) >= PUB_CACHE_MIN:
-                outs, host_ok, n = split_chunked_launch(pubkeys, msgs, sigs)
+                probe = {} if obs_on else None
+                outs, host_ok, n = split_chunked_launch(pubkeys, msgs,
+                                                        sigs, probe=probe)
                 out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+                if probe:
+                    phases = _overlap_phases(probe)
                 path = "pallas-split"
             else:
                 packed, host_ok = prepare_batch_packed(pubkeys, sigs, msgs)
@@ -1271,26 +1425,63 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
                 nb = max(PALLAS_TILE, bucket_size(n))
                 if nb != n:  # pad the trailing (lane) axis
                     packed = np.pad(packed, [(0, 0), (0, nb - n)])
+                if obs_on:
+                    phases["stage_s"] = time.perf_counter() - t0
                 if nb > MAX_CHUNK:
                     # huge batches (100k-validator VerifyCommit) run as
                     # MAX_CHUNK sub-batches with transfer/compute
                     # pipelining — same lane buckets the headline path
                     # uses, and the tunnel DMA of chunk j+1 overlaps the
                     # kernel of chunk j
+                    probe = {} if obs_on else None
                     outs = verify_packed_pipelined(packed,
-                                                   nsub=nb // MAX_CHUNK)
+                                                   nsub=nb // MAX_CHUNK,
+                                                   probe=probe)
                     out = jnp.concatenate(outs)
+                    if probe:
+                        phases.update(_overlap_phases(probe))
                 else:
-                    out = pe.verify_packed_pallas(jnp.asarray(packed),
-                                                  tile=min(PALLAS_TILE, nb))
+                    buf = jnp.asarray(packed)
+                    if obs_on:
+                        buf.block_until_ready()
+                        t_h2d = time.perf_counter()
+                        phases["h2d_s"] = t_h2d - t0 - phases["stage_s"]
+                        out = pe.verify_packed_pallas(
+                            buf, tile=min(PALLAS_TILE, nb))
+                        out.block_until_ready()
+                        phases["compute_s"] = time.perf_counter() - t_h2d
+                    else:
+                        out = pe.verify_packed_pallas(
+                            buf, tile=min(PALLAS_TILE, nb))
                 path = "pallas"
         else:
             dev, host_ok = prepare_batch(pubkeys, sigs, msgs)
             n = host_ok.shape[0]
             dev = _pad_dev(dev, n, bucket_size(n))
-            out = verify_kernel(
-                **{k: jnp.asarray(v) for k, v in dev.items()})
+            if obs_on:
+                t_st = time.perf_counter()
+                phases["stage_s"] = t_st - t0
+                arrs = {k: jnp.asarray(v) for k, v in dev.items()}
+                for a in arrs.values():
+                    a.block_until_ready()
+                t_h2d = time.perf_counter()
+                phases["h2d_s"] = t_h2d - t_st
+                out = verify_kernel(**arrs)
+                out.block_until_ready()
+                phases["compute_s"] = time.perf_counter() - t_h2d
+            else:
+                out = verify_kernel(
+                    **{k: jnp.asarray(v) for k, v in dev.items()})
             path = "xla"
+        t_col = time.perf_counter()
         res = np.asarray(out)  # blocks: wall below includes execution
-        _record_launch(path, n, res.shape[0], time.perf_counter() - t0)
+        if obs_on:
+            # paths that bracketed compute have only the readback left
+            # here (collect_s); the double-buffered paths block for the
+            # FIRST time here, so the wait is residual compute + D2H
+            # merged — recorded as drain_s, never mislabeled collect
+            key = "collect_s" if "compute_s" in phases else "drain_s"
+            phases[key] = time.perf_counter() - t_col
+        _record_launch(path, n, res.shape[0], time.perf_counter() - t0,
+                       extra=phases or None)
         return res[:n] & host_ok

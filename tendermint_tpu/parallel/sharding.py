@@ -313,6 +313,9 @@ class _DataPlane:
         from tendermint_tpu.ops import ed25519 as edops
 
         if edops._use_pallas():
+            from tendermint_tpu.crypto import devobs
+
+            obs_on = devobs.is_enabled()
             t0 = time.perf_counter()
             packed, host_ok = edops.prepare_batch_packed(pubkeys, sigs, msgs)
             n = host_ok.shape[0]
@@ -325,31 +328,59 @@ class _DataPlane:
             nb = -(-max(edops.bucket_size(n), unit) // unit) * unit
             if nb != n:
                 packed = np.pad(packed, [(0, 0), (0, nb - n)])
+            extra = {"stage_s": time.perf_counter() - t0} if obs_on \
+                else None
             fn = self._packed_fn()
             shard_in = NamedSharding(self.mesh, P(None, BATCH_AXIS))
             outs = []
+            put_walls = []
             starts = list(range(0, nb, chunk_max))
-            nxt = jax.device_put(
-                np.ascontiguousarray(packed[:, :min(chunk_max, nb)]),
-                shard_in)
-            for ci, s in enumerate(starts):
-                cur = nxt
-                outs.append(fn(cur))
-                if ci + 1 < len(starts):
-                    s2 = starts[ci + 1]
-                    nxt = jax.device_put(
-                        np.ascontiguousarray(
-                            packed[:, s2:min(s2 + chunk_max, nb)]),
-                        shard_in)
-            out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+            # at most two sharded chunks in flight (cur + nxt) — the
+            # double-buffered window, not the whole host batch
+            chunk_bytes = 128 * min(chunk_max, nb)
+            inflight = min(int(packed.nbytes), 2 * chunk_bytes)
+            devobs.ledger_add("staging", inflight)
+            try:
+                t_put = time.perf_counter()
+                nxt = jax.device_put(
+                    np.ascontiguousarray(packed[:, :min(chunk_max, nb)]),
+                    shard_in)
+                put_walls.append(time.perf_counter() - t_put)
+                for ci, s in enumerate(starts):
+                    cur = nxt
+                    outs.append(fn(cur))
+                    if ci + 1 < len(starts):
+                        s2 = starts[ci + 1]
+                        t_put = time.perf_counter()
+                        nxt = jax.device_put(
+                            np.ascontiguousarray(
+                                packed[:, s2:min(s2 + chunk_max, nb)]),
+                            shard_in)
+                        put_walls.append(time.perf_counter() - t_put)
+                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+            finally:
+                devobs.ledger_add("staging", -inflight)
+            if extra is not None:
+                extra.update(edops._overlap_phases({
+                    "dma_s": sum(put_walls),
+                    "dma_first_s": put_walls[0],
+                    "chunks": len(starts)}))
+                extra.update(devobs.shard_fields(n, nb, self.nshard))
         else:
             dev, host_ok = edops.prepare_batch(pubkeys, sigs, msgs)
             n = host_ok.shape[0]
             return self._compact()(dev, bucket=True,
                                    shards=self.nshard) & host_ok
+        t_col = time.perf_counter()
         res = np.asarray(out)
+        if extra is not None:
+            # first blocking point of the pipelined mesh launch: the
+            # wait merges residual compute with the readback (drain_s;
+            # collect_s would claim a D2H split this path cannot see)
+            extra["drain_s"] = time.perf_counter() - t_col
         edops._record_launch("mesh-pallas", n, nb,
-                             time.perf_counter() - t0, shards=self.nshard)
+                             time.perf_counter() - t0, shards=self.nshard,
+                             extra=extra)
         return res[:n] & host_ok
 
 
@@ -376,20 +407,55 @@ def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):
     def run(dev_arrays: dict, bucket: bool = False, shards: int = 0):
         """bucket=True rounds the padded size up to a power-of-two bucket
         (ops/ed25519.bucket_size) so long-lived processes compile one
-        sharded kernel per bucket instead of one per batch size."""
+        sharded kernel per bucket instead of one per batch size.
+
+        With the device observatory enabled (crypto/devobs.py, ADR-021)
+        the launch is decomposed: pad (host stage), an explicit sharded
+        device_put bracketed with block_until_ready (H2D), dispatch ->
+        block (compute), and the bitmap readback (D2H) — plus per-shard
+        real-row counts.  This is the one mesh path CI can drive on the
+        virtual CPU mesh, so the acceptance test pins stage + h2d +
+        compute + collect summing to the recorded wall here.  Disabled,
+        the code path is byte-identical to the pre-ADR-021 shape."""
+        import numpy as np
+
+        from tendermint_tpu.crypto import devobs
+
         t0 = time.perf_counter()
         n = dev_arrays["pub"].shape[0]
-        nshard = mesh.devices.size
+        nshard = int(mesh.devices.size)
         base = edops.bucket_size(n) if bucket else n
         nb = max(-(-base // nshard) * nshard, nshard)
         padded = edops._pad_dev(dict(dev_arrays), n, nb)
-        bitmap, _ = jitted(padded["pub"], padded["r"],
-                           padded["s_digits"], padded["k_digits"])
-        import numpy as np
-        res = np.asarray(bitmap)
+        extra = None
+        if devobs.is_enabled():
+            t_st = time.perf_counter()
+            operands = (padded["pub"], padded["r"],
+                        padded["s_digits"], padded["k_digits"])
+            nbytes = sum(int(a.nbytes) for a in operands)
+            devobs.ledger_add("staging", nbytes)
+            try:
+                put = jax.device_put(operands, batch_sharded)
+                jax.block_until_ready(put)
+                t_h2d = time.perf_counter()
+                bitmap, _ = jitted(*put)
+                jax.block_until_ready(bitmap)
+                t_cmp = time.perf_counter()
+                res = np.asarray(bitmap)
+                t_col = time.perf_counter()
+            finally:
+                devobs.ledger_add("staging", -nbytes)
+            extra = {"stage_s": t_st - t0, "h2d_s": t_h2d - t_st,
+                     "compute_s": t_cmp - t_h2d,
+                     "collect_s": t_col - t_cmp,
+                     **devobs.shard_fields(n, nb, nshard)}
+        else:
+            bitmap, _ = jitted(padded["pub"], padded["r"],
+                               padded["s_digits"], padded["k_digits"])
+            res = np.asarray(bitmap)
         edops._record_launch("mesh-sharded", n, nb,
                              time.perf_counter() - t0,
-                             shards=shards or int(nshard))
+                             shards=shards or nshard, extra=extra)
         return res[:n]
 
     return jitted, run
