@@ -1036,12 +1036,167 @@ def _consensus_main():
           file=sys.stderr)
 
 
+def run_statesync_restore(n_heights=24, n_vals=4, n_txs=8,
+                          chunk_size=512, fetchers=4, group_every=8,
+                          resume_frac=0.5):
+    """Statesync fast-join core (ADR-022, shared by BENCH_STATESYNC=1
+    and bench_report config12): build a deterministic snapshotting
+    serving chain, then restore a fresh app through the REAL pipelined
+    Syncer (fetch -> digest-verify -> apply, per-peer accounting,
+    RestoreLedger group commits) and measure chunks/s + time-to-synced;
+    a second leg pre-seeds the ledger with ``resume_frac`` of the
+    chunks and measures the crash-resume path.  Host-only by
+    construction: the restore plane launches no device kernels (the
+    light verification batches sit under the tpu threshold), so this
+    is rc=0 with or without an accelerator."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from helpers import build_chain, make_genesis
+
+    # syncer logs default to stdout, which is the bench driver's JSON
+    # contract (and bench_report's line-oriented stdout) — route them
+    # to stderr and keep only errors
+    from tendermint_tpu.libs import log as tmlog
+    tmlog.setup(level="error", stream=sys.stderr)
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.blocksync.replay import replay_window
+    from tendermint_tpu.libs.kvdb import MemDB
+    from tendermint_tpu.light import (Client, DictProvider, LightStore,
+                                      TrustOptions)
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.statesync import StateProvider, Syncer
+    from tendermint_tpu.statesync.ledger import RestoreLedger
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+
+    gdoc, privs = make_genesis(n_vals)
+    txs_fn = lambda h: [b"ss%d.%d=%s" % (h, i, b"v" * 96)  # noqa: E731
+                        for i in range(n_txs)]
+    blocks, commits, states = build_chain(gdoc, privs, n_heights,
+                                          txs_fn=txs_fn)
+    serving = KVStoreApplication()
+    serving.snapshot_interval = n_heights - 4
+    serving.snapshot_chunk_size = chunk_size
+    ex = BlockExecutor(StateStore(MemDB()), serving)
+    store, state = BlockStore(MemDB()), state_from_genesis(gdoc)
+    applied = 0
+    while applied < n_heights:
+        state, n = replay_window(ex, store, state, blocks[applied:],
+                                 commits[applied:], max_window=8)
+        applied += n
+    lbs = {b.header.height: LightBlock(
+        SignedHeader(b.header, commits[i]), states[i].validators)
+        for i, b in enumerate(blocks)}
+    now = Timestamp(1700005000, 0)
+
+    def sp():
+        lc = Client(gdoc.chain_id,
+                    TrustOptions(1, lbs[1].hash(), 3600.0 * 24),
+                    DictProvider(gdoc.chain_id, lbs), [],
+                    LightStore(MemDB()))
+        return StateProvider(lc, now)
+
+    snaps = serving.list_snapshots()
+    target = max(snaps, key=lambda s: s.height)
+
+    def fetch(snapshot, index, peer):
+        return (serving.load_snapshot_chunk(
+            snapshot.height, snapshot.format, index), peer)
+
+    def one_restore(ledger):
+        app = KVStoreApplication()
+        syncer = Syncer(app, sp(), fetch, fetchers=fetchers,
+                        ledger=ledger)
+        syncer.add_snapshot(target, "bench-peer")
+        t0 = time.perf_counter()
+        st, _commit = syncer.sync_any()
+        wall = time.perf_counter() - t0
+        assert st.last_block_height == target.height
+        return wall, syncer.last_restore
+
+    # leg 1: cold restore through the full pipeline + group-committed
+    # ledger writes
+    cold_ledger = RestoreLedger(MemDB(), group_every=group_every)
+    cold_s, cold_stats = one_restore(cold_ledger)
+
+    # leg 2: crash-resume — pre-seed the ledger with the first
+    # resume_frac of the chunks (what a killed restore left durable)
+    seed_ledger = RestoreLedger(MemDB(), group_every=group_every)
+    seed_ledger.begin(target)
+    n_seed = max(1, int(target.chunks * resume_frac))
+    for i in range(n_seed):
+        seed_ledger.put_chunk(i, serving.load_snapshot_chunk(
+            target.height, target.format, i))
+    seed_ledger.flush()
+    resume_s, resume_stats = one_restore(seed_ledger)
+    assert resume_stats["resumed"] == n_seed
+
+    total_bytes = cold_stats["bytes"]
+    return {
+        "chunks": target.chunks,
+        "chunk_bytes": chunk_size,
+        "snapshot_height": target.height,
+        "restore_bytes": total_bytes,
+        "chunks_per_s": round(target.chunks / cold_s, 1),
+        "bytes_per_s": round(total_bytes / cold_s, 1),
+        "time_to_synced_s": round(cold_s, 4),
+        "resume_time_to_synced_s": round(resume_s, 4),
+        "resume_seeded_chunks": n_seed,
+        "resume_vs_cold": round(cold_s / resume_s, 2) if resume_s else 0,
+        "fetchers": fetchers,
+    }
+
+
+def _statesync_main():
+    """Statesync fast-join config (BENCH_STATESYNC=1, ADR-022): one
+    rc=0 JSON line — chunks/s + time-to-synced through the pipelined
+    fetch/verify/apply plane, plus the crash-resume leg.  Host-only by
+    design (no accelerator wanted): the config measures the fetch
+    pipeline + integrity + ledger floor that bounds a fresh join."""
+    os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+    t_start = time.time()
+    n_heights = int(os.environ.get("BENCH_SS_HEIGHTS", "24"))
+    n_txs = int(os.environ.get("BENCH_SS_TXS", "8"))
+    chunk = int(os.environ.get("BENCH_SS_CHUNK", "512"))
+    fetchers = int(os.environ.get("BENCH_SS_FETCHERS", "4"))
+    r = run_statesync_restore(n_heights=n_heights, n_txs=n_txs,
+                              chunk_size=chunk, fetchers=fetchers)
+    line = {
+        "metric": "statesync_restore_chunks_per_s",
+        "value": r["chunks_per_s"],
+        "unit": "chunks/s",
+        "time_to_synced_s": r["time_to_synced_s"],
+        "restore_bytes_per_s": r["bytes_per_s"],
+        "n_chunks": r["chunks"],
+        "chunk_bytes": r["chunk_bytes"],
+        "snapshot_height": r["snapshot_height"],
+        "resume_time_to_synced_s": r["resume_time_to_synced_s"],
+        "resume_vs_cold": r["resume_vs_cold"],
+        "fetchers": r["fetchers"],
+        "note": "host-only by design: measures the pipelined "
+                "fetch/verify/apply + ledger floor of a fresh join",
+        "trace": _trace_artifact("statesync"),
+    }
+    _emit(line)
+    print(f"# statesync bench: chunks={r['chunks']} "
+          f"cold_s={r['time_to_synced_s']} "
+          f"resume_s={r['resume_time_to_synced_s']} "
+          f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_STATESYNC") == "1":
+        _statesync_main()
+        return
     if os.environ.get("BENCH_CONSENSUS") == "1":
         _consensus_main()
         return

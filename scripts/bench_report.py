@@ -581,6 +581,23 @@ def config11_consensus(validators=4, heights=8):
             "commit_skew_max_ms": r["commit_skew_max_ms"]}
 
 
+def config12_statesync(n_heights=24):
+    """Statesync fast-join (statesync/, ADR-022): restore a fresh app
+    through the pipelined fetch/verify/apply plane with the
+    group-committed RestoreLedger, cold and crash-resumed.  Columns
+    mirror the BENCH_STATESYNC=1 bench.py line."""
+    from bench import run_statesync_restore
+
+    r = run_statesync_restore(n_heights=n_heights)
+    return {"config": f"12: statesync restore h{r['snapshot_height']}",
+            "chunks_per_s": r["chunks_per_s"],
+            "time_to_synced_s": r["time_to_synced_s"],
+            "restore_bytes_per_s": r["bytes_per_s"],
+            "n_chunks": r["chunks"],
+            "resume_time_to_synced_s": r["resume_time_to_synced_s"],
+            "resume_vs_cold": r["resume_vs_cold"]}
+
+
 def main():
     import json
 
@@ -601,7 +618,7 @@ def main():
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
-           config11_consensus)
+           config11_consensus, config12_statesync)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

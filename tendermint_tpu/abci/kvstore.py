@@ -146,8 +146,16 @@ class KVStoreApplication(abci.Application):
         }, sort_keys=True).encode()
         cs = max(1, int(self.snapshot_chunk_size))
         nchunks = max(1, -(-len(body) // cs))
+        # per-chunk digest metadata (statesync/integrity.py, ADR-022):
+        # lets the fetch plane verify each chunk BEFORE the app sees
+        # it and attribute a corrupt one to its sender; the whole-body
+        # hash below stays the app-level end-to-end check
+        from tendermint_tpu.statesync.integrity import make_chunk_metadata
+        meta = make_chunk_metadata(
+            [body[i * cs:(i + 1) * cs] for i in range(nchunks)])
         snap = abci.Snapshot(height=self.height, format=1, chunks=nchunks,
-                             hash=hashlib.sha256(body).digest())
+                             hash=hashlib.sha256(body).digest(),
+                             metadata=meta)
         self._snapshots = getattr(self, "_snapshots", [])
         self._snapshots.append((snap, body))
         self._snapshots = self._snapshots[-self._SNAPSHOT_KEEP:]

@@ -129,12 +129,40 @@ class TxIndexConfig:
 @dataclass
 class StateSyncConfig:
     """Reference config/config.go StateSyncConfig: bootstrap a fresh node
-    from an app snapshot verified through the light client."""
+    from an app snapshot verified through the light client.  The
+    fast-join knobs (ADR-022) replace the old hardcoded
+    CHUNK_FETCHERS/CHUNK_RETRIES module constants; the serve_* pair
+    bounds the snapshot-serving side (per-peer token buckets on the
+    chunk server — every node serves snapshots, so these apply even
+    with enable=false)."""
     enable: bool = False
     rpc_servers: str = ""      # comma-separated full-node RPC addrs
     trust_height: int = 0
     trust_hash: str = ""       # hex header hash at trust_height
     trust_period: float = 86400.0 * 7
+    fetchers: int = 4          # concurrent chunk fetcher threads
+    chunk_timeout_ms: float = 15000.0  # per-chunk fetch deadline; a
+    #                            slower peer is quarantined
+    retries: int = 3           # PER-PEER consecutive-failure budget
+    #                            before a provider is banned
+    serve_rate_per_s: float = 100.0  # per-peer chunk-serve rate; 0 =
+    #                            unlimited
+    serve_burst: int = 32      # per-peer token-bucket burst
+
+    def validate_basic(self):
+        if self.fetchers <= 0:
+            raise ValueError("state_sync.fetchers must be positive")
+        if self.chunk_timeout_ms <= 0:
+            raise ValueError(
+                "state_sync.chunk_timeout_ms must be positive")
+        if self.retries <= 0:
+            raise ValueError("state_sync.retries must be positive")
+        # 0 = unlimited serve rate; only negatives are nonsense
+        if self.serve_rate_per_s < 0:
+            raise ValueError(
+                "state_sync.serve_rate_per_s must be >= 0")
+        if self.serve_burst <= 0:
+            raise ValueError("state_sync.serve_burst must be positive")
 
 
 @dataclass
@@ -258,12 +286,12 @@ class SLOConfig:
     rate.  Targets are p99 objectives in MILLISECONDS; 0 = track the
     quantiles but no target (no burn-rate gauge)."""
     # the per-priority verify streams (ADR-016) plus the consensus
-    # observatory's height-lifecycle streams (ADR-020: block_interval,
-    # propose, quorum_prevote, apply) plus the device observatory's
-    # per-launch wall stream (ADR-021: device_launch)
+    # observatory's height-lifecycle streams (ADR-020), the device
+    # observatory's per-launch wall stream (ADR-021), and the
+    # statesync per-chunk fetch-to-applied stream (ADR-022)
     STREAMS = ("consensus", "commit", "blocksync", "mempool",
                "block_interval", "propose", "quorum_prevote", "apply",
-               "device_launch")
+               "device_launch", "statesync")
 
     enable: bool = False
     window: int = 1024
@@ -276,6 +304,7 @@ class SLOConfig:
     quorum_prevote_p99_ms: float = 0.0
     apply_p99_ms: float = 0.0
     device_launch_p99_ms: float = 0.0
+    statesync_p99_ms: float = 0.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
@@ -327,7 +356,7 @@ class Config:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
                      "batch_verifier", "verify_scheduler", "slo",
-                     "block_pipeline", "devobs"):
+                     "block_pipeline", "devobs", "state_sync"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -436,6 +465,11 @@ rpc_servers = "{self._q(self.state_sync.rpc_servers)}"
 trust_height = {self.state_sync.trust_height}
 trust_hash = "{self._q(self.state_sync.trust_hash)}"
 trust_period = {self.state_sync.trust_period}
+fetchers = {self.state_sync.fetchers}
+chunk_timeout_ms = {self.state_sync.chunk_timeout_ms}
+retries = {self.state_sync.retries}
+serve_rate_per_s = {self.state_sync.serve_rate_per_s}
+serve_burst = {self.state_sync.serve_burst}
 
 [batch_verifier]
 tpu_threshold = {self.batch_verifier.tpu_threshold}
@@ -473,6 +507,7 @@ propose_p99_ms = {self.slo.propose_p99_ms}
 quorum_prevote_p99_ms = {self.slo.quorum_prevote_p99_ms}
 apply_p99_ms = {self.slo.apply_p99_ms}
 device_launch_p99_ms = {self.slo.device_launch_p99_ms}
+statesync_p99_ms = {self.slo.statesync_p99_ms}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -548,7 +583,12 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             rpc_servers=ss.get("rpc_servers", ""),
             trust_height=ss.get("trust_height", 0),
             trust_hash=ss.get("trust_hash", ""),
-            trust_period=float(ss.get("trust_period", 86400.0 * 7)))
+            trust_period=float(ss.get("trust_period", 86400.0 * 7)),
+            fetchers=int(ss.get("fetchers", 4)),
+            chunk_timeout_ms=float(ss.get("chunk_timeout_ms", 15000.0)),
+            retries=int(ss.get("retries", 3)),
+            serve_rate_per_s=float(ss.get("serve_rate_per_s", 100.0)),
+            serve_burst=int(ss.get("serve_burst", 32)))
         bv = d.get("batch_verifier", {})
         cfg.batch_verifier = BatchVerifierConfig(
             tpu_threshold=bv.get("tpu_threshold", 32),

@@ -79,6 +79,28 @@ LOCK_ORDER = {
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._res_lock": 24,
     "tendermint_tpu/crypto/scheduler.py:VerifyScheduler._stats_lock": 28,
 
+    # -- statesync fast-join (statesync/, ADR-022): the metrics-
+    # bundle install lock (27) constructs StateSyncMetrics under it
+    # (Registry 80); the syncer discovery lock (31), the per-peer
+    # book (33; its ban callback runs with the lock RELEASED) and the
+    # reactor's response-routing / serve-queue conditions (34/35) are
+    # bookkeeping leaves — app calls, peer sends and metrics all
+    # happen outside them.  The restore ledger (63) buffers chunk
+    # writes through GroupCommitDB (67) while held; group COMMITS run
+    # with it released (commit_mutex 65)
+    "tendermint_tpu/statesync/syncer.py:_metrics_lock": 27,
+    "tendermint_tpu/statesync/syncer.py:_cfg_lock": 29,
+    "tendermint_tpu/statesync/syncer.py:Syncer._lock": 31,
+    "tendermint_tpu/statesync/syncer.py:_PeerBook._lock": 33,
+    "tendermint_tpu/statesync/reactor.py:StateSyncReactor._chunks_cv": 34,
+    "tendermint_tpu/statesync/reactor.py:StateSyncReactor._serve_cv": 35,
+    # _commit_lock is held across a whole take_group+commit_group unit
+    # (nests GroupCommitDB._commit_mutex 65 / _lock 67) so groups land
+    # strictly in take order under concurrent fetcher threads; the
+    # buffer lock (63) is never held while committing
+    "tendermint_tpu/statesync/ledger.py:RestoreLedger._commit_lock": 61,
+    "tendermint_tpu/statesync/ledger.py:RestoreLedger._lock": 63,
+
     # -- batch verifier / caches --
     "tendermint_tpu/crypto/lanepool.py:HostLanePool._lock": 30,
     "tendermint_tpu/crypto/batch.py:SigCache._lock": 32,

@@ -110,6 +110,24 @@ REGISTERED_SITES = frozenset({
     # and its bitmap proceed untouched; latency is absorbed into the
     # recording — the same contract observatory.record proved
     "devobs.record",
+    # statesync fast-join (statesync/, ADR-022): statesync.fetch fires
+    # per chunk-fetch attempt on a fetcher thread (raise = transport
+    # fault charged to the picked peer's per-peer budget; latency =
+    # slow fetch driving the per-chunk deadline / slow-peer
+    # quarantine; corrupt-chunk = the fetched bytes are flipped so the
+    # pre-app digest check must catch them, ban the sender and refetch
+    # elsewhere), statesync.verify fires at the fetch-thread integrity
+    # check (raise = verification machinery fault — retried like a
+    # transport error, the app NEVER sees the chunk),
+    # statesync.apply fires before each app apply_snapshot_chunk
+    # (raise = app-layer restore failure, the snapshot is rejected),
+    # and statesync.serve fires in the serving side's worker (raise =
+    # the request is answered busy-with-retry-after, the server stays
+    # up)
+    "statesync.fetch",
+    "statesync.verify",
+    "statesync.apply",
+    "statesync.serve",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic
@@ -264,12 +282,18 @@ def _count(site: str, mode: str):
         _fired[(site, mode)] = _fired.get((site, mode), 0) + 1
 
 
+# result-transform modes: no-ops at the entry hook, applied by their
+# dedicated result helpers (corrupt_bitmap / corrupt_bytes)
+_RESULT_MODES = frozenset({"corrupt-bitmap", "corrupt-chunk"})
+
+
 def inject(site: str):
     """Entry hook of a named fail-point site: raise / stall / die per the
-    armed mode.  "corrupt-bitmap" is a result-transform mode and is a
-    no-op here (see corrupt_bitmap)."""
+    armed mode.  Result-transform modes ("corrupt-bitmap",
+    "corrupt-chunk") are no-ops here (see corrupt_bitmap /
+    corrupt_bytes)."""
     mode = _mode_for(site)
-    if mode is None or mode == "corrupt-bitmap":
+    if mode is None or mode in _RESULT_MODES:
         return
     if mode == "raise":
         _count(site, mode)
@@ -293,3 +317,15 @@ def corrupt_bitmap(site: str, bits):
         _count(site, "corrupt-bitmap")
         return ~np.asarray(bits, dtype=bool)
     return bits
+
+
+def corrupt_bytes(site: str, data: bytes) -> bytes:
+    """Result hook of a byte-stream site: under "corrupt-chunk" flip
+    the first byte (a peer serving garbage), which the statesync
+    fetch-thread digest check must catch BEFORE the app sees it."""
+    if _mode_for(site) == "corrupt-chunk":
+        _count(site, "corrupt-chunk")
+        if not data:
+            return b"\xff"
+        return bytes([data[0] ^ 0xFF]) + bytes(data[1:])
+    return data

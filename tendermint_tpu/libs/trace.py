@@ -84,6 +84,9 @@ KNOWN_SPANS = frozenset({
     "sched.launch", "sched.resolve", "sched.shed", "sched.submit",
     # state/execution.py
     "state.apply_block", "state.validate_block",
+    # statesync/ — the fast-join fetch/verify/apply pipeline and the
+    # bounded chunk server (ADR-022)
+    "statesync.fetch", "statesync.apply", "statesync.serve",
 })
 
 

@@ -91,6 +91,59 @@ class _FloodReactor(Reactor):
             self.sent += len(txs)
 
 
+class _ChunkFloodReactor(Reactor):
+    """An external Byzantine peer for the statesync serving side:
+    registers only the chunk channel and spams ChunkRequests at every
+    peer it connects to — the bounded chunk server (ADR-022) must
+    refuse (busy/ratelimit) instead of starving honest joiners."""
+
+    def __init__(self, batch: int = 32):
+        super().__init__("CHUNKFLOOD")
+        self.batch = batch
+        self.sent = 0
+
+    def get_channels(self):
+        from tendermint_tpu.statesync.reactor import CHUNK_CHANNEL
+        return [ChannelDescriptor(CHUNK_CHANNEL, priority=5,
+                                  send_queue_capacity=100)]
+
+    def add_peer(self, peer):
+        self.spawn(self._flood, peer, name="chunkflood")
+
+    def _flood(self, peer):
+        from tendermint_tpu.statesync.reactor import (CHUNK_CHANNEL,
+                                                      ChunkRequest)
+        idx = 0
+        while not self.quitting.is_set():
+            sent_any = False
+            for _ in range(self.batch):
+                if peer.send(CHUNK_CHANNEL,
+                             ChunkRequest(1, 1, idx % 64)):
+                    self.sent += 1
+                    sent_any = True
+                idx += 1
+            if not sent_any:
+                time.sleep(0.01)
+
+
+class _CorruptSnapshotApp:
+    """Byzantine snapshot server: serves every chunk with its first
+    byte flipped (the joiner's pre-app digest check must catch it and
+    ban this node)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def load_snapshot_chunk(self, height, format_, index):
+        b = self._inner.load_snapshot_chunk(height, format_, index)
+        if not b:
+            return b
+        return bytes([b[0] ^ 0xFF]) + bytes(b[1:])
+
+
 class HarnessNode:
     """One slot in the network: a scaffolded home dir + the live Node
     (rebuilt across restarts).  `priv` is the slot's validator key —
@@ -116,14 +169,29 @@ class HarnessNode:
                                           cfg.priv_validator_state_file())
         self.node_key = NodeKey.load_or_generate(cfg.node_key_file())
 
+    # set by NetHarness.statesync_join for a fresh-join slot
+    light_provider = None
+    cfg_mutator = None
+
     def build(self):
         from tendermint_tpu.abci.kvstore import KVStoreApplication
         from tendermint_tpu.node import Node
         cfg = self.harness.node_config(self.idx)
+        if self.cfg_mutator is not None:
+            self.cfg_mutator(cfg)
         transport = self.harness.net.transport(self.addr)
-        self.node = Node(cfg, KVStoreApplication(),
+        app = KVStoreApplication()
+        ao = self.harness.app_overrides
+        if ao:
+            app.snapshot_interval = int(ao.get("snapshot_interval", 0))
+            app.snapshot_chunk_size = int(
+                ao.get("snapshot_chunk_size", app.snapshot_chunk_size))
+            app._SNAPSHOT_KEEP = int(
+                ao.get("snapshot_keep", app._SNAPSHOT_KEEP))
+        self.node = Node(cfg, app,
                          in_memory=not self.harness.persist,
-                         transport=transport)
+                         transport=transport,
+                         light_provider=self.light_provider)
         return self.node
 
     def start(self):
@@ -160,6 +228,8 @@ class NetHarness:
                  workdir: Optional[str] = None, persist: bool = False,
                  consensus_overrides: Optional[dict] = None,
                  mempool_overrides: Optional[dict] = None,
+                 app_overrides: Optional[dict] = None,
+                 statesync_overrides: Optional[dict] = None,
                  power: int = 10, chain_id: str = "netharness-chain"):
         self.n_validators = validators
         self.n_nodes = validators + standbys
@@ -169,6 +239,8 @@ class NetHarness:
         self.chain_id = chain_id
         self.consensus_overrides = dict(consensus_overrides or {})
         self.mempool_overrides = dict(mempool_overrides or {})
+        self.app_overrides = dict(app_overrides or {})
+        self.statesync_overrides = dict(statesync_overrides or {})
         self.workdir = workdir or tempfile.mkdtemp(prefix="tm_netharness_")
         self.net = VirtualNetwork(
             seed=seed,
@@ -181,6 +253,7 @@ class NetHarness:
         self._monitor: Optional[threading.Thread] = None
         self._flooder: Optional[Switch] = None
         self._flood_reactor: Optional[_FloodReactor] = None
+        self._chunk_flooder: Optional[Switch] = None
         self._flood_seq = 0
         self._genesis_json: Optional[str] = None
         self._scaffold()
@@ -220,6 +293,8 @@ class NetHarness:
             setattr(cfg.consensus, k, v)
         for k, v in self.mempool_overrides.items():
             setattr(cfg.mempool, k, v)
+        for k, v in self.statesync_overrides.items():
+            setattr(cfg.state_sync, k, v)
         cfg.rpc.enabled = False
         cfg.p2p.pex = False
         cfg.p2p.laddr = hn.addr
@@ -367,6 +442,106 @@ class NetHarness:
         if self._flooder is not None:
             self._flooder.stop()
             self._flooder = None
+        if self._chunk_flooder is not None:
+            self._chunk_flooder.stop()
+            self._chunk_flooder = None
+
+    def start_chunk_flood(self, target: int, batch: int = 32):
+        """Attach an external peer spamming the target's statesync
+        chunk server (bounded + rate-limited, ADR-022)."""
+        if self._chunk_flooder is not None:
+            self._chunk_flooder.stop()
+            self._chunk_flooder = None
+        self._flood_seq += 1
+        addr = f"vchunkflood{self._flood_seq}"
+        nk = NodeKey.generate()
+        sw = Switch(nk, addr, network=self.chain_id,
+                    moniker="chunkflooder",
+                    transport=self.net.transport(addr))
+        sw.add_reactor("CHUNKFLOOD", _ChunkFloodReactor(batch=batch))
+        sw.start()
+        tgt = self.nodes[target]
+        peer = sw.dial_peer(f"{tgt.node_key.node_id}@{tgt.addr}")
+        if peer is None:
+            sw.stop()
+            raise RuntimeError("chunk flooder could not reach its target")
+        self._chunk_flooder = sw
+
+    # -- statesync fresh-join (ADR-022) ------------------------------------
+
+    def corrupt_provider(self, idx: int):
+        """Turn one node's snapshot serving Byzantine: every chunk it
+        serves has a flipped byte, so a joiner's pre-app digest check
+        must detect and ban it."""
+        reactor = self.nodes[idx].node.statesync_reactor
+        if not isinstance(reactor.app, _CorruptSnapshotApp):
+            reactor.app = _CorruptSnapshotApp(reactor.app)
+
+    def statesync_join(self, source: int, timeout: float = 60.0) -> int:
+        """Append a FRESH node slot that bootstraps via statesync: its
+        light client reads from the source node's stores in-process
+        (light/provider.NodeBackedProvider — the harness runs rpc-less)
+        and its chunk fetches ride the real vnet statesync channels,
+        rotating across every advertising peer.  Returns the joiner's
+        index; the restore itself is gated by wait_synced."""
+        from tendermint_tpu.light.provider import NodeBackedProvider
+        src = self.nodes[source]
+        if src.node is None:
+            raise ScenarioFailure("statesync_join source is not running")
+        deadline = time.monotonic() + timeout
+        while src.node.block_store.height() < 3 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        provider = NodeBackedProvider(self.chain_id,
+                                      src.node.block_store,
+                                      src.node.state_store)
+        anchor = provider.light_block(1)
+        hn = HarnessNode(self, len(self.nodes))
+        self.nodes.append(hn)
+        hn.scaffold()
+        with open(os.path.join(hn.home, "config", "genesis.json"),
+                  "w") as f:
+            f.write(self._genesis_json)
+        # a joiner is a full node, never a validator: drop the key the
+        # scaffold minted so the Node boots without a privval
+        keyfile = os.path.join(hn.home, "config",
+                               "priv_validator_key.json")
+        if os.path.exists(keyfile):
+            os.remove(keyfile)
+        trust_hash = anchor.hash().hex()
+
+        def mutate(cfg):
+            cfg.state_sync.enable = True
+            cfg.state_sync.trust_height = 1
+            cfg.state_sync.trust_hash = trust_hash
+
+        hn.cfg_mutator = mutate
+        hn.light_provider = provider
+        hn.start()
+        return hn.idx
+
+    def wait_synced(self, idx: int, timeout: float = 120.0):
+        """Gate: the joiner restored from a SNAPSHOT (its block store
+        has no early blocks — the chain was never replayed) within the
+        deadline."""
+        hn = self.nodes[idx]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            node = hn.node
+            if node is not None:
+                syncer = getattr(node.statesync_reactor, "syncer", None)
+                if syncer is not None and syncer.last_restore is not None \
+                        and node.state.last_block_height > 0:
+                    if node.block_store.load_block(1) is not None:
+                        raise ScenarioFailure(
+                            "joiner replayed from genesis instead of "
+                            "restoring a snapshot")
+                    return
+            time.sleep(0.2)
+        h = hn.node.state.last_block_height if hn.node else -1
+        raise ScenarioFailure(
+            f"joiner never statesynced within {timeout}s "
+            f"(state height {h}, heights={self.heights()})")
 
     def double_sign(self, idx: int):
         """Arm an equivocating prevoter (reference byzantine_test.go):
@@ -529,8 +704,32 @@ class NetHarness:
             self.start_flood(step.get("target", 0),
                              tx_bytes=step.get("tx_bytes", 128),
                              batch=step.get("batch", 64))
+        elif op == "chunk_flood":
+            self.start_chunk_flood(step.get("target", 0),
+                                   batch=step.get("batch", 32))
         elif op == "stop_flood":
             self.stop_flood()
+        elif op == "statesync_join":
+            ctx["joiner"] = self.statesync_join(
+                step.get("source", 0),
+                timeout=step.get("timeout", 60.0))
+        elif op == "wait_synced":
+            self.wait_synced(self._node_ref(step.get("node", "joiner"),
+                                            ctx),
+                             timeout=step.get("timeout", 120.0))
+        elif op == "corrupt_provider":
+            self.corrupt_provider(step["node"])
+        elif op == "expect_serve_refusals":
+            from tendermint_tpu.statesync.syncer import metrics as ssm
+            m = ssm()
+            seen = sum(m.serve_refused.value(reason=r)
+                       for r in ("busy", "ratelimit", "backpressure",
+                                 "error"))
+            if seen < step.get("min", 1):
+                raise ScenarioFailure(
+                    f"chunk server refused {seen} flood requests, "
+                    f"wanted >= {step.get('min', 1)}")
+            ctx["serve_refusals"] = seen
         elif op == "expect_rejections":
             # mempool metrics share the process-global registry, so one
             # running node's bundle sees the whole network's counters
@@ -633,7 +832,9 @@ class NetHarness:
                 standbys=scenario.get("standbys", 0), seed=seed,
                 workdir=workdir, persist=scenario.get("persist", False),
                 consensus_overrides=scenario.get("consensus"),
-                mempool_overrides=scenario.get("mempool"))
+                mempool_overrides=scenario.get("mempool"),
+                app_overrides=scenario.get("app"),
+                statesync_overrides=scenario.get("statesync"))
         h.start()
         try:
             return h.run_scenario(scenario)

@@ -74,7 +74,11 @@ class ChainWatcher:
         cur = self._cursors.get(name, store.base() - 1 if top else 0)
         found: List[Violation] = []
         upper = min(top, cur + self.MAX_HEIGHTS_PER_TICK)
-        for h in range(max(cur + 1, 1), upper + 1):
+        # never validate below the store's base: a statesync-restored
+        # joiner's first stored block is snapshot+1 (ADR-022) and a
+        # pruned store starts at retain_height — heights below base
+        # are absent by design, not validity violations
+        for h in range(max(cur + 1, store.base(), 1), upper + 1):
             v = self._check_height(name, node, h)
             found.extend(v)
         self._cursors[name] = upper

@@ -45,6 +45,15 @@ _STEP_OPS = frozenset({
     "kill", "restart", "kill_proposer", "double_sign",
     "expect_evidence", "flood", "stop_flood", "expect_rejections",
     "txs", "promote", "sleep",
+    # statesync fast-join (ADR-022): boot a FRESH node that restores
+    # from a snapshot over the live net ("statesync_join", anchored at
+    # "source"), gate its restore ("wait_synced"), turn one provider
+    # Byzantine ("corrupt_provider" — its served chunk bytes flip, the
+    # joiner must detect pre-app and ban it), spam a node's bounded
+    # chunk server ("chunk_flood" / "stop_flood") and gate that it
+    # refused ("expect_serve_refusals")
+    "statesync_join", "wait_synced", "corrupt_provider", "chunk_flood",
+    "expect_serve_refusals",
 })
 
 
@@ -63,7 +72,7 @@ def validate_scenario(sc: dict) -> dict:
         if op not in _STEP_OPS:
             raise ValueError(
                 f"scenario {sc['name']} step {i}: unknown op {op!r}")
-        for ref in ("node", "target", "src", "dst", "a", "b"):
+        for ref in ("node", "target", "src", "dst", "a", "b", "source"):
             v = step.get(ref)
             if isinstance(v, int) and not 0 <= v < n:
                 raise ValueError(
@@ -208,6 +217,42 @@ SCENARIOS: List[dict] = [validate_scenario(s) for s in (
             # while the rest keep committing
             {"op": "restart", "node": 4},
             {"op": "wait_height", "delta": 3, "timeout": 180},
+        ],
+    },
+    {
+        # ADR-022 acceptance: a fresh node statesyncs from a LIVE
+        # committing net while (a) one provider serves corrupt chunk
+        # bytes (must be detected pre-app and banned), (b) a serving
+        # validator is killed mid-stream (sender rotation), and (c) a
+        # flooding peer spams the join source's bounded chunk server
+        # (must be refused, not starve consensus).  The joiner must
+        # restore from a snapshot (no block 1 in its store), then
+        # follow the chain with the rest of the net still committing.
+        "name": "statesync_fresh_join",
+        "validators": 4,
+        # moderate cadence so snapshots outlive the joiner's
+        # verify+fetch round trips (keep-window x interval x block
+        # time — the discipline test_node_statesync derived)
+        "consensus": {"timeout_commit": 0.3,
+                      "skip_timeout_commit": False},
+        "app": {"snapshot_interval": 3, "snapshot_chunk_size": 96,
+                "snapshot_keep": 12},
+        "statesync": {"serve_rate_per_s": 60.0, "serve_burst": 8},
+        "steps": [
+            {"op": "wait_height", "delta": 4, "timeout": 90},
+            {"op": "corrupt_provider", "node": 1},
+            {"op": "chunk_flood", "target": 0, "batch": 32},
+            {"op": "statesync_join", "source": 0},
+            {"op": "sleep", "s": 0.5},
+            {"op": "kill", "node": 2},
+            {"op": "wait_synced", "timeout": 150},
+            {"op": "stop_flood"},
+            {"op": "expect_serve_refusals", "min": 1},
+            # no "who": every running node — the three live validators
+            # AND the joiner — must advance together, proving the
+            # statesync -> blocksync -> consensus handoff completed
+            # while the rest of the net kept committing
+            {"op": "wait_height", "delta": 2, "timeout": 120},
         ],
     },
     {

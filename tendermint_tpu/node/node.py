@@ -175,7 +175,12 @@ class Node(BaseService):
     a BaseService like the reference's node)."""
 
     def __init__(self, config: Config, app, genesis: Optional[GenesisDoc]
-                 = None, in_memory: bool = False, transport=None):
+                 = None, in_memory: bool = False, transport=None,
+                 light_provider=None):
+        """``light_provider`` (light/provider.Provider) overrides the
+        statesync light client's HTTP provider — the in-process path
+        the NetHarness fresh-join scenario uses (rpc off, no sockets);
+        production nodes keep [state_sync] rpc_servers."""
         super().__init__("node")
         from tendermint_tpu.libs import log as tmlog
         from tendermint_tpu.proxy import AppConns, ClientCreator
@@ -350,31 +355,52 @@ class Node(BaseService):
         # state_sync enabled also restores from peers before blocksync
         from tendermint_tpu.statesync.reactor import StateSyncReactor
         state_provider = None
+        restore_ledger = None
         if self._statesync_active:
             servers = [a.strip() for a in
                        cfg.state_sync.rpc_servers.split(",") if a.strip()]
-            if not (servers and cfg.state_sync.trust_height and
-                    cfg.state_sync.trust_hash):
+            if not (cfg.state_sync.trust_height and
+                    cfg.state_sync.trust_hash and
+                    (servers or light_provider is not None)):
                 raise NodeError(
                     "state_sync requires rpc_servers, trust_height and "
                     "trust_hash (reference config/config.go StateSync)")
             from tendermint_tpu.light.client import (Client as LightClient,
                                                      TrustOptions)
-            from tendermint_tpu.light.provider import HTTPProvider
             from tendermint_tpu.light.store import LightStore
             from tendermint_tpu.statesync.stateprovider import StateProvider
+            if light_provider is not None:
+                primary, witnesses = light_provider, []
+            else:
+                from tendermint_tpu.light.provider import HTTPProvider
+                primary = HTTPProvider(self.genesis.chain_id, servers[0])
+                witnesses = [HTTPProvider(self.genesis.chain_id, a)
+                             for a in servers[1:]]
             lc = LightClient(
                 self.genesis.chain_id,
                 TrustOptions(cfg.state_sync.trust_height,
                              bytes.fromhex(cfg.state_sync.trust_hash),
                              period_s=cfg.state_sync.trust_period),
-                HTTPProvider(self.genesis.chain_id, servers[0]),
-                witnesses=[HTTPProvider(self.genesis.chain_id, a)
-                           for a in servers[1:]],
+                primary, witnesses=witnesses,
                 store=LightStore(MemDB()))
             state_provider = StateProvider(lc)
+            # crash-resume restore ledger (ADR-022): a kill mid-restore
+            # reopens this DB, re-verifies the stored chunk prefix and
+            # resumes from the frontier instead of refetching from zero
+            from tendermint_tpu.statesync.ledger import RestoreLedger
+            restore_ledger = RestoreLedger(
+                MemDB() if in_memory else SQLiteDB(
+                    os.path.join(cfg.data_dir(), "statesync.db")))
+        ssc = cfg.state_sync
         self.statesync_reactor = StateSyncReactor(
-            self.app_conns.snapshot, state_provider=state_provider)
+            self.app_conns.snapshot, state_provider=state_provider,
+            ledger=restore_ledger,
+            fetchers=ssc.fetchers,
+            chunk_timeout_s=ssc.chunk_timeout_ms / 1000.0,
+            retries=ssc.retries,
+            serve_rate_per_s=ssc.serve_rate_per_s,
+            serve_burst=ssc.serve_burst)
+        self._statesync_ledger = restore_ledger
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
         # PEX + addr book (node.go:908 createPEXReactorAndAddToSwitch)
         self.pex_reactor = None
@@ -666,6 +692,14 @@ class Node(BaseService):
                     db.flush()
                 except Exception:  # noqa: BLE001 - best-effort shutdown
                     pass
+        if getattr(self, "_statesync_ledger", None) is not None:
+            try:
+                # flush, don't clear: an interrupted restore must stay
+                # resumable across a clean restart too (ADR-022)
+                self._statesync_ledger.flush()
+                self._statesync_ledger.close()
+            except Exception:  # noqa: BLE001 - best-effort shutdown
+                pass
 
     # -- info for RPC -------------------------------------------------------
 

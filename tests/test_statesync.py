@@ -24,14 +24,15 @@ from tendermint_tpu.types.light_block import LightBlock, SignedHeader
 NOW = Timestamp(1700005000, 0)
 
 
-def _served_chain(n_heights=20, n_vals=4, snapshot_interval=5):
+def _served_chain(n_heights=20, n_vals=4, snapshot_interval=5,
+                  chunk_size=128):
     """A 'serving node': chain built with a snapshotting kvstore."""
     gdoc, privs = make_genesis(n_vals)
 
     def mk_app():
         app = KVStoreApplication()
         app.snapshot_interval = snapshot_interval
-        app.snapshot_chunk_size = 128  # force multi-chunk snapshots
+        app.snapshot_chunk_size = chunk_size  # force multi-chunk snapshots
         return app
 
     # build_chain uses its own executor/app; rebuild here with snapshots on
@@ -199,3 +200,892 @@ def test_statesync_gives_up_after_chunk_retry_limit():
         syncer.add_snapshot(s, "peer1")
     with pytest.raises(StateSyncError):
         syncer.sync_any()
+
+
+# ---------------------------------------------------------------------------
+# ADR-022 fast-join: per-chunk integrity, per-peer accounting, resume,
+# bounded serving, chaos matrix
+# ---------------------------------------------------------------------------
+
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from tendermint_tpu.libs import fail, slo, trace
+from tendermint_tpu.libs.kvdb import SQLiteDB
+from tendermint_tpu.statesync import integrity
+from tendermint_tpu.statesync.ledger import RestoreLedger
+from tendermint_tpu.statesync.syncer import (ChunkBusy, SnapshotRejected,
+                                             metrics as ss_metrics)
+from tendermint_tpu.statesync import syncer as ssync
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _light_sp(gdoc, lbs):
+    lc = Client(gdoc.chain_id, TrustOptions(1, lbs[1].hash(), 3600.0 * 24),
+                DictProvider(gdoc.chain_id, lbs), [], LightStore(MemDB()))
+    return StateProvider(lc, NOW)
+
+
+def _chunk_of(app, snapshot, index):
+    return app.load_snapshot_chunk(snapshot.height, snapshot.format, index)
+
+
+class _RecordingApp(KVStoreApplication):
+    """Records every chunk the syncer hands to apply_snapshot_chunk —
+    the pre-app integrity assertion reads this."""
+
+    def __init__(self):
+        super().__init__()
+        self.applied = []
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        self.applied.append((index, bytes(chunk), sender))
+        return super().apply_snapshot_chunk(index, chunk, sender)
+
+
+def test_chunk_metadata_roundtrip_and_tamper():
+    chunks = [b"a" * 10, b"b" * 10, b"tail"]
+    meta = integrity.make_chunk_metadata(chunks)
+    digests = integrity.parse_chunk_metadata(meta, 3)
+    assert digests is not None and len(digests) == 3
+    for i, c in enumerate(chunks):
+        assert integrity.verify_chunk(digests, i, c)
+        assert not integrity.verify_chunk(digests, i, c + b"x")
+    # malformed headers refuse instead of half-trusting
+    assert integrity.parse_chunk_metadata(b"", 3) is None
+    assert integrity.parse_chunk_metadata(b"junkmeta", 3) is None
+    assert integrity.parse_chunk_metadata(meta, 2) is None  # count lies
+    bad = bytearray(meta)
+    bad[10] ^= 0xFF  # break the embedded root
+    assert integrity.parse_chunk_metadata(bytes(bad), 3) is None
+    # stored-prefix re-verification keeps only intact chunks
+    stored = {0: chunks[0], 1: b"rotten", 2: chunks[2]}
+    assert integrity.verify_chunks(digests, stored) == [0, 2]
+    # legacy snapshots (no digests): everything is returned, the app's
+    # end-to-end check stays the only guard
+    assert integrity.verify_chunks(None, stored) == [0, 1, 2]
+
+
+def test_kvstore_snapshots_carry_chunk_digests():
+    _, _, serving_app, _, _, _, _ = _served_chain()
+    for s in serving_app.list_snapshots():
+        digests = integrity.parse_chunk_metadata(s.metadata, s.chunks)
+        assert digests is not None, "kvstore snapshot lacks digest meta"
+        for i in range(s.chunks):
+            assert integrity.verify_chunk(digests, i,
+                                          _chunk_of(serving_app, s, i))
+
+
+def test_corrupt_chunk_detected_pre_app_and_banned():
+    """THE tentpole invariant: a Byzantine provider's corrupt chunk is
+    detected on the fetch thread BEFORE the app call, the offending
+    peer is banned, the chunk refetches from an honest peer, and the
+    restore completes with the exact app state."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    fresh_app = _RecordingApp()
+    banned = []
+    m = ss_metrics()
+    base_corrupt = m.chunks_verified.value(outcome="corrupt")
+    base_banned = m.peers_banned.value()
+
+    def fetch(snapshot, index, peer):
+        body = _chunk_of(serving_app, snapshot, index)
+        if peer == "evil":
+            return b"\x00" * len(body), peer
+        return body, peer
+
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch,
+                    ban_peer=lambda p, r: banned.append((p, r)),
+                    fetchers=3)
+    for s in serving_app.list_snapshots():
+        # the Byzantine peer advertises FIRST so rotation starts there
+        syncer.add_snapshot(s, "evil")
+        syncer.add_snapshot(s, "good1")
+        syncer.add_snapshot(s, "good2")
+    state, commit = syncer.sync_any()
+
+    h = state.last_block_height
+    assert fresh_app.height == h
+    assert fresh_app.data == {k: v for k, v in serving_app.data.items()
+                              if int(k[1:]) <= h}
+    # every chunk the app saw was intact (pre-app detection)
+    snaps = {(s.height, s.format): s for s in serving_app.list_snapshots()}
+    for idx, chunk, sender in fresh_app.applied:
+        assert sender != "evil" or chunk == _chunk_of(
+            serving_app, snaps[(h, 1)], idx)
+        assert hashlib.sha256(chunk).digest() == hashlib.sha256(
+            _chunk_of(serving_app, snaps[(h, 1)], idx)).digest()
+    assert any(p == "evil" for p, _ in banned), banned
+    assert not any(p.startswith("good") for p, _ in banned), banned
+    assert m.chunks_verified.value(outcome="corrupt") > base_corrupt
+    assert m.peers_banned.value() > base_banned
+    assert m.time_to_synced.value() > 0
+
+
+def test_one_dead_peer_of_three_completes():
+    """Regression for the per-chunk accounting bug: a single dead peer
+    used to burn the whole snapshot's retry budget.  With per-peer
+    counters + rotation, 1 dead of 3 providers must complete."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    fresh_app = KVStoreApplication()
+    banned = []
+    asked = []
+    lock = threading.Lock()
+
+    def fetch(snapshot, index, peer):
+        with lock:
+            asked.append(peer)
+        if peer == "dead":
+            raise StateSyncError("connection refused")
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch,
+                    ban_peer=lambda p, r: banned.append(p),
+                    fetchers=3, retries=2)
+    for s in serving_app.list_snapshots():
+        syncer.add_snapshot(s, "dead")       # dead hint advertised first
+        syncer.add_snapshot(s, "alive1")
+        syncer.add_snapshot(s, "alive2")
+    state, commit = syncer.sync_any()
+    assert state.last_block_height == 15
+    assert fresh_app.height == 15
+    # rotation really spread across the live providers
+    assert {"alive1", "alive2"} <= set(asked)
+    # the dead peer's failures never spilled onto the live ones
+    assert "alive1" not in banned and "alive2" not in banned
+    stats = syncer.last_restore
+    assert stats is not None and stats["chunks"] >= 1
+
+
+def test_peer_book_budget_epochs_and_ban():
+    """Per-peer accounting semantics: one strike per backoff EPOCH
+    (concurrent same-burst failures don't double-strike), budget
+    exhaustion bans via the callback, corrupt chunks ban instantly,
+    success resets the counter."""
+    from tendermint_tpu.statesync.syncer import _PeerBook
+
+    banned = []
+    book = _PeerBook(["a", "b"], retries=2,
+                     ban_cb=lambda p, r: banned.append((p, r)))
+    t0 = time.monotonic()
+    # burst: 4 concurrent fetches that all STARTED before the first
+    # strike landed -> one strike total
+    assert book.failure("a", t0, "x") is False
+    for _ in range(3):
+        assert book.failure("a", t0, "x") is False
+    assert book.dead_peers() == []
+    # distinct epochs: strikes 2 then 3 (> retries=2) -> dead + banned
+    assert book.failure("a", time.monotonic(), "x") is False
+    assert book.failure("a", time.monotonic(), "x") is True
+    assert book.dead_peers() == ["a"]
+    assert banned and banned[0][0] == "a"
+    # rotation never hands out a dead peer; b still serves
+    for _ in range(4):
+        peer, wait_s = book.pick()
+        if peer is not None:
+            assert peer == "b"
+    # success resets b's counter
+    book.failure("b", time.monotonic(), "x")
+    book.success("b")
+    peer, _ = book.pick()
+    assert peer == "b"
+    # corrupt chunk: instant ban, then all_dead aborts the plane
+    book.ban("b", "digest mismatch")
+    assert book.all_dead()
+    assert ("b", "digest mismatch") in banned
+    peer, wait_s = book.pick()
+    assert peer is None and wait_s < 0
+
+
+def test_busy_peer_backs_off_without_strike():
+    """ChunkBusy (the bounded server's refusal) rotates + backs off
+    but never bans: a loaded server is not a dead one."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    fresh_app = KVStoreApplication()
+    banned = []
+    busy_hits = [0]
+
+    def fetch(snapshot, index, peer):
+        if peer == "loaded":
+            busy_hits[0] += 1
+            raise ChunkBusy("busy", retry_after_s=0.05)
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch,
+                    ban_peer=lambda p, r: banned.append(p), fetchers=2)
+    for s in serving_app.list_snapshots():
+        syncer.add_snapshot(s, "loaded")
+        syncer.add_snapshot(s, "calm")
+    state, _ = syncer.sync_any()
+    assert state.last_block_height == 15
+    assert busy_hits[0] >= 1
+    assert banned == []
+
+
+def test_ledger_resume_skips_stored_chunks():
+    """In-process resume: a transport abort mid-restore keeps the
+    verified prefix in the ledger; the next attempt refetches ONLY the
+    missing chunks and completes."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = \
+        _served_chain(chunk_size=32)   # many chunks: die mid-restore
+    ledger = RestoreLedger(MemDB(), group_every=2)
+    # die after 3 successful fetches on attempt 1
+    fetches = []
+    lock = threading.Lock()
+
+    def flaky(snapshot, index, peer):
+        with lock:
+            fetches.append(index)
+            if len(fetches) > 3 and flaky.armed:
+                raise StateSyncError("transport died")
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    flaky.armed = True
+    fresh_app = KVStoreApplication()
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), flaky,
+                    fetchers=1, retries=1, ledger=ledger)
+    best = max(s.height for s in serving_app.list_snapshots()
+               if s.height <= 18)
+    target = [s for s in serving_app.list_snapshots()
+              if s.height == best][0]
+    syncer.add_snapshot(target, "peer1")
+    with pytest.raises(StateSyncError):
+        syncer.sync_any()
+    stored_before = len(ledger.begin(target))
+    assert 1 <= stored_before <= 3
+    man = ledger.manifest()
+    assert man is not None and man["height"] == target.height
+
+    # attempt 2: healthy transport — only the gap is fetched
+    flaky.armed = False
+    first_attempt = len(fetches)
+    fresh_app2 = KVStoreApplication()
+    syncer2 = Syncer(fresh_app2, _light_sp(gdoc, lbs), flaky,
+                     fetchers=1, ledger=ledger)
+    syncer2.add_snapshot(target, "peer1")
+    state, commit = syncer2.sync_any()
+    assert state.last_block_height == target.height
+    assert fresh_app2.height == target.height
+    refetched = len(fetches) - first_attempt
+    assert refetched == target.chunks - stored_before, \
+        (refetched, target.chunks, stored_before)
+    assert syncer2.last_restore["resumed"] == stored_before
+    # completion clears the ledger
+    assert ledger.manifest() is None
+
+
+def test_ledger_clears_on_snapshot_rejection():
+    """Chunks of a REJECTED snapshot must not linger: the next begin()
+    starts clean."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    ledger = RestoreLedger(MemDB(), group_every=2)
+
+    class RejectingApp(KVStoreApplication):
+        def apply_snapshot_chunk(self, index, chunk, sender):
+            r = super().apply_snapshot_chunk(index, chunk, sender)
+            if self._restoring is None and r.result == \
+                    abci.ResponseApplySnapshotChunk.ACCEPT:
+                raise RuntimeError("app exploded after restore")
+            return r
+
+    def fetch(snapshot, index, peer):
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    syncer = Syncer(RejectingApp(), _light_sp(gdoc, lbs), fetch,
+                    fetchers=2, ledger=ledger)
+    for s in serving_app.list_snapshots():
+        syncer.add_snapshot(s, "peer1")
+    with pytest.raises(StateSyncError):
+        syncer.sync_any()
+    assert ledger.manifest() is None
+    assert list(ledger.db.iterate_prefix(b"ss:")) == []
+
+
+def test_statesync_chaos_matrix():
+    """raise/latency/corrupt at the statesync seams, with the degrade
+    contract pinned per site (the exercised-chaos-site gate in
+    test_lint.py keys on these literals: statesync.fetch,
+    statesync.verify, statesync.apply)."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+
+    def fetch(snapshot, index, peer):
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    def run_sync(app=None, **kw):
+        syncer = Syncer(app or KVStoreApplication(),
+                        _light_sp(gdoc, lbs), fetch, **kw)
+        for s in serving_app.list_snapshots():
+            syncer.add_snapshot(s, "p1")
+            syncer.add_snapshot(s, "p2")
+        return syncer.sync_any()
+
+    # fetch raise: every provider eventually exhausts -> StateSyncError,
+    # the app never sees a chunk
+    app = _RecordingApp()
+    fail.set_mode("statesync.fetch", "raise")
+    try:
+        with pytest.raises(StateSyncError):
+            run_sync(app=app, retries=1)
+        assert fail.fired("statesync.fetch", "raise") >= 1
+        assert app.applied == []
+    finally:
+        fail.clear()
+
+    # fetch latency: absorbed, restore completes
+    fail.set_mode("statesync.fetch", "latency:30")
+    try:
+        state, _ = run_sync()
+        assert state.last_block_height == 15
+        assert fail.fired("statesync.fetch", "latency:30") >= 1
+    finally:
+        fail.clear()
+
+    # corrupt-chunk: flipped bytes are detected pre-app on EVERY
+    # provider -> all banned -> StateSyncError, app untouched
+    app = _RecordingApp()
+    m = ss_metrics()
+    base_corrupt = m.chunks_verified.value(outcome="corrupt")
+    fail.set_mode("statesync.fetch", "corrupt-chunk")
+    try:
+        with pytest.raises(StateSyncError):
+            run_sync(app=app, retries=1)
+        assert fail.fired("statesync.fetch", "corrupt-chunk") >= 1
+        assert app.applied == []
+        assert m.chunks_verified.value(outcome="corrupt") > base_corrupt
+    finally:
+        fail.clear()
+
+    # verify raise: machinery fault -> retried as transport error, app
+    # untouched, eventually StateSyncError (no ban storm: the fault is
+    # ours, not proven peer misbehavior -> peers die of exhausted
+    # budgets, not digest bans)
+    app = _RecordingApp()
+    fail.set_mode("statesync.verify", "raise")
+    try:
+        with pytest.raises(StateSyncError):
+            run_sync(app=app, retries=1)
+        assert fail.fired("statesync.verify", "raise") >= 1
+        assert app.applied == []
+    finally:
+        fail.clear()
+
+    # apply raise: app-layer restore failure -> snapshot REJECTED (and
+    # blacklisted), surfaced as no-viable-snapshots
+    fail.set_mode("statesync.apply", "raise")
+    try:
+        with pytest.raises(StateSyncError, match="REJECTED"):
+            run_sync()
+        assert fail.fired("statesync.apply", "raise") >= 1
+    finally:
+        fail.clear()
+
+    # apply latency: absorbed
+    fail.set_mode("statesync.apply", "latency:20")
+    try:
+        state, _ = run_sync()
+        assert state.last_block_height == 15
+        assert fail.fired("statesync.apply", "latency:20") >= 1
+    finally:
+        fail.clear()
+
+
+def test_statesync_spans_and_slo_stream():
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    fresh_app = KVStoreApplication()
+
+    def fetch(snapshot, index, peer):
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch, fetchers=2)
+    for s in serving_app.list_snapshots():
+        syncer.add_snapshot(s, "peer1")
+    since = trace.last_seq()
+    trace.enable(capacity=4096)
+    slo.set_config(enabled=True, window=256)
+    try:
+        state, _ = syncer.sync_any()
+    finally:
+        spans = trace.snapshot(since=since)
+        trace.disable()
+        rep = slo.stream_report("statesync")
+        slo.set_config(enabled=False)
+    assert state.last_block_height == 15
+    got = {s["name"] for s in spans}
+    assert "statesync.fetch" in got and "statesync.apply" in got, \
+        sorted(got)[:20]
+    # pipelining: some fetch span for a later chunk starts before the
+    # apply span of an earlier chunk ends (fetch of k+1 overlaps apply)
+    applies = [s for s in spans if s["name"] == "statesync.apply"]
+    fetches = [s for s in spans if s["name"] == "statesync.fetch"]
+    assert rep is not None and rep["n"] >= 1
+    assert applies and fetches
+
+
+def test_serve_bounded_queue_ratelimit_and_chaos():
+    """The serving side (reactor): per-peer token buckets refuse with
+    busy + Retry-After, the queue stays bounded, chaos raise at
+    statesync.serve answers busy instead of killing the server."""
+    from tendermint_tpu.statesync.reactor import (ChunkRequest,
+                                                  ChunkResponse,
+                                                  StateSyncReactor)
+
+    _, _, serving_app, _, _, _, _ = _served_chain()
+    snap = serving_app.list_snapshots()[0]
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+            self.sent = []
+            self._lock = threading.Lock()
+
+        def try_send(self, ch, msg):
+            with self._lock:
+                self.sent.append(msg)
+            return True
+
+        def responses(self):
+            with self._lock:
+                return list(self.sent)
+
+    m = ss_metrics()
+    base_refused = sum(m.serve_refused.value(reason=r)
+                      for r in ("busy", "ratelimit"))
+    base_served = m.chunks_served.value()
+    reactor = StateSyncReactor(serving_app, serve_rate_per_s=50.0,
+                               serve_burst=4, serve_queue=8)
+    reactor.start()
+    try:
+        flooder = FakePeer("flooder")
+        req = ChunkRequest(snap.height, snap.format, 0)
+        from tendermint_tpu.statesync.reactor import (CHUNK_CHANNEL,
+                                                      encode_msg)
+        raw = encode_msg(req)
+        for _ in range(64):
+            reactor.receive(CHUNK_CHANNEL, flooder, raw)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            rs = flooder.responses()
+            if len(rs) >= 64:
+                break
+            time.sleep(0.02)
+        rs = flooder.responses()
+        refused = [r for r in rs if r.busy]
+        served = [r for r in rs if not r.busy and not r.missing]
+        assert refused, "flood was never refused"
+        assert all(r.retry_after_ms > 0 for r in refused)
+        assert served, "polite share was never served"
+        assert sum(m.serve_refused.value(reason=r)
+                   for r in ("busy", "ratelimit")) > base_refused
+        assert m.chunks_served.value() > base_served
+
+        # a SECOND peer is not starved by the flooder's bucket
+        polite = FakePeer("polite")
+        reactor.receive(CHUNK_CHANNEL, polite, raw)
+        deadline = time.monotonic() + 5.0
+        while not polite.responses() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert polite.responses() and not polite.responses()[0].busy
+
+        # chaos: serve raise answers busy (reason=error), server lives
+        base_err = m.serve_refused.value(reason="error")
+        fail.set_mode("statesync.serve", "raise")
+        try:
+            chaotic = FakePeer("chaotic")
+            reactor.receive(CHUNK_CHANNEL, chaotic, raw)
+            deadline = time.monotonic() + 5.0
+            while not chaotic.responses() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert fail.fired("statesync.serve", "raise") >= 1
+            assert chaotic.responses() and chaotic.responses()[0].busy
+            assert m.serve_refused.value(reason="error") > base_err
+        finally:
+            fail.clear()
+        # latency at the serve seam: absorbed, still served
+        fail.set_mode("statesync.serve", "latency:30")
+        try:
+            lagged = FakePeer("lagged")
+            reactor.receive(CHUNK_CHANNEL, lagged, raw)
+            deadline = time.monotonic() + 5.0
+            while not lagged.responses() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert fail.fired("statesync.serve", "latency:30") >= 1
+            assert lagged.responses() and not lagged.responses()[0].busy
+        finally:
+            fail.clear()
+    finally:
+        reactor.stop()
+
+
+def test_statesync_config_roundtrip_env_and_wiring(tmp_path):
+    """[statesync] knobs: TOML round-trip, validate_basic, and
+    config-wins-over-env in BOTH directions (module resolution)."""
+    from tendermint_tpu.config.config import Config
+    from tendermint_tpu.statesync import reactor as ssreactor
+
+    cfg = Config(home=str(tmp_path))
+    cfg.state_sync.fetchers = 7
+    cfg.state_sync.chunk_timeout_ms = 2500.0
+    cfg.state_sync.retries = 5
+    cfg.state_sync.serve_rate_per_s = 42.5
+    cfg.state_sync.serve_burst = 9
+    cfg.slo.statesync_p99_ms = 123.0
+    cfg.save()
+    back = Config.load(str(tmp_path))
+    assert back.state_sync.fetchers == 7
+    assert back.state_sync.chunk_timeout_ms == 2500.0
+    assert back.state_sync.retries == 5
+    assert back.state_sync.serve_rate_per_s == 42.5
+    assert back.state_sync.serve_burst == 9
+    assert back.slo.statesync_p99_ms == 123.0
+    back.validate_basic()
+    for mutate in (lambda c: setattr(c.state_sync, "fetchers", 0),
+                   lambda c: setattr(c.state_sync, "chunk_timeout_ms", 0),
+                   lambda c: setattr(c.state_sync, "retries", 0),
+                   lambda c: setattr(c.state_sync, "serve_rate_per_s", -1),
+                   lambda c: setattr(c.state_sync, "serve_burst", 0)):
+        bad = Config.load(str(tmp_path))
+        mutate(bad)
+        with pytest.raises(ValueError, match="state_sync"):
+            bad.validate_basic()
+
+    # env is the node-less default; set_config (and explicit Syncer
+    # args, which is how the node wires [statesync]) wins BOTH ways
+    os.environ["TM_TPU_SS_FETCHERS"] = "11"
+    os.environ["TM_TPU_SS_SERVE_RATE"] = "9.5"
+    try:
+        assert ssync.default_fetchers() == 11
+        assert ssreactor.default_serve_rate_per_s() == 9.5
+        ssync.set_config(fetchers=2)
+        assert ssync.default_fetchers() == 2      # config beats env
+        ssync.set_config(fetchers=None)
+        assert ssync.default_fetchers() == 11     # back to env
+        s = Syncer(object(), object(), lambda *a: None, fetchers=3)
+        assert s._fetchers() == 3                 # explicit arg beats all
+        s2 = Syncer(object(), object(), lambda *a: None)
+        assert s2._fetchers() == 11
+    finally:
+        del os.environ["TM_TPU_SS_FETCHERS"]
+        del os.environ["TM_TPU_SS_SERVE_RATE"]
+        ssync.set_config(fetchers=None)
+    assert ssync.default_fetchers() == ssync.DEFAULT_FETCHERS
+
+
+_RESUME_CHILD = r"""
+REPO_DIR = @@REPO@@
+import os, sys
+sys.path.insert(0, REPO_DIR)
+sys.path.insert(0, os.path.join(REPO_DIR, "tests"))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+
+from test_statesync import _served_chain, _light_sp
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.libs.kvdb import SQLiteDB
+from tendermint_tpu.statesync.ledger import RestoreLedger
+from tendermint_tpu.statesync.syncer import Syncer
+
+home, kill_after = sys.argv[1], int(sys.argv[2])
+gdoc, privs, serving_app, blocks, commits, states, lbs = \
+    _served_chain(chunk_size=32)
+
+# die IMMEDIATELY after the kill_after-th chunk lands in the ledger:
+# the process vanishes mid-restore, no flush, no close
+puts = {"n": 0}
+orig = RestoreLedger.put_chunk
+def dying(self, index, data):
+    orig(self, index, data)
+    puts["n"] += 1
+    if puts["n"] == kill_after:
+        os._exit(77)
+RestoreLedger.put_chunk = dying
+
+ledger = RestoreLedger(SQLiteDB(os.path.join(home, "statesync.db")),
+                       group_every=2)
+def fetch(snapshot, index, peer):
+    return (serving_app.load_snapshot_chunk(
+        snapshot.height, snapshot.format, index), peer)
+syncer = Syncer(KVStoreApplication(), _light_sp(gdoc, lbs), fetch,
+                fetchers=1, ledger=ledger)
+best = [s for s in serving_app.list_snapshots() if s.height == 15][0]
+syncer.add_snapshot(best, "peer1")
+syncer.sync_any()
+sys.exit(3)  # the kill should have fired mid-restore
+"""
+
+
+def test_crash_resume_os_exit_mid_restore(tmp_path):
+    """Child process really dies (os._exit) mid-restore; the parent
+    reopens the SQLite-backed ledger, finds the durable verified
+    prefix (manifest + chunks), and a fresh sync resumes from the
+    frontier — refetching ONLY the gap — to the exact app state.
+    Host-only by construction: the restore path launches no device
+    kernels, so no new XLA shapes compile (the nb=64 discipline)."""
+    home = str(tmp_path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    kill_after = 4
+    r = subprocess.run(
+        [sys.executable, "-c",
+         _RESUME_CHILD.replace("@@REPO@@", repr(REPO)), home,
+         str(kill_after)],
+        env=env, capture_output=True, timeout=180)
+    assert r.returncode == 77, (
+        f"child rc={r.returncode}\n"
+        f"stderr: {r.stderr[-2000:].decode(errors='replace')}")
+
+    # parent: rebuild the identical chain (helpers are deterministic)
+    gdoc, privs, serving_app, blocks, commits, states, lbs = \
+        _served_chain(chunk_size=32)
+    target = [s for s in serving_app.list_snapshots()
+              if s.height == 15][0]
+    ledger = RestoreLedger(SQLiteDB(os.path.join(home, "statesync.db")),
+                           group_every=2)
+    man = ledger.manifest()
+    assert man is not None and man["height"] == 15, man
+    stored = ledger.begin(target)
+    # group_every=2, killed after put #4: exactly the committed groups
+    # are durable (the open group may be lost, never half-landed)
+    assert 2 <= len(stored) <= 4, sorted(stored)
+    digests = integrity.parse_chunk_metadata(target.metadata,
+                                             target.chunks)
+    assert sorted(stored) == integrity.verify_chunks(digests, stored)
+
+    fetched = []
+
+    def fetch(snapshot, index, peer):
+        fetched.append(index)
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    fresh_app = KVStoreApplication()
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch,
+                    fetchers=2, ledger=ledger)
+    syncer.add_snapshot(target, "peer1")
+    state, commit = syncer.sync_any()
+    assert state.last_block_height == 15
+    assert fresh_app.height == 15
+    assert fresh_app.data == {k: v for k, v in serving_app.data.items()
+                              if int(k[1:]) <= 15}
+    assert state.app_hash == states[14].app_hash
+    # the frontier resumed: only the gap was refetched
+    assert len(set(fetched)) == target.chunks - len(stored), \
+        (sorted(set(fetched)), target.chunks, sorted(stored))
+    assert syncer.last_restore["resumed"] == len(stored)
+    ledger.close()
+
+
+def test_statesync_fresh_join_scenario():
+    """ADR-022 NetHarness acceptance: a fresh node statesyncs from a
+    LIVE committing net under a corrupt provider, a serving-validator
+    kill mid-stream, and a chunk-request flood — zero invariant
+    violations, the joiner restores from a snapshot (no block 1) and
+    follows, the flood is refused.  Host-only verify (4-lane batches
+    under the tpu threshold): no XLA shapes."""
+    from tendermint_tpu.networks import scenarios
+    from tendermint_tpu.networks.harness import NetHarness
+
+    res = NetHarness.run(scenarios.by_name("statesync_fresh_join"),
+                         seed=7)
+    assert res["ctx"]["serve_refusals"] >= 1
+    assert not res["violations"], res["violations"]
+    joiner = f"node{res['ctx']['joiner']}"
+    assert res["heights"][joiner] >= 2
+
+
+# ---------------------------------------------------------------------------
+# review hardening regressions (ADR-022): metadata-keyed snapshot
+# identity, sender-matched response routing, slow-burst epochs,
+# busy-forever bound, stop interruption
+# ---------------------------------------------------------------------------
+
+def test_poisoned_metadata_cannot_frame_honest_providers():
+    """A Byzantine FIRST advertiser attaching a self-consistent but
+    wrong digest list to the real (height, format, hash) must not
+    poison the snapshot entry honest providers advertise: metadata is
+    part of the snapshot identity, so the poisoned advertisement is a
+    DIFFERENT snapshot that fails alone while the honest one
+    restores — and no honest peer is banned for 'corrupt' chunks."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    target = [s for s in serving_app.list_snapshots()
+              if s.height == 15][0]
+    # crafted metadata: digests of garbage, CORRECTLY rooted — it
+    # parses, it is self-consistent, it is simply a lie
+    fake_meta = integrity.make_chunk_metadata(
+        [b"garbage-%d" % i for i in range(target.chunks)])
+    poisoned = abci.Snapshot(target.height, target.format, target.chunks,
+                             target.hash, fake_meta)
+    banned = []
+
+    def fetch(snapshot, index, peer):
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    fresh_app = KVStoreApplication()
+    syncer = Syncer(fresh_app, _light_sp(gdoc, lbs), fetch,
+                    ban_peer=lambda p, r: banned.append(p),
+                    fetchers=2, retries=1)
+    syncer.add_snapshot(poisoned, "evil")      # evil advertises FIRST
+    syncer.add_snapshot(target, "honest1")
+    syncer.add_snapshot(target, "honest2")
+    state, _ = syncer.sync_any()
+    assert state.last_block_height == 15
+    assert fresh_app.height == 15
+    # the honest providers were never framed by the poisoned digests
+    assert "honest1" not in banned and "honest2" not in banned
+
+
+def test_spoofed_chunk_response_cannot_satisfy_honest_request():
+    """Response routing is keyed by SENDER: a Byzantine peer spamming
+    missing=True responses must not settle (or fail) a fetch addressed
+    to a different peer."""
+    from tendermint_tpu.statesync.reactor import (ChunkResponse,
+                                                  StateSyncReactor)
+
+    _, _, serving_app, _, _, _, _ = _served_chain()
+    snap = serving_app.list_snapshots()[0]
+    body = _chunk_of(serving_app, snap, 0)
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+
+        def try_send(self, ch, msg):
+            return True
+
+    class FakeSwitch:
+        def __init__(self, peers):
+            self.peers = {p.id: p for p in peers}
+
+    honest, spoofer = FakePeer("honest"), FakePeer("spoofer")
+    reactor = StateSyncReactor(serving_app, chunk_timeout_s=1.5)
+    reactor.switch = FakeSwitch([honest, spoofer])
+
+    result = {}
+
+    def fetchit():
+        try:
+            result["r"] = reactor._fetch_chunk(snap, 0, "honest")
+        except Exception as e:  # noqa: BLE001 - asserted below
+            result["err"] = e
+
+    t = threading.Thread(target=fetchit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    # the spoofer races in a missing=True for the same chunk ...
+    reactor.receive.__func__  # (direct internal delivery below)
+    with reactor._chunks_cv:
+        reactor._chunks[(snap.height, snap.format, 0, "spoofer")] = \
+            ChunkResponse(snap.height, snap.format, 0, b"", missing=True)
+        reactor._chunks_cv.notify_all()
+    time.sleep(0.2)
+    assert "err" not in result and "r" not in result, result
+    # ... and only the HONEST peer's real response satisfies the fetch
+    with reactor._chunks_cv:
+        reactor._chunks[(snap.height, snap.format, 0, "honest")] = \
+            ChunkResponse(snap.height, snap.format, 0, body)
+        reactor._chunks_cv.notify_all()
+    t.join(timeout=3.0)
+    assert result.get("r") == (body, "honest"), result
+
+
+def test_peer_book_slow_burst_is_one_epoch():
+    """N concurrent fetches stalling together earn ONE slow strike,
+    not N (the same epoch guard as transport failures)."""
+    from tendermint_tpu.statesync.syncer import _PeerBook
+
+    book = _PeerBook(["a"], retries=2)
+    t0 = time.monotonic()
+    for _ in range(5):
+        book.slow("a", t0)   # one burst: all started before the strike
+    assert book.dead_peers() == []
+    book.slow("a", time.monotonic())   # a NEW epoch strikes again
+    book.slow("a", time.monotonic())   # third epoch: budget exhausted
+    assert book.dead_peers() == ["a"]
+
+
+def test_always_busy_provider_aborts_instead_of_hanging():
+    """A provider that answers busy FOREVER must not hang sync_any:
+    every BUSY_STRIKES_AFTER consecutive busies convert into a strike
+    until the budget exhausts and the restore aborts."""
+    from tendermint_tpu.statesync.syncer import _PeerBook
+
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+
+    def busy_fetch(snapshot, index, peer):
+        raise ChunkBusy("permanently saturated", retry_after_s=0.005)
+
+    syncer = Syncer(KVStoreApplication(), _light_sp(gdoc, lbs),
+                    busy_fetch, fetchers=2, retries=1)
+    target = [s for s in serving_app.list_snapshots()
+              if s.height == 15][0]
+    syncer.add_snapshot(target, "loaded")
+    t0 = time.monotonic()
+    with pytest.raises(StateSyncError):
+        syncer.sync_any()
+    # bounded: (retries+1) * BUSY_STRIKES_AFTER busies at tiny
+    # retry-after + backoffs — well under a minute, not forever
+    assert time.monotonic() - t0 < 60.0
+    assert _PeerBook.BUSY_STRIKES_AFTER >= 2  # contract the bound rests on
+
+
+def test_stop_event_interrupts_inflight_restore():
+    """Node.stop must not wait behind a wedged fetch plane: setting
+    the syncer's stop_event aborts the in-flight restore promptly
+    (ledger kept — the next process resumes)."""
+    gdoc, privs, serving_app, blocks, commits, states, lbs = _served_chain()
+    stop = threading.Event()
+
+    def stalling_fetch(snapshot, index, peer):
+        if index > 0:
+            time.sleep(0.15)   # a slow transport, not a dead one
+        return _chunk_of(serving_app, snapshot, index), peer
+
+    syncer = Syncer(KVStoreApplication(), _light_sp(gdoc, lbs),
+                    stalling_fetch, fetchers=1, stop_event=stop)
+    for s in serving_app.list_snapshots():
+        syncer.add_snapshot(s, "peer1")
+    threading.Timer(0.1, stop.set).start()
+    t0 = time.monotonic()
+    with pytest.raises(StateSyncError):
+        syncer.sync_any()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_unawaited_chunk_responses_are_dropped():
+    """receive() stores ONLY responses some fetcher is awaiting: an
+    unawaited response is stale or spam either way, so the response
+    map is bounded by the fetcher count, not by remote input — and a
+    response flood cannot evict an honest in-flight response."""
+    from tendermint_tpu.statesync.reactor import (CHUNK_CHANNEL,
+                                                  ChunkResponse,
+                                                  StateSyncReactor,
+                                                  encode_msg)
+
+    _, _, serving_app, _, _, _, _ = _served_chain()
+    snap = serving_app.list_snapshots()[0]
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+
+        def try_send(self, ch, msg):
+            return True
+
+    reactor = StateSyncReactor(serving_app)
+    spammer = FakePeer("spammer")
+    for i in range(200):
+        reactor.receive(CHUNK_CHANNEL, spammer, encode_msg(
+            ChunkResponse(snap.height, snap.format, i % 8, b"junk")))
+    assert reactor._chunks == {}
+    # an awaited key IS stored
+    key = (snap.height, snap.format, 0, "spammer")
+    with reactor._chunks_cv:
+        reactor._awaited.add(key)
+    reactor.receive(CHUNK_CHANNEL, spammer, encode_msg(
+        ChunkResponse(snap.height, snap.format, 0, b"real")))
+    assert key in reactor._chunks
