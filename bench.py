@@ -919,6 +919,234 @@ def run_mempool_ingress(n_threads=6, n_per=300, queue=2048, batch=128,
     }
 
 
+def run_control_ramp(controlled: bool, phases: int = 12,
+                     phase_s: float = 0.4, floor_tps: float = 50.0,
+                     peak_tps: float = 1500.0,
+                     consensus_target_ms: float = 50.0,
+                     probe_n: int = 32) -> dict:
+    """One diurnal-ramp measurement for the adaptive control plane
+    (ADR-023; shared by BENCH_CONTROL=1 and bench_report config13).
+
+    The run_mempool_ingress core — private Mempool + IngressGate +
+    VerifyScheduler — driven by a raised-cosine tx load (floor_tps ->
+    peak_tps -> floor_tps over `phases` x `phase_s`), while one
+    CONSENSUS-class verify probe per phase rides through the SAME
+    scheduler the flood's MEMPOOL-class pre-verification congests —
+    ADR-018's priority-inversion weather, on a clock.  libs/slo tracks
+    the consensus stream against `consensus_target_ms`; with
+    controlled=True a Controller (period 50 ms) governs the gate's
+    rate/burst and the coalescing window, steering on the published
+    burn exactly as in a node.  Returns the held-SLO fraction (phases
+    with consensus burn <= 1.0), admission totals and the per-phase
+    knob trajectories."""
+    import math
+    import threading  # noqa: F401 - parity with run_mempool_ingress
+
+    from tendermint_tpu.abci import types as abci_types
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.crypto import scheduler as vsched
+    from tendermint_tpu.libs import control, slo
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.mempool.ingress import IngressGate, make_signed_tx
+    from tendermint_tpu.mempool.mempool import Mempool
+
+    class AcceptApp(abci_types.Application):
+        def check_tx(self, req):
+            return abci_types.ResponseCheckTx(code=0, gas_wanted=1)
+
+    # the diurnal curve, then everything signed OUTSIDE the clock
+    loads = [floor_tps + (peak_tps - floor_tps)
+             * (0.5 - 0.5 * math.cos(2.0 * math.pi * p / phases))
+             for p in range(phases)]
+    counts = [max(1, int(l * phase_s)) for l in loads]
+    tag = "ctl" if controlled else "static"
+    npool = 16
+    privs = [edkeys.PrivKey((i + 1).to_bytes(32, "little"))
+             for i in range(npool)]
+    seq = 0
+    txs = []
+    for p, n in enumerate(counts):
+        row = []
+        for i in range(n):
+            row.append(make_signed_tx(
+                privs[seq % npool],
+                b"%s ramp payload %02d/%06d" % (tag.encode(), p, seq)))
+            seq += 1
+        txs.append(row)
+    pubs, msgs, sigs = _make_batch_selfhosted(phases * probe_n
+                                              + probe_n)
+    keys = [edkeys.PubKey(p) for p in pubs]
+    probe_subs = [[(keys[i], msgs[i], sigs[i])
+                   for i in range(p * probe_n, (p + 1) * probe_n)]
+                  for p in range(phases + 1)]
+
+    total = sum(counts)
+    # fresh SigCache per run (the _sched_main discipline): the probe
+    # batches are deterministic, so a shared cache would hand the
+    # second run instant verifies and fake a held SLO
+    from tendermint_tpu.crypto import batch as cbatch
+    cbatch.verified_sigs = cbatch.SigCache()
+    mp = Mempool(AcceptApp(), size_limit=total + 1,
+                 cache_size=2 * total, registry=Registry())
+    sched = vsched.install(vsched.VerifyScheduler(window_s=0.002))
+    sched.start()
+    # self-calibrating SLO target: one quiet probe (the spare batch,
+    # never reused) measures this host's verify floor — a fixed ms
+    # target would be unreachable on a slow host and trivially held on
+    # a fast one, and either way the bench would measure the host, not
+    # the governor.  consensus_target_ms is the floor.
+    tq = time.perf_counter()
+    assert sched.submit(probe_subs[phases],
+                        vsched.Priority.CONSENSUS).result(
+                            timeout=600).all()
+    quiet_ms = (time.perf_counter() - tq) * 1000.0
+    target_ms = max(consensus_target_ms, 3.0 * quiet_ms)
+    # each probe submit lands as ONE consensus observation (the
+    # scheduler times the batch, not the pairs), so the window is
+    # counted in PHASES: 3 keeps burn on the current weather — a clamp
+    # that works reads as recovery two phases later instead of being
+    # held hostage by every pre-clamp phase since boot
+    slo.reset()
+    slo.set_config(enabled=True, window=3,
+                   targets={"consensus": target_ms / 1000.0},
+                   budgets={"consensus": 0.10})
+    # static admission config deliberately names the failure mode the
+    # governor exists for: unlimited rate, so peak load congests the
+    # shared scheduler and the consensus probes eat the queue
+    gate = IngressGate(mp, queue_size=1024, batch=128, workers=2,
+                       rate_per_s=0.0).attach()
+    gate.start()
+    ctl = None
+    knob_names = ("ingress_rate_per_s", "ingress_burst",
+                  "sched_window_ms")
+    traj = {name: [] for name in knob_names}
+    futs = []
+    try:
+        if controlled:
+            ctl = control.install(control.Controller(period_ms=50.0,
+                                                     recover_after=2))
+            ctl.register(control.SPEC_BY_NAME["ingress_rate_per_s"],
+                         lambda: gate.rate_per_s,
+                         lambda v: gate.set_rate(rate_per_s=v))
+            ctl.register(control.SPEC_BY_NAME["ingress_burst"],
+                         lambda: gate.burst,
+                         lambda v: gate.set_rate(burst=v))
+            ctl.register(control.SPEC_BY_NAME["sched_window_ms"],
+                         lambda: sched.window_s * 1000.0,
+                         lambda v: sched.set_window(v / 1000.0),
+                         integral=False)
+            control.set_config(enable=True)
+            ctl.start()
+        held = 0
+        burns = []
+        probe_ms = []
+        t0 = time.perf_counter()
+        for p in range(phases):
+            t_end = time.perf_counter() + phase_s
+            for tx in txs[p]:
+                futs.append(gate.submit(tx, source="p2p:benchctl"))
+            tp = time.perf_counter()
+            f = sched.submit(probe_subs[p], vsched.Priority.CONSENSUS)
+            assert f.result(timeout=600).all()
+            probe_ms.append((time.perf_counter() - tp) * 1000.0)
+            rep = slo.stream_report("consensus") or {}
+            burn = rep.get("burn_rate")
+            burns.append(None if burn is None else round(burn, 3))
+            if burn is None or burn <= 1.0:
+                held += 1
+            for name in knob_names:
+                traj[name].append(round({
+                    "ingress_rate_per_s": gate.rate_per_s,
+                    "ingress_burst": gate.burst,
+                    "sched_window_ms": sched.window_s * 1000.0,
+                }[name], 2))
+            rest = t_end - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+    finally:
+        if ctl is not None:
+            ctl.stop()
+            control.uninstall()
+            control.set_config(enable=None)
+        gate.stop()
+        sched.stop()
+        vsched.uninstall(sched)
+        slo.set_config(enabled=False, targets={}, budgets={})
+        slo.reset()
+    admitted = sum(1 for r in results if r.code == 0)
+    shed = sum(1 for r in results
+               if r.codespace == "ingress")
+    return {
+        "held_slo_fraction": round(held / phases, 3),
+        "burns": burns,
+        "probe_p99_ms": _quantile_ms([m / 1000.0 for m in probe_ms],
+                                     0.99),
+        "admitted": admitted, "shed": shed, "total": total,
+        "admitted_tx_per_s": round(admitted / wall, 1),
+        "knob_trajectory": traj,
+        "decisions": (ctl.report()["decisions"] if ctl is not None
+                      else []),
+        "target_ms": round(target_ms, 2),
+        "quiet_probe_ms": round(quiet_ms, 2),
+        "wall_s": round(wall, 2),
+    }
+
+
+def _control_main():
+    """Adaptive-control config (BENCH_CONTROL=1, ADR-023): the SAME
+    diurnal ramp twice — static knobs, then governed — and one rc=0
+    JSON line whose value is the governed run's held-SLO fraction with
+    the static twin's alongside.  Host-capable: without an accelerator
+    the verifies run on host lanes (explicit note)."""
+    phases = int(os.environ.get("BENCH_CONTROL_PHASES", "12"))
+    phase_s = float(os.environ.get("BENCH_CONTROL_PHASE_S", "0.4"))
+    peak = float(os.environ.get("BENCH_CONTROL_PEAK_TPS", "1500"))
+    target_ms = float(os.environ.get("BENCH_CONTROL_TARGET_MS", "50"))
+
+    platform, probe_err = _probe_backend()
+    device = probe_err is None and platform != "cpu"
+    if probe_err is not None:
+        os.environ["TM_TPU_DISABLE_BATCH"] = "1"
+        print(f"# control bench: backend probe failed, host-only: "
+              f"{probe_err}", file=sys.stderr)
+
+    static = run_control_ramp(False, phases=phases, phase_s=phase_s,
+                              peak_tps=peak,
+                              consensus_target_ms=target_ms)
+    governed = run_control_ramp(True, phases=phases, phase_s=phase_s,
+                                peak_tps=peak,
+                                consensus_target_ms=target_ms)
+    moves = {}
+    for d in governed["decisions"]:
+        key = f"{d['knob']}:{d['direction']}"
+        moves[key] = moves.get(key, 0) + 1
+    line = {
+        "metric": "control_held_slo_fraction",
+        "value": governed["held_slo_fraction"],
+        "unit": "fraction",
+        "static_held_fraction": static["held_slo_fraction"],
+        "probe_p99_ms": governed["probe_p99_ms"],
+        "static_probe_p99_ms": static["probe_p99_ms"],
+        "admitted_tx_per_s": governed["admitted_tx_per_s"],
+        "static_admitted_tx_per_s": static["admitted_tx_per_s"],
+        "shed": governed["shed"], "static_shed": static["shed"],
+        "knob_trajectory": governed["knob_trajectory"],
+        "decision_counts": moves,
+        "phases": phases, "peak_tps": peak,
+        "target_ms": governed["target_ms"],
+        "quiet_probe_ms": governed["quiet_probe_ms"],
+        "trace": _trace_artifact("control"),
+    }
+    if not device:
+        line["note"] = "device unavailable, host fallback"
+    _emit(line)
+    print(f"# control bench: phases={phases} peak={peak}/s "
+          f"static_burns={static['burns']} "
+          f"governed_burns={governed['burns']}", file=sys.stderr)
+
+
 def _quantile_ms(vals, q):
     """Nearest-rank quantile over `vals` (seconds), in ms — THE
     libs/slo.py definition (imported, not copied), so the bench line
@@ -1194,6 +1422,9 @@ def main():
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_CONTROL") == "1":
+        _control_main()
+        return
     if os.environ.get("BENCH_STATESYNC") == "1":
         _statesync_main()
         return

@@ -598,6 +598,31 @@ def config12_statesync(n_heights=24):
             "resume_vs_cold": r["resume_vs_cold"]}
 
 
+def config13_control(phases=8):
+    """Adaptive control plane (libs/control.py, ADR-023): the SAME
+    diurnal load ramp twice — static knobs, then governed — through the
+    real IngressGate + VerifyScheduler.  Columns mirror the
+    BENCH_CONTROL=1 bench.py line: held-SLO fraction for both twins,
+    probe p99, and how many knob moves the governor made."""
+    from bench import run_control_ramp
+
+    static = run_control_ramp(False, phases=phases)
+    governed = run_control_ramp(True, phases=phases)
+    moves = {}
+    for d in governed["decisions"]:
+        key = f"{d['knob']}:{d['direction']}"
+        moves[key] = moves.get(key, 0) + 1
+    return {"config": f"13: adaptive control, {phases}-phase ramp",
+            "held_slo_fraction": governed["held_slo_fraction"],
+            "static_held_fraction": static["held_slo_fraction"],
+            "probe_p99_ms": governed["probe_p99_ms"],
+            "static_probe_p99_ms": static["probe_p99_ms"],
+            "admitted_tx_per_s": governed["admitted_tx_per_s"],
+            "static_admitted_tx_per_s": static["admitted_tx_per_s"],
+            "target_ms": governed["target_ms"],
+            "knob_moves": moves}
+
+
 def main():
     import json
 
@@ -618,7 +643,7 @@ def main():
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
-           config11_consensus, config12_statesync)
+           config11_consensus, config12_statesync, config13_control)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

@@ -469,6 +469,31 @@ def cmd_debug_device(args):
         print(json.dumps(json.loads(body), indent=2))
 
 
+def cmd_debug_control(args):
+    """Snapshot the running node's adaptive control plane
+    (libs/control.py, ADR-023) via its pprof listener's
+    GET /debug/control — every governed knob's current vs static value
+    and safe range, the bounded decision ring (what the loop did and
+    why), and the kill-switch state."""
+    import urllib.request
+
+    addr = _pprof_addr(args, "and enable the controller with "
+                             "[control] enable or TM_TPU_CONTROL=1")
+    url = f"http://{addr}/debug/control"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        print(f"wrote control-plane report ({len(doc.get('knobs') or {})}"
+              f" knobs, {len(doc.get('decisions') or [])} decisions) "
+              f"to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
 def cmd_debug_index(args):
     """Print the pprof listener's GET /debug index — every registered
     debug endpoint with a one-line description, so operators stop
@@ -799,6 +824,14 @@ def main(argv=None):
                     help="newest N launch records")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_device)
+    sp = sub.add_parser("debug-control",
+                        help="snapshot the node's adaptive control "
+                             "plane (knob values + decision ring + "
+                             "kill state)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_control)
     sp = sub.add_parser("debug-index",
                         help="list the pprof listener's registered "
                              "debug endpoints")

@@ -6,6 +6,7 @@ data/ (stores + WAL).
 """
 from __future__ import annotations
 
+import math
 import os
 try:
     import tomllib
@@ -305,6 +306,19 @@ class SLOConfig:
     apply_p99_ms: float = 0.0
     device_launch_p99_ms: float = 0.0
     statesync_p99_ms: float = 0.0
+    # per-stream error budgets in PERCENT of windowed requests allowed
+    # over the p99 target (the burn-rate denominator; 1.0 = the p99
+    # convention).  Replaces the old hardcoded _P99_BUDGET constant
+    consensus_budget_pct: float = 1.0
+    commit_budget_pct: float = 1.0
+    blocksync_budget_pct: float = 1.0
+    mempool_budget_pct: float = 1.0
+    block_interval_budget_pct: float = 1.0
+    propose_budget_pct: float = 1.0
+    quorum_prevote_budget_pct: float = 1.0
+    apply_budget_pct: float = 1.0
+    device_launch_budget_pct: float = 1.0
+    statesync_budget_pct: float = 1.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
@@ -315,12 +329,93 @@ class SLOConfig:
                 out[stream] = ms / 1000.0
         return out
 
+    def budgets(self) -> dict:
+        """Stream -> error-budget FRACTION (percent / 100), every
+        stream (the estimator falls back to its own default for
+        missing ones, so emitting all keeps config the single source
+        of truth)."""
+        return {stream: getattr(self, f"{stream}_budget_pct") / 100.0
+                for stream in self.STREAMS}
+
     def validate_basic(self):
         if self.window <= 0:
             raise ValueError("slo.window must be positive")
         for stream in self.STREAMS:
             if getattr(self, f"{stream}_p99_ms") < 0:
                 raise ValueError(f"slo.{stream}_p99_ms must be >= 0")
+            pct = getattr(self, f"{stream}_budget_pct")
+            if not (0 < pct <= 100):
+                raise ValueError(
+                    f"slo.{stream}_budget_pct must be in (0, 100]")
+
+
+@dataclass
+class ControlConfig:
+    """Adaptive control plane (libs/control.py, ADR-023): the
+    SLO-burn-driven knob governor.  OFF by default — enabling it hands
+    the declared knobs (verify-scheduler window, host-lane pool width,
+    ingress admission rate/burst, block-pipeline depth, statesync
+    fetchers, comb min-batch) to a bounded AIMD decision loop that
+    steers them inside the per-knob [min, max] safe ranges below and
+    reverts every knob to its static configured value on kill
+    (`control.kill()` / TM_TPU_CONTROL=0) within one period.  Ranges
+    here TIGHTEN the literal KNOB_SPECS declarations; they never widen
+    what the code declared safe."""
+    # one row per governed knob (libs/control.KNOB_SPECS)
+    KNOBS = ("sched_window_ms", "host_pool_workers",
+             "ingress_rate_per_s", "ingress_burst", "pipeline_depth",
+             "statesync_fetchers", "comb_min_batch")
+
+    enable: bool = False
+    period_ms: float = 1000.0   # decision-loop period
+    recover_after: int = 3      # clean periods before additive recovery
+    sched_window_ms_min: float = 0.5
+    sched_window_ms_max: float = 20.0
+    sched_window_ms_step: float = 0.5
+    host_pool_workers_min: float = 1.0
+    host_pool_workers_max: float = 16.0
+    host_pool_workers_step: float = 1.0
+    ingress_rate_per_s_min: float = 32.0
+    ingress_rate_per_s_max: float = 100000.0
+    ingress_rate_per_s_step: float = 64.0
+    ingress_burst_min: float = 16.0
+    ingress_burst_max: float = 65536.0
+    ingress_burst_step: float = 64.0
+    pipeline_depth_min: float = 2.0
+    pipeline_depth_max: float = 32.0
+    pipeline_depth_step: float = 1.0
+    statesync_fetchers_min: float = 1.0
+    statesync_fetchers_max: float = 32.0
+    statesync_fetchers_step: float = 1.0
+    comb_min_batch_min: float = 16.0
+    comb_min_batch_max: float = 4096.0
+    comb_min_batch_step: float = 16.0
+
+    def range_of(self, knob: str) -> tuple:
+        return (getattr(self, f"{knob}_min"),
+                getattr(self, f"{knob}_max"))
+
+    def step_of(self, knob: str) -> float:
+        return getattr(self, f"{knob}_step")
+
+    def validate_basic(self):
+        if self.period_ms <= 0:
+            raise ValueError("control.period_ms must be positive")
+        if self.recover_after <= 0:
+            raise ValueError("control.recover_after must be positive")
+        for knob in self.KNOBS:
+            lo, hi = self.range_of(knob)
+            step = self.step_of(knob)
+            if not (math.isfinite(lo) and math.isfinite(hi)
+                    and math.isfinite(step)):
+                raise ValueError(
+                    f"control.{knob} min/max/step must be finite")
+            if lo > hi:
+                raise ValueError(
+                    f"control.{knob}_min must be <= {knob}_max")
+            if step <= 0:
+                raise ValueError(
+                    f"control.{knob}_step must be positive")
 
 
 @dataclass
@@ -350,13 +445,15 @@ class Config:
     block_pipeline: BlockPipelineConfig = field(
         default_factory=BlockPipelineConfig)
     devobs: DevObsConfig = field(default_factory=DevObsConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
         every section validates, errors carry the section name."""
         for name in ("p2p", "mempool", "rpc", "consensus",
                      "batch_verifier", "verify_scheduler", "slo",
-                     "block_pipeline", "devobs", "state_sync"):
+                     "block_pipeline", "devobs", "state_sync",
+                     "control"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -508,6 +605,42 @@ quorum_prevote_p99_ms = {self.slo.quorum_prevote_p99_ms}
 apply_p99_ms = {self.slo.apply_p99_ms}
 device_launch_p99_ms = {self.slo.device_launch_p99_ms}
 statesync_p99_ms = {self.slo.statesync_p99_ms}
+consensus_budget_pct = {self.slo.consensus_budget_pct}
+commit_budget_pct = {self.slo.commit_budget_pct}
+blocksync_budget_pct = {self.slo.blocksync_budget_pct}
+mempool_budget_pct = {self.slo.mempool_budget_pct}
+block_interval_budget_pct = {self.slo.block_interval_budget_pct}
+propose_budget_pct = {self.slo.propose_budget_pct}
+quorum_prevote_budget_pct = {self.slo.quorum_prevote_budget_pct}
+apply_budget_pct = {self.slo.apply_budget_pct}
+device_launch_budget_pct = {self.slo.device_launch_budget_pct}
+statesync_budget_pct = {self.slo.statesync_budget_pct}
+
+[control]
+enable = {str(self.control.enable).lower()}
+period_ms = {self.control.period_ms}
+recover_after = {self.control.recover_after}
+sched_window_ms_min = {self.control.sched_window_ms_min}
+sched_window_ms_max = {self.control.sched_window_ms_max}
+sched_window_ms_step = {self.control.sched_window_ms_step}
+host_pool_workers_min = {self.control.host_pool_workers_min}
+host_pool_workers_max = {self.control.host_pool_workers_max}
+host_pool_workers_step = {self.control.host_pool_workers_step}
+ingress_rate_per_s_min = {self.control.ingress_rate_per_s_min}
+ingress_rate_per_s_max = {self.control.ingress_rate_per_s_max}
+ingress_rate_per_s_step = {self.control.ingress_rate_per_s_step}
+ingress_burst_min = {self.control.ingress_burst_min}
+ingress_burst_max = {self.control.ingress_burst_max}
+ingress_burst_step = {self.control.ingress_burst_step}
+pipeline_depth_min = {self.control.pipeline_depth_min}
+pipeline_depth_max = {self.control.pipeline_depth_max}
+pipeline_depth_step = {self.control.pipeline_depth_step}
+statesync_fetchers_min = {self.control.statesync_fetchers_min}
+statesync_fetchers_max = {self.control.statesync_fetchers_max}
+statesync_fetchers_step = {self.control.statesync_fetchers_step}
+comb_min_batch_min = {self.control.comb_min_batch_min}
+comb_min_batch_max = {self.control.comb_min_batch_max}
+comb_min_batch_step = {self.control.comb_min_batch_step}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -618,7 +751,18 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
             enable=bool(sl.get("enable", False)),
             window=int(sl.get("window", 1024)),
             **{f"{s}_p99_ms": float(sl.get(f"{s}_p99_ms", 0.0))
+               for s in SLOConfig.STREAMS},
+            **{f"{s}_budget_pct": float(sl.get(f"{s}_budget_pct", 1.0))
                for s in SLOConfig.STREAMS})
+        ct = d.get("control", {})
+        defaults = ControlConfig()
+        cfg.control = ControlConfig(
+            enable=bool(ct.get("enable", False)),
+            period_ms=float(ct.get("period_ms", 1000.0)),
+            recover_after=int(ct.get("recover_after", 3)),
+            **{f: float(ct.get(f, getattr(defaults, f)))
+               for knob in ControlConfig.KNOBS
+               for f in (f"{knob}_min", f"{knob}_max", f"{knob}_step")})
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

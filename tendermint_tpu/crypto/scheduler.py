@@ -276,6 +276,18 @@ class VerifyScheduler(BaseService):
         }
         self._exec_since: Optional[float] = None
 
+    # -- live reconfiguration (ADR-023) ------------------------------------
+
+    def set_window(self, window_s: float):
+        """Thread-safe live coalescing-window change (the adaptive
+        control plane's seam).  The collector re-reads window_s on
+        every wait iteration, so a plain clamped store takes effect on
+        the NEXT window close; the wake lets a widened window re-arm
+        without waiting out the old deadline."""
+        self.window_s = max(0.0, float(window_s))
+        with self._cond:
+            self._cond.notify_all()
+
     # -- metrics -----------------------------------------------------------
 
     @staticmethod

@@ -149,6 +149,14 @@ LOCK_ORDER = {
     # slo (76... metrics 80/84 — publication runs with the ring lock
     # dropped, so the lower slo rank is never acquired under it)
     "tendermint_tpu/crypto/devobs.py:DevObs._lock": 78,
+    # adaptive control plane (libs/control.py, ADR-023): the install
+    # lock ranks with the other process-global install locks (it holds
+    # is_running()'s _mtx 60 check under it); Controller._lock is a
+    # LEAF — registry/ring/bookkeeping only, every knob setter (which
+    # acquires pipeline 14/16, ingress 18, scheduler 20...) and every
+    # metrics/trace publication runs with it RELEASED
+    "tendermint_tpu/libs/control.py:_global_lock": 26,
+    "tendermint_tpu/libs/control.py:Controller._lock": 79,
 
     # -- observability: always acquired last, hold nothing --
     "tendermint_tpu/libs/metrics.py:Registry._lock": 80,

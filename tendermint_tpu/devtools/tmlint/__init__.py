@@ -4,8 +4,9 @@ for the tendermint_tpu verify stack (docs/adr/adr-014-tmlint.md).
 Static passes (pure AST, no jax):
   passes_shape    TM101/TM102  compile-shape discipline at kernel seams
   passes_locks    TM201-TM204  lock order, blocking calls, table parity
-  passes_hygiene  TM301-TM307  threads, optional deps, f-strings,
-                               except-pass, chaos/trace/metric registries
+  passes_hygiene  TM301-TM308  threads, optional deps, f-strings,
+                               except-pass, chaos/trace/metric
+                               registries, KnobSpec envelopes
 
 Runtime sanitizers (tmlint.runtime, imported only by tests):
   CompileSentinel  per-test XLA bucket/compile accounting

@@ -195,6 +195,14 @@ RULES = [
          "``self._metrics().X``) that no bundle class in "
          "libs/metrics.py registers.  Catches typo'd metric names that "
          "would otherwise AttributeError only on the failure path."),
+    Rule("TM308", "undeclared-knob-envelope", "all linted files",
+         "A KnobSpec(...) declaration (libs/control.py, ADR-023) whose "
+         "safe_range is not a literal finite (lo, hi) tuple with "
+         "lo <= hi, whose step is not a literal > 0, or whose signal "
+         "does not name a metric registered by a bundle class in "
+         "libs/metrics.py.  The adaptive control plane only moves "
+         "knobs inside ranges a human declared and reviews, steering "
+         "on published signals only."),
 ]
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -1,4 +1,4 @@
-"""TM301-TM307 — hygiene rules and registry checks.
+"""TM301-TM308 — hygiene rules and registry checks.
 
 Each rule encodes one invariant that previously lived only as prose in
 CHANGES.md / ADRs:
@@ -11,6 +11,8 @@ CHANGES.md / ADRs:
   TM305  fail.inject sites registered in libs/fail.REGISTERED_SITES
   TM306  trace span/instant names registered in libs/trace.KNOWN_SPANS
   TM307  metrics-bundle attribute reads name registered metrics
+  TM308  every KnobSpec declares a literal finite safe_range and a
+         signal naming a registered metric (ADR-023 control plane)
 
 The registries are read by AST, not import — the pass must work with
 no package import at all (and libs/fail.py stays enforceable even when
@@ -380,6 +382,91 @@ def _check_trace_spans(f: SourceFile, known: Set[str],
 
 
 # ---------------------------------------------------------------------------
+# TM308 — KnobSpec declarations (adaptive control plane, ADR-023)
+# ---------------------------------------------------------------------------
+
+_KNOBSPEC_PARAMS = ("name", "safe_range", "step", "direction",
+                    "signal", "mode", "labels")
+
+
+def _knobspec_arg(call: ast.Call, param: str) -> Optional[ast.AST]:
+    idx = _KNOBSPEC_PARAMS.index(param)
+    if idx < len(call.args):
+        return call.args[idx]
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    return None
+
+
+def _numeric_const(node: Optional[ast.AST]) -> Optional[float]:
+    """The value of a literal int/float (incl. unary minus), else None."""
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, ast.USub) and \
+            isinstance(node.operand, ast.Constant) and \
+            isinstance(node.operand.value, (int, float)):
+        return -float(node.operand.value)
+    if isinstance(node, ast.Constant) and \
+            isinstance(node.value, (int, float)) and \
+            not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+def _check_knob_specs(f: SourceFile, metric_attrs: Set[str],
+                      findings: List[Finding]):
+    """Every KnobSpec(...) call must DECLARE its envelope as literals:
+    a finite (lo, hi) safe_range with lo <= hi, a literal step > 0,
+    and a literal signal string naming a metric some bundle class in
+    libs/metrics.py registers.  The governor only ever moves a knob
+    inside a range a human wrote down and reviews — a computed range
+    or a typo'd steering signal is a lint error, not a 3am incident."""
+    if f.tree is None:
+        return
+    import math as _math
+    for node in ast.walk(f.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node.func) == "KnobSpec"):
+            continue
+        name_node = _knobspec_arg(node, "name")
+        label = name_node.value \
+            if isinstance(name_node, ast.Constant) \
+            and isinstance(name_node.value, str) else "<dynamic>"
+        rng = _knobspec_arg(node, "safe_range")
+        ok_range = False
+        if isinstance(rng, (ast.Tuple, ast.List)) and \
+                len(rng.elts) == 2:
+            lo = _numeric_const(rng.elts[0])
+            hi = _numeric_const(rng.elts[1])
+            ok_range = (lo is not None and hi is not None
+                        and _math.isfinite(lo) and _math.isfinite(hi)
+                        and lo <= hi)
+        if not ok_range:
+            findings.append(Finding(
+                "TM308", f.path, node.lineno, "<module>",
+                f"KnobSpec {label!r}: safe_range must be a LITERAL "
+                "finite (lo, hi) tuple with lo <= hi — the governor's "
+                "envelope is declared and reviewed, never computed"))
+        step = _numeric_const(_knobspec_arg(node, "step"))
+        if step is None or not (_math.isfinite(step) and step > 0):
+            findings.append(Finding(
+                "TM308", f.path, node.lineno, "<module>",
+                f"KnobSpec {label!r}: step must be a literal finite "
+                "number > 0"))
+        sig = _knobspec_arg(node, "signal")
+        if not (isinstance(sig, ast.Constant)
+                and isinstance(sig.value, str)
+                and sig.value in metric_attrs):
+            got = sig.value if isinstance(sig, ast.Constant) else None
+            findings.append(Finding(
+                "TM308", f.path, node.lineno, "<module>",
+                f"KnobSpec {label!r}: signal {got!r} must be a literal "
+                "string naming a metric registered by a bundle class "
+                "in libs/metrics.py — the control plane steers on "
+                "PUBLISHED signals only"))
+
+
+# ---------------------------------------------------------------------------
 # TM307 — metric attribute reads
 # ---------------------------------------------------------------------------
 
@@ -433,4 +520,5 @@ def check(corpus: Corpus) -> List[Finding]:
         _check_fail_sites(f, exact, prefixes, findings)
         _check_trace_spans(f, spans, findings)
         _check_metric_attrs(f, metric_attrs, findings)
+        _check_knob_specs(f, metric_attrs, findings)
     return findings

@@ -128,6 +128,13 @@ REGISTERED_SITES = frozenset({
     "statesync.verify",
     "statesync.apply",
     "statesync.serve",
+    # adaptive control plane (libs/control.py, ADR-023): fires at the
+    # top of every decision period.  raise = the WHOLE period's
+    # decisions are skipped (counted under knob=period,
+    # direction=skipped) and every knob reverts to its static
+    # configured value — a malfunctioning controller must fail static,
+    # never fail steering; latency is absorbed into the period
+    "control.decide",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

@@ -567,8 +567,16 @@ class CryptoMetrics:
             "crypto", "slo_burn_rate",
             "Error-budget burn rate against the stream's p99 target "
             "([slo] config): windowed fraction of requests over "
-            "target / 0.01.  1.0 = spending the budget exactly as "
+            "target / the stream's [slo] budget (budget_pct/100, "
+            "default 0.01).  1.0 = spending the budget exactly as "
             "fast as the SLO allows.", labels=("stream",))
+        self.slo_target = reg.gauge(
+            "crypto", "slo_target_seconds",
+            "Configured p99 target per stream ([slo] <stream>_p99_ms, "
+            "seconds).  Published so SLO consumers — the adaptive "
+            "control plane (ADR-023), dashboards — read targets from "
+            "metrics instead of magic constants.  Absent for streams "
+            "with no configured target.", labels=("stream",))
 
 
 class DevObsMetrics:
@@ -738,3 +746,38 @@ class MempoolMetrics:
             "submit to settled ResponseCheckTx (queue wait + batched "
             "pre-verify + app CheckTx + insert).",
             buckets=exp_buckets(0.0002, 4, 10))
+
+
+class ControlMetrics:
+    """Adaptive control plane (libs/control.py, ADR-023): what the
+    knob governor decided, where every governed knob sits right now,
+    how often moves hit a declared safe-range bound, and whether the
+    kill switch is flipped.  The decision RING (the why behind each
+    move) is served at GET /debug/control; these are the aggregates."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or DEFAULT
+        self.decisions = reg.counter(
+            "control", "decisions_total",
+            "Knob moves by the decision loop, by knob and direction "
+            "(grow / shrink / revert / held: the seam refused this "
+            "period's move / error: the knob's seam raised / skipped: "
+            "a whole period skipped at the control.decide chaos "
+            "seam, knob=period).", labels=("knob", "direction"))
+        self.knob_value = reg.gauge(
+            "control", "knob_value",
+            "Current value of each governed knob as last applied or "
+            "observed by the controller (registration publishes the "
+            "static configured value).", labels=("knob",))
+        self.clamped = reg.counter(
+            "control", "clamped_total",
+            "Decisions whose target was clamped onto a declared "
+            "safe-range bound — persistent clamping means the range "
+            "(or the workload) needs operator attention.",
+            labels=("knob",))
+        self.killed = reg.gauge(
+            "control", "killed",
+            "1 while the kill switch is flipped (control.kill() / "
+            "chaos at control.decide): every knob is reverted to its "
+            "static configured value and the loop refuses further "
+            "decisions.")

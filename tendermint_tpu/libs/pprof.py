@@ -33,6 +33,11 @@ image) and with near-zero overhead when idle:
                                transfer/compute/compile decomposition,
                                the compile-cache inventory, and the
                                HBM residency ledger
+  GET /debug/control           adaptive control plane (libs/control.py,
+                               ADR-023): every governed knob's current
+                               vs static value and safe range, the
+                               bounded decision ring, and the
+                               kill-switch state
   GET /debug                   index: every registered debug endpoint
                                with a one-line description, so
                                operators stop guessing URLs
@@ -85,6 +90,9 @@ DEBUG_ENDPOINTS = (
     ("/debug/device?last=N",
      "device observatory: per-launch transfer/compute/compile "
      "decomposition, compile-cache inventory, HBM ledger (ADR-021)"),
+    ("/debug/control",
+     "adaptive control plane: knob values, decision ring, kill state "
+     "(ADR-023)"),
 )
 
 
@@ -249,6 +257,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "last_lane_report": _cbatch.last_lane_report(),
                 }
                 self._send(200, json.dumps(body, default=str),
+                           ctype="application/json")
+            elif url.path == "/debug/control":
+                # the adaptive control plane (ADR-023): every governed
+                # knob's current/static value and safe range, the
+                # bounded decision ring, and the kill-switch state
+                from tendermint_tpu.libs import control
+                self._send(200, json.dumps(control.report(),
+                                           default=str),
                            ctype="application/json")
             else:
                 self._send(404, "unknown route; GET /debug for the "

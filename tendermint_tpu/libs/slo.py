@@ -30,8 +30,14 @@ Design constraints, in trace.py's order:
 
 Burn rate: a per-stream p99 target (seconds) turns the ring into an
 error-budget gauge — ``burn_rate = (fraction of windowed observations
-over target) / 0.01``.  1.0 means the stream is spending its p99
-budget exactly as fast as the SLO allows; 10 means a page.
+over target) / budget``, where ``budget`` defaults to the p99
+convention (0.01: 1% of requests may exceed the target) and is
+per-stream configurable via ``[slo] <stream>_budget_pct``.  1.0 means
+the stream is spending its budget exactly as fast as the SLO allows;
+10 means a page.  The targets themselves are published as the
+``tendermint_crypto_slo_target_seconds{stream}`` gauge so consumers
+(the adaptive control plane, dashboards) read them from metrics, not
+magic constants.
 
 Read it back via ``slo.report()``, ``GET /debug/latency`` on the pprof
 listener, or the ``debug-latency`` CLI (cmd/__main__.py).
@@ -45,8 +51,9 @@ from typing import Dict, List, Optional
 
 _DEFAULT_WINDOW = 1024
 
-# the p99 objective the burn rate is computed against: a p99 target
-# budgets 1% of requests over it
+# the default error budget the burn rate is computed against: a p99
+# target budgets 1% of requests over it.  Per-stream overrides come
+# from the [slo] <stream>_budget_pct config fields (as fractions here)
 _P99_BUDGET = 0.01
 
 
@@ -77,7 +84,8 @@ class SloEstimator:
 
     def __init__(self, window: Optional[int] = None,
                  targets: Optional[Dict[str, float]] = None,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 budgets: Optional[Dict[str, float]] = None):
         if enabled is None:
             enabled = os.environ.get("TM_TPU_SLO", "") == "1"
         if window is None:
@@ -92,6 +100,10 @@ class SloEstimator:
         # stream -> p99 target in SECONDS (config carries ms; the node
         # wiring converts)
         self.targets: Dict[str, float] = dict(targets or {})
+        # stream -> error-budget FRACTION (config carries percent; the
+        # node wiring divides by 100).  Missing streams fall back to
+        # the p99 convention (_P99_BUDGET)
+        self.budgets: Dict[str, float] = dict(budgets or {})
         self._enabled = bool(enabled)
         self._lock = threading.Lock()
         self._streams: Dict[str, _Stream] = {}
@@ -102,13 +114,16 @@ class SloEstimator:
         return self._enabled
 
     def enable(self, window: Optional[int] = None,
-               targets: Optional[Dict[str, float]] = None):
+               targets: Optional[Dict[str, float]] = None,
+               budgets: Optional[Dict[str, float]] = None):
         with self._lock:
             if window is not None and int(window) != self.window:
                 self.window = max(1, int(window))
                 self._streams.clear()  # rings are sized at creation
             if targets is not None:
                 self.targets = dict(targets)
+            if budgets is not None:
+                self.budgets = dict(budgets)
         self._enabled = True
 
     def disable(self):
@@ -116,7 +131,8 @@ class SloEstimator:
 
     def set_config(self, enabled: Optional[bool] = None,
                    window: Optional[int] = None,
-                   targets: Optional[Dict[str, float]] = None):
+                   targets: Optional[Dict[str, float]] = None,
+                   budgets: Optional[Dict[str, float]] = None):
         """Apply config without touching the enabled flag unless asked
         (enable() unconditionally arms; this must not — see the
         module-level set_config)."""
@@ -126,6 +142,8 @@ class SloEstimator:
                 self._streams.clear()  # rings are sized at creation
             if targets is not None:
                 self.targets = dict(targets)
+            if budgets is not None:
+                self.budgets = dict(budgets)
         if enabled is not None:
             self._enabled = bool(enabled)
 
@@ -183,10 +201,14 @@ class SloEstimator:
         }
         target = self.targets.get(stream)
         if target is not None and target > 0:
+            budget = self.budgets.get(stream, _P99_BUDGET)
+            if not (budget > 0):
+                budget = _P99_BUDGET
             over = sum(1 for v in vals if v > target)
             out["target_p99_s"] = target
+            out["budget"] = budget
             out["over_target_frac"] = over / n
-            out["burn_rate"] = (over / n) / _P99_BUDGET
+            out["burn_rate"] = (over / n) / budget
         return out
 
     def report(self) -> dict:
@@ -196,6 +218,7 @@ class SloEstimator:
             "enabled": self._enabled,
             "window": self.window,
             "targets_s": dict(self.targets),
+            "budgets": dict(self.budgets),
             "streams": {s: self.stream_report(s) for s in sorted(streams)},
         }
 
@@ -219,9 +242,26 @@ def is_enabled() -> bool:
     return EST._enabled
 
 
+def _publish_targets():
+    """Publish the GLOBAL estimator's per-stream targets as the
+    crypto_slo_target_seconds{stream} gauge (report-time only, never
+    the observe() hot path).  Consumers — the adaptive control plane
+    (ADR-023), dashboards — read targets from metrics, not from this
+    module's internals."""
+    targets = dict(EST.targets)
+    if not targets:
+        return
+    from tendermint_tpu.libs.metrics import CryptoMetrics
+    m = CryptoMetrics()
+    for stream, target in targets.items():
+        m.slo_target.set(float(target), stream=stream)
+
+
 def enable(window: Optional[int] = None,
-           targets: Optional[Dict[str, float]] = None):
-    EST.enable(window=window, targets=targets)
+           targets: Optional[Dict[str, float]] = None,
+           budgets: Optional[Dict[str, float]] = None):
+    EST.enable(window=window, targets=targets, budgets=budgets)
+    _publish_targets()
 
 
 def disable():
@@ -246,11 +286,14 @@ def report() -> dict:
 
 def set_config(enabled: Optional[bool] = None,
                window: Optional[int] = None,
-               targets: Optional[Dict[str, float]] = None):
+               targets: Optional[Dict[str, float]] = None,
+               budgets: Optional[Dict[str, float]] = None):
     """Node wiring ([slo] config section): the operator's config wins
     over a stale env var in BOTH directions (mirrors
     ops/secp.set_lane_enabled and edops.set_comb_config).  None leaves
     a dimension untouched.  Never routes through enable(): configuring
     a DISABLED estimator must not open even a transient window where a
     concurrent observe() records into it."""
-    EST.set_config(enabled=enabled, window=window, targets=targets)
+    EST.set_config(enabled=enabled, window=window, targets=targets,
+                   budgets=budgets)
+    _publish_targets()

@@ -65,6 +65,9 @@ KNOWN_SPANS = frozenset({
     # crypto/degrade.py — breaker + device lane lifecycle
     "breaker.transition", "device.collect", "device.host_fallback",
     "device.launch",
+    # libs/control.py — adaptive control plane decision periods
+    # (ADR-023)
+    "control.decide",
     # crypto/lanepool.py — sharded native C host verify (ADR-015)
     "lanepool.verify",
     # networks/ — the in-process multi-node harness (ADR-019)

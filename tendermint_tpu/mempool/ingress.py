@@ -212,6 +212,31 @@ class IngressGate(BaseService):
                        "sig_rejected": 0, "fallback_batches": 0,
                        "rechecked": 0}
 
+    # -- live reconfiguration (ADR-023) ------------------------------------
+
+    def set_rate(self, rate_per_s: Optional[float] = None,
+                 burst: Optional[float] = None):
+        """Thread-safe live admission-rate change (the adaptive control
+        plane's seam, ADR-023).  Buckets snapshot rate/burst at
+        construction, so every LIVE per-source bucket is re-clamped
+        here too — a rate cut takes effect immediately for sources
+        already being limited, not only for new ones.  None leaves a
+        dimension untouched; rate 0 disables limiting (the static
+        "unlimited" default)."""
+        with self._rl_lock:
+            if rate_per_s is not None:
+                self.rate_per_s = max(0.0, float(rate_per_s))
+            if burst is not None:
+                self.burst = (float(burst) if burst > 0
+                              else max(1.0, self.rate_per_s))
+            for b in self._buckets.values():
+                b.rate = self.rate_per_s
+                b.burst = self.burst
+                # never GRANT tokens on a clamp-down: a flooding
+                # source's saved-up allowance must shrink with the
+                # burst, not persist past it
+                b.tokens = min(b.tokens, self.burst)
+
     # -- lifecycle ---------------------------------------------------------
 
     def attach(self):

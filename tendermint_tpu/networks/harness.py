@@ -230,6 +230,9 @@ class NetHarness:
                  mempool_overrides: Optional[dict] = None,
                  app_overrides: Optional[dict] = None,
                  statesync_overrides: Optional[dict] = None,
+                 control_overrides: Optional[dict] = None,
+                 slo_overrides: Optional[dict] = None,
+                 verify_scheduler_overrides: Optional[dict] = None,
                  power: int = 10, chain_id: str = "netharness-chain"):
         self.n_validators = validators
         self.n_nodes = validators + standbys
@@ -241,6 +244,10 @@ class NetHarness:
         self.mempool_overrides = dict(mempool_overrides or {})
         self.app_overrides = dict(app_overrides or {})
         self.statesync_overrides = dict(statesync_overrides or {})
+        self.control_overrides = dict(control_overrides or {})
+        self.slo_overrides = dict(slo_overrides or {})
+        self.verify_scheduler_overrides = dict(
+            verify_scheduler_overrides or {})
         self.workdir = workdir or tempfile.mkdtemp(prefix="tm_netharness_")
         self.net = VirtualNetwork(
             seed=seed,
@@ -255,6 +262,10 @@ class NetHarness:
         self._flood_reactor: Optional[_FloodReactor] = None
         self._chunk_flooder: Optional[Switch] = None
         self._flood_seq = 0
+        self._ramp_stop = threading.Event()
+        self._ramp_thread: Optional[threading.Thread] = None
+        self._ramp_sent = 0
+        self._ramp_rejected = 0
         self._genesis_json: Optional[str] = None
         self._scaffold()
 
@@ -295,6 +306,12 @@ class NetHarness:
             setattr(cfg.mempool, k, v)
         for k, v in self.statesync_overrides.items():
             setattr(cfg.state_sync, k, v)
+        for k, v in self.control_overrides.items():
+            setattr(cfg.control, k, v)
+        for k, v in self.slo_overrides.items():
+            setattr(cfg.slo, k, v)
+        for k, v in self.verify_scheduler_overrides.items():
+            setattr(cfg.verify_scheduler, k, v)
         cfg.rpc.enabled = False
         cfg.p2p.pex = False
         cfg.p2p.laddr = hn.addr
@@ -333,6 +350,7 @@ class NetHarness:
         self._monitor_stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=3.0)
+        self.stop_ramp()
         self.stop_flood()
         for hn in self.nodes:
             try:
@@ -445,6 +463,150 @@ class NetHarness:
         if self._chunk_flooder is not None:
             self._chunk_flooder.stop()
             self._chunk_flooder = None
+
+    def start_load_ramp(self, target: int, peak_tps: float = 200.0,
+                        floor_tps: float = 10.0, period_s: float = 2.0,
+                        tx_bytes: int = 96):
+        """Diurnal workload (ADR-023): a background submitter whose tx
+        rate follows a raised cosine between floor_tps and peak_tps
+        with period period_s, feeding the target's mempool CheckTx
+        path.  Rejections are EXPECTED while the control plane clamps
+        admission — the ramp counts them and keeps pushing, exactly
+        like real clients retrying through weather."""
+        import math
+        self.stop_ramp()
+        self._ramp_stop.clear()
+        self._ramp_sent = 0
+        self._ramp_rejected = 0
+        stop = self._ramp_stop
+
+        def _ramp():
+            seq = 0
+            t0 = time.monotonic()
+            while not stop.is_set():
+                t = time.monotonic() - t0
+                phase = 0.5 - 0.5 * math.cos(
+                    2.0 * math.pi * t / max(0.1, period_s))
+                tps = floor_tps + (peak_tps - floor_tps) * phase
+                burst = max(1, int(tps * 0.05))
+                hn = self.nodes[target]
+                node = hn.node
+                if node is None or not hn.running:
+                    if stop.wait(0.1):
+                        return
+                    continue
+                for _ in range(burst):
+                    body = (f"ramp{seq}=".encode()
+                            + os.urandom(max(1, tx_bytes // 2))
+                            .hex().encode())
+                    seq += 1
+                    try:
+                        resp = node.mempool.check_tx(
+                            body[:max(16, tx_bytes)])
+                        if getattr(resp, "code", 0):
+                            self._ramp_rejected += 1
+                        else:
+                            self._ramp_sent += 1
+                    except Exception:  # noqa: BLE001 - a stopping node
+                        self._ramp_rejected += 1
+                if stop.wait(0.05):
+                    return
+
+        self._ramp_thread = threading.Thread(
+            target=_ramp, daemon=True, name="harness-load-ramp")
+        self._ramp_thread.start()
+
+    def stop_ramp(self):
+        self._ramp_stop.set()
+        t = self._ramp_thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._ramp_thread = None
+
+    # -- adaptive control plane (ADR-023) ----------------------------------
+
+    def control_set(self, enabled: bool):
+        """Flip the process-global governor's config override (the
+        controller's loop reverts every knob to static within one
+        period when disabled, resumes governing when re-enabled)."""
+        from tendermint_tpu.libs import control
+        control.set_config(enable=bool(enabled))
+
+    def control_kill(self, reason: str = "scenario"):
+        from tendermint_tpu.libs import control
+        control.kill(reason)
+
+    def expect_control_reverted(self, timeout: float = 3.0) -> dict:
+        """Gate: every registered knob sits back at its declared
+        static value — the kill-switch contract (within one control
+        period; the poll budget is the step's timeout).  Asserted from
+        the decision ring AND the control_knob_value gauges, per the
+        ADR-023 acceptance: if any knob was ever steered, the ring
+        must carry its revert entry."""
+        from tendermint_tpu.libs import control
+        from tendermint_tpu.libs.metrics import ControlMetrics
+        gauges = ControlMetrics()
+        deadline = time.monotonic() + timeout
+        last: dict = {}
+        why = "no knobs registered"
+        while time.monotonic() < deadline:
+            rep = control.report()
+            knobs = rep.get("knobs") or {}
+            last = {name: (float(k["value"]), float(k["static"]))
+                    for name, k in knobs.items()}
+            decs = rep.get("decisions") or []
+            ringed = {d["knob"] for d in decs
+                      if d.get("direction") == "revert"}
+            if last and all(abs(v - s) < 1e-9
+                            for v, s in last.values()):
+                gauge_bad = [
+                    name for name, (_, s) in last.items()
+                    if abs(gauges.knob_value.value(knob=name) - s)
+                    > 1e-9]
+                missing = set(last) - ringed
+                if not gauge_bad and not missing:
+                    return last
+                why = (f"gauge mismatch {gauge_bad}, "
+                       f"no revert ring entry for {sorted(missing)}")
+            else:
+                why = f"values off static: {last}"
+            time.sleep(0.02)
+        raise ScenarioFailure(
+            f"control plane not reverted within {timeout}s: {why}")
+
+    def expect_burn(self, stream: str, min_burn: Optional[float] = None,
+                    max_burn: Optional[float] = None,
+                    timeout: float = 30.0) -> float:
+        """Gate on a stream's SLO error-budget burn rate (libs/slo.py).
+        min_burn waits for the burn to REACH the threshold (the static
+        twin blowing its budget at peak); max_burn waits for it to
+        settle AT OR BELOW (the governed run holding the SLO).  Reads
+        stream_report directly — the gauges lag the estimator by one
+        publish."""
+        from tendermint_tpu.consensus import observatory as obsv
+        from tendermint_tpu.libs import slo
+        deadline = time.monotonic() + timeout
+        last: Optional[float] = None
+        while time.monotonic() < deadline:
+            try:
+                obsv.publish_pending()
+            except Exception:  # noqa: BLE001 - telemetry must not gate
+                pass
+            rep = slo.stream_report(stream) or {}
+            burn = rep.get("burn_rate")
+            if burn is not None:
+                last = float(burn)
+                if min_burn is not None and last >= min_burn:
+                    return last
+                if min_burn is None and max_burn is not None \
+                        and last <= max_burn:
+                    return last
+            time.sleep(0.1)
+        want = (f">= {min_burn}" if min_burn is not None
+                else f"<= {max_burn}")
+        raise ScenarioFailure(
+            f"slo burn gate failed: {stream} burn {last} never went "
+            f"{want} within {timeout}s")
 
     def start_chunk_flood(self, target: int, batch: int = 32):
         """Attach an external peer spamming the target's statesync
@@ -754,6 +916,29 @@ class NetHarness:
             # reactor gossips it to whoever proposes next
             src = min(hn.idx for hn in self.running_nodes())
             self.submit_tx(src, tx)
+        elif op == "load_ramp":
+            self.start_load_ramp(step.get("target", 0),
+                                 peak_tps=step.get("peak_tps", 200.0),
+                                 floor_tps=step.get("floor_tps", 10.0),
+                                 period_s=step.get("period_s", 2.0),
+                                 tx_bytes=step.get("tx_bytes", 96))
+        elif op == "stop_ramp":
+            self.stop_ramp()
+            ctx["ramp_sent"] = self._ramp_sent
+            ctx["ramp_rejected"] = self._ramp_rejected
+        elif op == "control_set":
+            self.control_set(step.get("enabled", True))
+        elif op == "control_kill":
+            self.control_kill(step.get("reason", "scenario"))
+        elif op == "expect_control_reverted":
+            ctx["control_reverted"] = self.expect_control_reverted(
+                timeout=step.get("timeout", 3.0))
+        elif op == "expect_burn":
+            key = f"burn_{step.get('stream', 'consensus')}"
+            ctx[key] = self.expect_burn(
+                step.get("stream", "consensus"),
+                min_burn=step.get("min"), max_burn=step.get("max"),
+                timeout=step.get("timeout", 30.0))
         elif op == "sleep":
             time.sleep(step.get("s", 0.5))
         else:  # pragma: no cover - validate_scenario gates this
@@ -834,7 +1019,11 @@ class NetHarness:
                 consensus_overrides=scenario.get("consensus"),
                 mempool_overrides=scenario.get("mempool"),
                 app_overrides=scenario.get("app"),
-                statesync_overrides=scenario.get("statesync"))
+                statesync_overrides=scenario.get("statesync"),
+                control_overrides=scenario.get("control"),
+                slo_overrides=scenario.get("slo"),
+                verify_scheduler_overrides=scenario.get(
+                    "verify_scheduler"))
         h.start()
         try:
             return h.run_scenario(scenario)

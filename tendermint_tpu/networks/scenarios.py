@@ -33,6 +33,17 @@ Step vocabulary (harness._apply_step):
   {"op": "txs", "node": i, "items": [..]}  submit raw txs
   {"op": "promote", "node": i, "power": p} validator-set churn via the
       kvstore "val:<pubkey_b64>!<power>" tx (power 0 demotes)
+  {"op": "load_ramp", "target": i, ...}    diurnal background load: a
+      raised-cosine tx rate between "floor_tps" and "peak_tps" with
+      period "period_s" into node i's CheckTx path, until "stop_ramp"
+  {"op": "stop_ramp"}
+  {"op": "control_set", "enabled": b}      flip the ADR-023 governor's
+      config override (disable reverts every knob within one period)
+  {"op": "control_kill"}                   trip the kill switch
+  {"op": "expect_control_reverted"}        gate: every knob back at its
+      static value (decision ring + control_knob_value gauges)
+  {"op": "expect_burn", "stream": s, ...}  gate on a stream's SLO burn
+      rate: "min" waits for burn to reach it, "max" to settle below
   {"op": "sleep", "s": x}
 """
 from __future__ import annotations
@@ -54,6 +65,14 @@ _STEP_OPS = frozenset({
     # refused ("expect_serve_refusals")
     "statesync_join", "wait_synced", "corrupt_provider", "chunk_flood",
     "expect_serve_refusals",
+    # adaptive control plane (ADR-023): drive a diurnal load curve at a
+    # node ("load_ramp" / "stop_ramp"), flip the governor on/off
+    # ("control_set"), trip the kill switch ("control_kill"), gate that
+    # every knob sits back at its static value ("expect_control_reverted"
+    # — decision ring + control_knob_value gauges), and gate a stream's
+    # SLO burn rate ("expect_burn", min or max)
+    "load_ramp", "stop_ramp", "control_set", "control_kill",
+    "expect_control_reverted", "expect_burn",
 })
 
 
@@ -253,6 +272,75 @@ SCENARIOS: List[dict] = [validate_scenario(s) for s in (
             # statesync -> blocksync -> consensus handoff completed
             # while the rest of the net kept committing
             {"op": "wait_height", "delta": 2, "timeout": 120},
+        ],
+    },
+    {
+        # ADR-023 acceptance: the SAME weather (diurnal load ramp +
+        # flooding peer + a 3 s partition pulse) hits the net twice —
+        # first with the governor DISABLED (the static twin: the
+        # block-interval burn must blow past 1.0 at peak), then with it
+        # governing (AIMD clamp-down + recovery must work the burn back
+        # under budget by scenario end).  All nodes share the
+        # process-global controller/scheduler/SLO estimator, so the
+        # twins are TEMPORAL phases of one run, not parallel nodes.
+        # Finale: the kill switch trips mid-ramp and every knob must
+        # sit back at its static value within one control period
+        # (decision ring + control_knob_value gauges).
+        "name": "diurnal_weather",
+        "validators": 4,
+        "mempool": {"ingress_queue": 128, "ingress_rate_per_s": 300.0,
+                    "ingress_burst": 64},
+        "verify_scheduler": {"enable": True},
+        "control": {"enable": True, "period_ms": 100.0,
+                    "recover_after": 2},
+        # tight windows so one pulse of weather is measurable: 4 nodes
+        # x 1 >800ms interval = 4/32 obs = 12.5% over a 10% budget ->
+        # burn 1.25 at peak; ~8 clean heights displace it back out
+        "slo": {"enable": True, "window": 32,
+                "block_interval_p99_ms": 800.0,
+                "block_interval_budget_pct": 10.0,
+                "consensus_p99_ms": 250.0,
+                "consensus_budget_pct": 10.0},
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            # -- phase 1: STATIC TWIN (governor off, knobs at config)
+            {"op": "control_set", "enabled": False},
+            {"op": "expect_control_reverted", "timeout": 3.0},
+            {"op": "load_ramp", "target": 0, "peak_tps": 300,
+             "period_s": 2.0},
+            {"op": "flood", "target": 0, "tx_bytes": 128, "batch": 64},
+            {"op": "partition", "groups": [[0, 1, 2], [3]]},
+            {"op": "sleep", "s": 3.0},
+            {"op": "heal"},
+            {"op": "wait_height", "delta": 2, "timeout": 90},
+            # the static twin blew its block-interval budget at peak
+            {"op": "expect_burn", "stream": "block_interval",
+             "min": 1.0, "timeout": 30},
+            {"op": "stop_flood"},
+            {"op": "stop_ramp"},
+            # -- phase 2: GOVERNED (same weather, controller on)
+            {"op": "control_set", "enabled": True},
+            {"op": "load_ramp", "target": 0, "peak_tps": 300,
+             "period_s": 2.0},
+            {"op": "flood", "target": 0, "tx_bytes": 128, "batch": 64},
+            {"op": "partition", "groups": [[0, 1, 2], [3]]},
+            {"op": "sleep", "s": 3.0},
+            {"op": "heal"},
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+            {"op": "stop_flood"},
+            # recovery: the governed run must keep committing and work
+            # the burn back under budget (fresh sub-target intervals
+            # displace the weather out of the 32-obs window)
+            {"op": "wait_height", "delta": 10, "timeout": 180},
+            {"op": "expect_burn", "stream": "block_interval",
+             "max": 1.0, "timeout": 90},
+            {"op": "expect_burn", "stream": "consensus",
+             "max": 1.0, "timeout": 60},
+            # -- phase 3: KILL SWITCH mid-ramp
+            {"op": "control_kill"},
+            {"op": "expect_control_reverted", "timeout": 3.0},
+            {"op": "stop_ramp"},
+            {"op": "wait_height", "delta": 2, "timeout": 90},
         ],
     },
     {

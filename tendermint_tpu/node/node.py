@@ -543,7 +543,8 @@ class Node(BaseService):
         from tendermint_tpu.libs import slo
         slo.set_config(enabled=self.config.slo.enable,
                        window=self.config.slo.window,
-                       targets=self.config.slo.targets_s())
+                       targets=self.config.slo.targets_s(),
+                       budgets=self.config.slo.budgets())
         # device observatory (crypto/devobs.py, ADR-021): per-launch
         # transfer/compute/compile decomposition + HBM ledger; config
         # wins over a stale TM_TPU_DEVOBS env both ways
@@ -557,6 +558,24 @@ class Node(BaseService):
         # "no drops" (ADR-020 satellite)
         from tendermint_tpu.libs.metrics import TraceMetrics
         TraceMetrics()
+        # adaptive control plane (libs/control.py, ADR-023): the first
+        # node in the process installs the controller; config wins over
+        # a stale TM_TPU_CONTROL env both ways.  Wired after every knob
+        # owner above exists, and each knob registers only when ITS
+        # seam does — a node without a pipeline governs the rest
+        self._controller = None
+        from tendermint_tpu.libs import control
+        cc = self.config.control
+        control.set_config(enable=cc.enable)
+        if cc.enable and control.installed() is None:
+            self._controller = control.install(
+                control.Controller(period_ms=cc.period_ms,
+                                   recover_after=cc.recover_after))
+            self._register_knobs(self._controller, cc)
+            self._controller.start()
+            self.log.info("adaptive control plane started",
+                          period_ms=cc.period_ms,
+                          knobs=",".join(self._controller.knobs()))
         # mempool ingress gate (ADR-018): start AFTER the verify
         # scheduler so the worker's MEMPOOL-class pre-verification can
         # route through it from the first batch
@@ -590,6 +609,54 @@ class Node(BaseService):
             self.pprof_server.start()
         if self.grpc_server is not None:
             self.grpc_server.start()
+
+    def _register_knobs(self, controller, cc):
+        """Bind every declared knob whose seam this node owns to the
+        controller (ADR-023).  Getters/setters are the same live
+        `set_config`-style seams the wiring above used, so "static
+        config" stays the single source of truth for reverts."""
+        from tendermint_tpu.crypto import lanepool
+        from tendermint_tpu.libs.control import SPEC_BY_NAME
+        from tendermint_tpu.ops import ed25519 as edops
+        from tendermint_tpu.statesync import syncer as ss_syncer
+
+        def reg(name, getter, setter):
+            # a fractional step (sched_window_ms moves in 0.5 ms) means
+            # the knob itself is fractional — integer coercion would
+            # round every half-step move away
+            step = cc.step_of(name)
+            controller.register(SPEC_BY_NAME[name], getter, setter,
+                                safe_range=cc.range_of(name),
+                                step=step,
+                                integral=float(step).is_integer())
+
+        sched = self._verify_sched
+        if sched is not None:
+            reg("sched_window_ms",
+                lambda: sched.window_s * 1000.0,
+                lambda v: sched.set_window(v / 1000.0))
+        reg("host_pool_workers",
+            lambda: float(lanepool.workers()),
+            lambda v: lanepool.set_workers(int(v)))
+        gate = self.ingress_gate
+        if gate is not None:
+            reg("ingress_rate_per_s",
+                lambda: gate.rate_per_s,
+                lambda v: gate.set_rate(rate_per_s=v))
+            reg("ingress_burst",
+                lambda: gate.burst,
+                lambda v: gate.set_rate(burst=v))
+        pipe = self._block_pipeline
+        if pipe is not None:
+            reg("pipeline_depth",
+                lambda: float(pipe.depth),
+                lambda v: pipe.set_depth(int(v)))
+        reg("statesync_fetchers",
+            lambda: float(ss_syncer.default_fetchers()),
+            lambda v: ss_syncer.set_config(fetchers=int(v)))
+        reg("comb_min_batch",
+            lambda: float(edops.comb_min_batch()),
+            lambda v: edops.set_comb_config(min_batch=int(v)))
 
     def _on_breaker_transition(self, old: str, new: str, reason: str):
         self.log.info("device verify lane breaker transition",
@@ -652,6 +719,15 @@ class Node(BaseService):
         if getattr(self, "_breaker_unsub", None) is not None:
             self._breaker_unsub()
             self._breaker_unsub = None
+        if getattr(self, "_controller", None) is not None:
+            # FIRST: stopping the controller reverts every governed
+            # knob to its static configured value while the knob
+            # owners below are still alive to accept the revert
+            from tendermint_tpu.libs import control
+            self._controller.stop()
+            if control.installed() is self._controller:
+                control.uninstall()
+            self._controller = None
         if getattr(self, "_verify_sched", None) is not None:
             from tendermint_tpu.crypto import scheduler as vsched
             self._verify_sched.stop()

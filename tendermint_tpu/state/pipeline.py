@@ -182,6 +182,35 @@ class BlockPipeline(BaseService):
         with self._cond:
             return self._durable_height
 
+    # -- live reconfiguration (ADR-023) ------------------------------------
+
+    def set_depth(self, depth: int) -> bool:
+        """Thread-safe live depth change (the adaptive control plane's
+        seam).  Only between windows: the staged queue is rebuilt, and
+        that is safe exactly when no replay holds _busy (the stage
+        worker blocks on puts and _next_staged drops stale-gen items,
+        so a swapped queue with a bumped gen strands nothing).  Returns
+        False without touching anything if a window is in flight — the
+        caller skips this period's move and retries next period."""
+        depth = int(depth)
+        if depth <= 0:
+            return False
+        if not self._busy.acquire(blocking=False):
+            return False
+        try:
+            if depth == self.depth:
+                return True
+            self.depth = depth
+            with self._cond:
+                # invalidate any stale staged items so the old queue's
+                # leftovers can never reach the new one's consumers
+                self._gen += 1
+                self._staged_q = queue.Queue(maxsize=depth)
+                self._cond.notify_all()
+            return True
+        finally:
+            self._busy.release()
+
     # -- the replay entry (called from blocksync.replay) -------------------
 
     def replay_window(self, executor, store, state, blocks, certifiers,

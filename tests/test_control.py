@@ -1,0 +1,690 @@
+"""Adaptive control plane (libs/control.py, ADR-023): policy-mode
+decision table, declared-envelope enforcement, the kill switch's
+exact-revert contract, chaos at the decision seam, the [control] and
+[slo] budget config surface, the ingress live-rate seam, and a
+locksan-proven concurrent hammer across the real setter seams."""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs import control
+from tendermint_tpu.libs.control import (KNOB_SPECS, SPEC_BY_NAME,
+                                         Controller, Knob, KnobSpec)
+
+
+class Holder:
+    """A minimal knob seam: a float cell with getter/setter, counting
+    sets so tests can assert a revert did (or did not) touch it."""
+
+    def __init__(self, v):
+        self.v = float(v)
+        self.sets = 0
+
+    def get(self):
+        return self.v
+
+    def set(self, v):
+        self.v = float(v)
+        self.sets += 1
+
+
+@pytest.fixture(autouse=True)
+def _clean_control_state():
+    """Every test leaves the process-global control surface as it
+    found it: no installed controller, no config override, no armed
+    chaos mode at the decide seam."""
+    from tendermint_tpu.libs import fail
+    yield
+    control.uninstall()
+    control.set_config(enable=None)
+    fail.clear("control.decide")
+
+
+def _spec(mode="admission", name="t_knob", rng=(10.0, 100.0), step=8.0,
+          direction=-1, signal="ingress_queue_depth", labels=None):
+    return KnobSpec(name, safe_range=rng, step=step, direction=direction,
+                    signal=signal, mode=mode, labels=labels)
+
+
+# ---------------------------------------------------------------------------
+# the declared envelope: KnobSpec / Knob validation
+# ---------------------------------------------------------------------------
+
+def test_knobspec_rejects_bad_declarations():
+    with pytest.raises(ValueError, match="safe_range"):
+        _spec(rng=(100.0, 10.0))
+    with pytest.raises(ValueError, match="safe_range"):
+        _spec(rng=(float("-inf"), 10.0))
+    with pytest.raises(ValueError, match="step"):
+        _spec(step=0.0)
+    with pytest.raises(ValueError, match="step"):
+        _spec(step=float("nan"))
+    with pytest.raises(ValueError, match="mode"):
+        _spec(mode="vibes")
+
+
+def test_every_declared_spec_row_is_well_formed():
+    """The literal table itself (tmlint TM308 checks it statically;
+    this is the runtime twin): finite ranges, positive steps, a known
+    mode, and unique names."""
+    assert len({s.name for s in KNOB_SPECS}) == len(KNOB_SPECS)
+    for s in KNOB_SPECS:
+        lo, hi = s.safe_range
+        assert lo <= hi and s.step > 0
+        assert s.mode in ("throughput", "admission", "backlog",
+                          "pressure")
+        assert SPEC_BY_NAME[s.name] is s
+
+
+def test_knob_config_range_and_clamp_and_coerce():
+    h = Holder(40.0)
+    # config tightens the declared range; a nonsense range is refused
+    k = Knob(_spec(), h.get, h.set, safe_range=(20.0, 80.0), step=4.0)
+    assert k.clamp(200.0) == (80.0, True)
+    assert k.clamp(1.0) == (20.0, True)
+    assert k.clamp(33.0) == (33.0, False)
+    assert k.coerce(33.4) == 33.0  # integral by default
+    kf = Knob(_spec(name="t_frac"), h.get, h.set, integral=False)
+    assert kf.coerce(2.5) == 2.5
+    with pytest.raises(ValueError, match="finite"):
+        Knob(_spec(), h.get, h.set, safe_range=(9.0, 1.0))
+    with pytest.raises(ValueError, match="step"):
+        Knob(_spec(), h.get, h.set, step=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# policy modes, driven synthetically through _decide (published-signal
+# dicts in, one bounded Decision out)
+# ---------------------------------------------------------------------------
+
+COLD = {"consensus": 0.0, "commit": 0.0, "block_interval": 0.0}
+HOT = {"consensus": 2.0, "commit": 0.0, "block_interval": 0.0}
+
+
+def _decide(ctl, k, burns, sources=None):
+    return ctl._decide(k, sources or {}, burns, time.time())
+
+
+def test_admission_md_clamp_and_ai_recovery():
+    ctl = Controller(period_ms=10, recover_after=2)
+    h = Holder(96.0)
+    k = ctl.register(_spec(), h.get, h.set)
+    # hot: multiplicative halve toward lo, never past it
+    d = _decide(ctl, k, HOT)
+    assert (d.direction, d.value, d.reason) == ("shrink", 48.0,
+                                                "overload-md")
+    d = _decide(ctl, k, HOT)
+    assert d.value == 24.0 and h.v == 24.0
+    d = _decide(ctl, k, HOT)
+    assert d.value == 12.0
+    d = _decide(ctl, k, HOT)
+    assert d.value == 10.0  # the lo floor, never past it
+    assert _decide(ctl, k, HOT) is None  # pinned at the floor
+    # recovery: additive, only after recover_after clean periods
+    assert _decide(ctl, k, COLD) is None
+    d = _decide(ctl, k, COLD)
+    assert (d.direction, d.value, d.reason) == ("grow", 18.0,
+                                                "recover-ai")
+    for _ in range(40):
+        if _decide(ctl, k, COLD) is None:
+            break
+    assert h.v == 96.0  # recovery stops AT static, never past it
+
+
+def test_admission_unlimited_static_engages_and_restores_zero():
+    """static == 0 means "unlimited": the clamp engages from the
+    range's hi, and full recovery restores the literal 0."""
+    ctl = Controller(period_ms=10, recover_after=1)
+    h = Holder(0.0)
+    k = ctl.register(_spec(), h.get, h.set)
+    d = _decide(ctl, k, HOT)
+    assert (d.value, d.reason) == (100.0, "overload-engage")
+    d = _decide(ctl, k, HOT)
+    assert d.value == 50.0
+    d = _decide(ctl, k, COLD)
+    assert (d.value, d.reason) == (58.0, "recover-ai")
+    while h.v != 0.0:
+        d = _decide(ctl, k, COLD)
+        assert d is not None and d.value <= 100.0
+    assert d.reason == "recovered-static" and not k.engaged
+    assert _decide(ctl, k, COLD) is None  # unlimited again: nothing to do
+
+
+def test_throughput_grow_backoff_idle_recover():
+    ctl = Controller(period_ms=10, recover_after=2)
+    h = Holder(4.0)
+    spec = _spec(mode="throughput", rng=(1.0, 16.0), step=1.0,
+                 direction=1)
+    k = ctl.register(spec, h.get, h.set)
+
+    def src(depth):
+        class G:
+            def value(self, **kw):
+                return depth
+        return {spec.signal: G()}
+
+    d = _decide(ctl, k, COLD, src(5.0))  # busy, no history yet: grow
+    assert (d.direction, d.value, d.reason) == ("grow", 5.0,
+                                                "backlog-cold")
+    d = _decide(ctl, k, COLD, src(9.0))  # rising: grow again
+    assert d.value == 6.0
+    h.v = 15.5                           # a grow past hi clamps @bound
+    d = _decide(ctl, k, COLD, src(20.0))
+    assert (d.value, d.reason) == (16.0, "backlog-cold@bound")
+    h.v = 6.0
+    d = _decide(ctl, k, HOT, src(9.0))   # burn hot: step back to static
+    assert (d.value, d.reason) == (5.0, "burn-hot")
+    assert _decide(ctl, k, COLD, src(0.0)) is None  # idle 1
+    d = _decide(ctl, k, COLD, src(0.0))             # idle 2: recover
+    assert (d.value, d.reason) == (4.0, "idle-recover")
+    assert h.v == k.static
+
+
+def test_backlog_pinned_grow_calm_recover():
+    ctl = Controller(period_ms=10, recover_after=1)
+    h = Holder(4.0)
+    spec = _spec(mode="backlog", rng=(2.0, 8.0), step=1.0, direction=1,
+                 signal="pipeline_depth")
+    k = ctl.register(spec, h.get, h.set)
+
+    def src(depth):
+        class G:
+            def value(self, **kw):
+                return depth
+        return {spec.signal: G()}
+
+    d = _decide(ctl, k, COLD, src(4.0))   # pinned at the current depth
+    assert (d.value, d.reason) == (5.0, "queue-pinned")
+    d = _decide(ctl, k, COLD, src(1.0))   # calm: back toward static
+    assert (d.value, d.reason) == (4.0, "calm-recover")
+
+
+def test_decision_seam_refusal_and_error_containment():
+    ctl = Controller(period_ms=10)
+    h = Holder(64.0)
+    k = ctl.register(_spec(), h.get,
+                     lambda v: False)  # the seam refuses (busy)
+    d = _decide(ctl, k, HOT)
+    assert d.direction == "held" and "seam-busy" in d.reason
+    assert h.v == 64.0
+
+    def boom():
+        raise RuntimeError("subsystem stopped")
+
+    k2 = ctl.register(_spec(name="t_other"), h.get, h.set)
+    k2.getter = boom  # the subsystem stopped AFTER registration
+    d = _decide(ctl, k2, HOT)
+    assert d.direction == "error" and "subsystem stopped" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# the kill switch: exact revert, ring evidence, gauge truth
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_reverts_every_knob_exactly():
+    from tendermint_tpu.libs.metrics import ControlMetrics
+    ctl = Controller(period_ms=10)
+    cells = {}
+    for i, name in enumerate(("t_a", "t_b", "t_c")):
+        h = cells[name] = Holder(10.0 + i)
+        ctl.register(_spec(name=name, rng=(1.0, 1000.0)), h.get, h.set)
+    # steer every knob away from static, then flip the switch
+    for h in cells.values():
+        h.set(h.v + 500.0)
+    ctl.kill("operator")
+    m = ControlMetrics()
+    for name, h in cells.items():
+        k = ctl._knobs[name]
+        assert h.v == k.static  # the exact registration-time value
+        assert m.knob_value.value(knob=name) == k.static
+    assert m.killed.value() == 1.0
+    rep = ctl.report()
+    assert rep["killed"] == "operator"
+    ringed = [d for d in rep["decisions"] if d["direction"] == "revert"]
+    # EVERY knob rings on a revert event, steered or not
+    assert {d["knob"] for d in ringed} == set(cells)
+    assert all(d["reason"] == "kill:operator" for d in ringed)
+    # a knob already at static reverts without touching its seam
+    sets_before = cells["t_a"].sets
+    ctl.revert_all("again")
+    assert cells["t_a"].sets == sets_before
+    assert len([d for d in ctl.report()["decisions"]
+                if d["reason"] == "again"]) == len(cells)
+
+
+def test_running_controller_kill_and_disable_within_one_period():
+    """The integration contract the diurnal_weather scenario gates on:
+    with the loop RUNNING, both control.kill() and a config disable
+    hand every knob back to static within one period."""
+    ctl = control.install(Controller(period_ms=20))
+    h = Holder(50.0)
+    ctl.register(_spec(rng=(1.0, 1000.0)), h.get, h.set)
+    control.set_config(enable=True)
+    ctl.start()
+    try:
+        h.set(700.0)
+        control.kill("test")
+        assert h.v == 50.0  # kill() reverts synchronously
+        assert ctl.killed() == "test"
+        # config disable (the other half of the switch): the LOOP must
+        # notice within one period, no operator call involved
+        ctl2 = Controller(period_ms=20)
+        h2 = Holder(5.0)
+        ctl2.register(_spec(name="t_d", rng=(1.0, 1000.0)), h2.get,
+                      h2.set)
+        ctl.stop()
+        control.uninstall()
+        control.install(ctl2)
+        ctl2.start()
+        h2.set(900.0)
+        control.set_config(enable=False)
+        deadline = time.monotonic() + 5.0
+        while h2.v != 5.0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert h2.v == 5.0
+        assert any(d["reason"] == "disabled"
+                   for d in ctl2.report()["decisions"])
+    finally:
+        control.uninstall()
+
+
+def test_install_surface_refuses_second_running_controller():
+    ctl = control.install(Controller(period_ms=50))
+    ctl.start()
+    try:
+        with pytest.raises(RuntimeError, match="already installed"):
+            control.install(Controller())
+        assert control.running() is ctl
+    finally:
+        control.uninstall()
+    assert control.installed() is None and not ctl.is_running()
+    # no controller: report() still serves the debug payload shape
+    rep = control.report()
+    assert rep["running"] is False and rep["knobs"] == {}
+
+
+def test_config_enable_wins_over_env_both_ways(monkeypatch):
+    monkeypatch.setenv("TM_TPU_CONTROL", "1")
+    assert control.enabled()
+    control.set_config(enable=False)
+    assert not control.enabled()  # config beats the armed env var
+    monkeypatch.setenv("TM_TPU_CONTROL", "0")
+    control.set_config(enable=True)
+    assert control.enabled()      # ...in BOTH directions
+    control.set_config(enable=None)
+    assert not control.enabled()  # cleared: env rules again
+
+
+# ---------------------------------------------------------------------------
+# chaos at the decision seam: a fault is a controller malfunction, and
+# a malfunctioning controller fails STATIC
+# ---------------------------------------------------------------------------
+
+def test_chaos_raise_at_decide_skips_period_and_fails_static():
+    from tendermint_tpu.libs import fail
+    from tendermint_tpu.libs.metrics import ControlMetrics
+    ctl = control.install(Controller(period_ms=20))
+    h = Holder(30.0)
+    ctl.register(_spec(rng=(1.0, 1000.0)), h.get, h.set)
+    control.set_config(enable=True)
+    skipped0 = ControlMetrics().decisions.value(knob="period",
+                                                direction="skipped")
+    ctl.start()
+    try:
+        h.set(600.0)
+        fail.set_mode("control.decide", "raise")
+        deadline = time.monotonic() + 5.0
+        while (fail.fired("control.decide", "raise") < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert fail.fired("control.decide", "raise") >= 2
+        assert h.v == 30.0  # fail-static: the chaos fault reverted it
+        assert any(d["reason"] == "chaos"
+                   for d in ctl.report()["decisions"])
+        assert ctl.report()["skipped_periods"] >= 2
+        assert ControlMetrics().decisions.value(
+            knob="period", direction="skipped") >= skipped0 + 2
+        # the loop SURVIVES: disarm and it decides again
+        fail.clear("control.decide")
+        p0 = ctl.report()["periods"]
+        deadline = time.monotonic() + 5.0
+        while (ctl.report()["periods"] <= p0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert ctl.is_running() and ctl.report()["periods"] > p0
+    finally:
+        fail.clear("control.decide")
+        control.uninstall()
+
+
+def test_chaos_latency_at_decide_stalls_but_never_wedges():
+    from tendermint_tpu.libs import fail
+    ctl = control.install(Controller(period_ms=10))
+    control.set_config(enable=True)
+    fail.set_mode("control.decide", "latency:30")
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while (fail.fired("control.decide", "latency:30") < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert fail.fired("control.decide", "latency:30") >= 2
+        assert ctl.is_running()  # slow periods, live loop
+    finally:
+        fail.clear("control.decide")
+        control.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# the [control] + [slo] budget config surface
+# ---------------------------------------------------------------------------
+
+def test_control_and_budget_config_toml_roundtrip(tmp_path):
+    from tendermint_tpu.config.config import Config
+    cfg = Config(home=str(tmp_path), moniker="ctl")
+    cfg.control.enable = True            # non-default (ADR-023)
+    cfg.control.period_ms = 250.0
+    cfg.control.recover_after = 5
+    cfg.control.ingress_rate_per_s_min = 64.0
+    cfg.control.ingress_rate_per_s_max = 5000.0
+    cfg.control.sched_window_ms_step = 0.25
+    cfg.slo.consensus_budget_pct = 10.0  # non-default (satellite 1)
+    cfg.slo.block_interval_budget_pct = 2.5
+    cfg.save()
+    back = Config.load(str(tmp_path))
+    assert back.control.enable is True
+    assert back.control.period_ms == 250.0
+    assert back.control.recover_after == 5
+    assert back.control.range_of("ingress_rate_per_s") == (64.0, 5000.0)
+    assert back.control.step_of("sched_window_ms") == 0.25
+    assert back.slo.consensus_budget_pct == 10.0
+    assert back.slo.budgets()["consensus"] == 0.10
+    assert back.slo.budgets()["block_interval"] == 0.025
+    back.control.validate_basic()
+    back.slo.validate_basic()
+
+
+def test_control_config_validate_rejects_nonsense():
+    from tendermint_tpu.config.config import ControlConfig, SLOConfig
+    cc = ControlConfig()
+    cc.period_ms = 0
+    with pytest.raises(ValueError, match="period_ms"):
+        cc.validate_basic()
+    cc = ControlConfig()
+    cc.pipeline_depth_min = 40.0  # min > max
+    with pytest.raises(ValueError, match="pipeline_depth_min"):
+        cc.validate_basic()
+    cc = ControlConfig()
+    cc.comb_min_batch_step = 0.0
+    with pytest.raises(ValueError, match="comb_min_batch_step"):
+        cc.validate_basic()
+    sc = SLOConfig()
+    sc.consensus_budget_pct = 0.0
+    with pytest.raises(ValueError, match="consensus_budget_pct"):
+        sc.validate_basic()
+    sc.consensus_budget_pct = 150.0
+    with pytest.raises(ValueError, match="consensus_budget_pct"):
+        sc.validate_basic()
+
+
+def test_every_declared_knob_has_a_config_row():
+    """[control] carries one min/max/step triple per KNOB_SPECS row —
+    a new spec row without its config envelope is a drift bug."""
+    from tendermint_tpu.config.config import ControlConfig
+    cc = ControlConfig()
+    assert set(cc.KNOBS) == set(SPEC_BY_NAME)
+    for s in KNOB_SPECS:
+        lo, hi = cc.range_of(s.name)
+        # the config DEFAULT matches the declared literal envelope
+        assert (lo, hi) == s.safe_range
+        assert cc.step_of(s.name) == s.step
+
+
+# ---------------------------------------------------------------------------
+# [slo] per-stream budgets + the published target gauge (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_slo_budget_scales_burn_rate():
+    from tendermint_tpu.libs.slo import SloEstimator
+    est = SloEstimator(window=10, enabled=True,
+                       targets={"consensus": 0.1},
+                       budgets={"consensus": 0.10})
+    for v in [0.05] * 8 + [0.2] * 2:  # 20% of the window over target
+        est.observe("consensus", v)
+    rep = est.stream_report("consensus")
+    assert rep["over_target_frac"] == pytest.approx(0.2)
+    assert rep["budget"] == 0.10
+    assert rep["burn_rate"] == pytest.approx(2.0)
+    # same observations, p99-convention budget: 20x the burn
+    est.budgets = {"consensus": 0.01}
+    assert est.stream_report("consensus")["burn_rate"] == \
+        pytest.approx(20.0)
+    # a nonsense budget falls back to the p99 convention, never a /0
+    est.budgets = {"consensus": 0.0}
+    assert est.stream_report("consensus")["burn_rate"] == \
+        pytest.approx(20.0)
+
+
+def test_slo_set_config_publishes_target_gauge():
+    from tendermint_tpu.libs import slo
+    from tendermint_tpu.libs.metrics import CryptoMetrics
+    try:
+        slo.set_config(targets={"consensus": 0.25, "mempool": 1.5},
+                       budgets={"consensus": 0.05})
+        m = CryptoMetrics()
+        assert m.slo_target.value(stream="consensus") == 0.25
+        assert m.slo_target.value(stream="mempool") == 1.5
+        assert slo.report()["budgets"]["consensus"] == 0.05
+        # config-wins, both ways: enabled untouched unless asked
+        assert not slo.is_enabled()
+    finally:
+        slo.set_config(enabled=False, targets={}, budgets={})
+        slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# the ingress live-rate seam (satellite 2): set_rate re-clamps LIVE
+# per-source buckets, not only future ones
+# ---------------------------------------------------------------------------
+
+def test_ingress_set_rate_reclamps_live_buckets():
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.mempool.ingress import IngressGate
+    from tendermint_tpu.mempool.mempool import Mempool
+
+    class Accept:
+        def check_tx(self, req):
+            from tendermint_tpu.abci import types as abci
+            return abci.ResponseCheckTx(code=0)
+
+    mp = Mempool(Accept(), registry=Registry())
+    g = IngressGate(mp, rate_per_s=1000.0, burst=500, workers=1).attach()
+    g.start()
+    try:
+        # create a LIVE bucket for this source with saved-up allowance
+        assert g.submit(b"tx-0", source="peer-a") is not None
+        b = g._buckets["peer-a"]
+        assert b.rate == 1000.0 and b.burst == 500.0
+        b.tokens = 499.0  # a flood's saved-up allowance
+        g.set_rate(rate_per_s=50.0, burst=10)
+        assert g.rate_per_s == 50.0 and g.burst == 10.0
+        # the live bucket is re-clamped: rate, burst AND tokens — the
+        # saved-up allowance must shrink with the burst, not outlive it
+        assert b.rate == 50.0 and b.burst == 10.0
+        assert b.tokens <= 10.0
+        # None leaves a dimension untouched; rate 0 disables limiting
+        g.set_rate(burst=25)
+        assert g.rate_per_s == 50.0 and b.burst == 25.0
+        g.set_rate(rate_per_s=0.0)
+        assert g.rate_per_s == 0.0
+        for i in range(64):  # unlimited again: no ratelimit rejections
+            r = g.submit(b"tx-%d" % i, source="peer-a")
+            assert not (r.done() and "rate limited"
+                        in r.result(0.1).log)
+    finally:
+        g.stop()
+
+
+# ---------------------------------------------------------------------------
+# the locksan hammer: real seams, concurrent steering, exact results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.locksan
+def test_locksan_hammer_concurrent_steering_and_verifies():
+    """The TM201 proof for the control plane: a RUNNING controller's
+    decide loop, concurrent scheduler submits, an ingress flood, a
+    pipelined block replay and a thread spinning every registered
+    knob's setter across its safe range — all under the lockset
+    monitor, with exact verify results and the pipelined replay's
+    final state byte-identical to a static (serial, untouched) twin.
+    Any Controller._lock edge that violates its declared LEAF rank
+    fails the test with the offending acquisition."""
+    from helpers import build_chain, make_genesis
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.blocksync.replay import replay_window
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.crypto import scheduler as vsched
+    from tendermint_tpu.libs.kvdb import GroupCommitDB, MemDB
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.mempool.ingress import IngressGate
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.state import pipeline
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    class Accept:
+        def check_tx(self, req):
+            from tendermint_tpu.abci import types as abci
+            return abci.ResponseCheckTx(code=0)
+
+    cbatch.verified_sigs = cbatch.SigCache()
+    privs = [edkeys.PrivKey(bytes([i + 1]) * 32) for i in range(8)]
+    items = [(p.pub_key(), b"ctl hammer %d" % i,
+              p.sign(b"ctl hammer %d" % i))
+             for i, p in enumerate(privs)]
+    gdoc, gprivs = make_genesis(4)
+    blocks, commits, _states = build_chain(gdoc, gprivs, 8)
+
+    def _replay(ex, store, st):
+        applied = 0
+        while applied < len(blocks):
+            for i, c in enumerate(commits):
+                ex.mark_commit_verified(i + 1, c)
+            st, n = replay_window(ex, store, st, blocks[applied:],
+                                  commits[applied:], max_window=4)
+            assert n > 0
+            applied += n
+        return st
+
+    # the static twin: serial replay, no pipeline, no steering
+    ex1 = BlockExecutor(StateStore(MemDB()), KVStoreApplication())
+    st_static = _replay(ex1, BlockStore(MemDB()), state_from_genesis(gdoc))
+
+    sched = vsched.VerifyScheduler(window_s=0.001, max_batch=64,
+                                   tpu_threshold=1 << 30)
+    sched.start()
+    mp = Mempool(Accept(), registry=Registry())
+    gate = IngressGate(mp, queue_size=256, batch=32, workers=1,
+                       rate_per_s=200.0, burst=64).attach()
+    gate.start()
+    pipe = pipeline.set_config(enable=True, depth=4,
+                               group_commit_heights=4)
+    ctl = control.install(Controller(period_ms=5))
+    ctl.register(SPEC_BY_NAME["sched_window_ms"],
+                 lambda: sched.window_s * 1000.0,
+                 lambda ms: sched.set_window(ms / 1000.0),
+                 integral=False)
+    ctl.register(SPEC_BY_NAME["ingress_rate_per_s"],
+                 lambda: gate.rate_per_s,
+                 lambda r: gate.set_rate(rate_per_s=r))
+    ctl.register(SPEC_BY_NAME["pipeline_depth"],
+                 lambda: float(pipe.depth),
+                 lambda d: pipe.set_depth(int(d)))
+    control.set_config(enable=True)
+    ctl.start()
+    stop = threading.Event()
+    errors = []
+
+    def spin_knobs():
+        lo_w, hi_w = SPEC_BY_NAME["sched_window_ms"].safe_range
+        lo_d, hi_d = SPEC_BY_NAME["pipeline_depth"].safe_range
+        vals = [lo_w, hi_w, 2.0, 5.0]
+        i = 0
+        while not stop.is_set():
+            sched.set_window(vals[i % len(vals)] / 1000.0)
+            gate.set_rate(rate_per_s=float(32 + (i % 8) * 64),
+                          burst=float(16 + (i % 4) * 16))
+            # set_depth may refuse mid-window (False) — that IS the
+            # seam contract the controller's "held" decision rides on
+            pipe.set_depth(int(lo_d + (i % 4) * 2) if i % 2
+                           else int(hi_d // 2))
+            if i % 7 == 0:
+                ctl.revert_all("hammer")
+            i += 1
+            time.sleep(0.001)
+
+    def submit_verifies(k):
+        try:
+            for _ in range(6):
+                fut = sched.submit(items, vsched.Priority.CONSENSUS)
+                assert fut.result(timeout=30.0).all()
+        except Exception as e:  # noqa: BLE001 - collected for the main
+            errors.append(e)    # thread's assertion
+
+    def flood():
+        i = 0
+        while not stop.is_set():
+            gate.submit(b"flood %d" % i, source="hammer")
+            i += 1
+            time.sleep(0.0005)
+
+    def pipelined_replays():
+        try:
+            for _ in range(3):
+                ex = BlockExecutor(StateStore(GroupCommitDB(MemDB())),
+                                   KVStoreApplication())
+                st = _replay(ex, BlockStore(GroupCommitDB(MemDB())),
+                             state_from_genesis(gdoc))
+                # exact vs the static twin, every round, mid-steering
+                assert st.app_hash == st_static.app_hash
+                assert st.last_block_id == st_static.last_block_id
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=spin_knobs, name="knob-spin"),
+               threading.Thread(target=flood, name="flood"),
+               threading.Thread(target=pipelined_replays,
+                                name="replay")] + \
+        [threading.Thread(target=submit_verifies, args=(k,),
+                          name=f"verify-{k}") for k in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads[3:]:
+            t.join(timeout=60.0)
+        threads[2].join(timeout=60.0)
+        stop.set()
+        for t in threads[:2]:
+            t.join(timeout=10.0)
+        assert not errors, errors
+        assert all(not t.is_alive() for t in threads)
+        # the kill switch still lands exactly after all that churn
+        control.kill("hammer-done")
+        assert sched.window_s * 1000.0 == pytest.approx(
+            ctl._knobs["sched_window_ms"].static)
+        assert gate.rate_per_s == pytest.approx(
+            ctl._knobs["ingress_rate_per_s"].static)
+        assert float(pipe.depth) == pytest.approx(
+            ctl._knobs["pipeline_depth"].static)
+    finally:
+        stop.set()
+        control.uninstall()
+        pipeline.set_config(enable=False)
+        gate.stop()
+        sched.stop()

@@ -392,8 +392,9 @@ def f(rt):
 # ---------------------------------------------------------------------------
 
 CHAOS_TEST_FILES = ("test_chaos_matrix.py", "test_comb.py",
-                    "test_degrade.py", "test_devobs.py",
-                    "test_ingress.py", "test_latency_observatory.py",
+                    "test_control.py", "test_degrade.py",
+                    "test_devobs.py", "test_ingress.py",
+                    "test_latency_observatory.py",
                     "test_netharness.py", "test_observatory.py",
                     "test_pipeline.py", "test_scheduler.py",
                     "test_statesync.py")
