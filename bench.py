@@ -1264,6 +1264,147 @@ def _consensus_main():
           file=sys.stderr)
 
 
+def run_propose_fastpath(sizes=(1000, 10000, 50000), tx_bytes=100,
+                         reps=3) -> dict:
+    """Proposer fast-path core (ADR-024; shared by BENCH_PROPOSE=1 and
+    bench_report config14).  Per mempool size: decompose
+    create_proposal_block (reap / prepare / assemble, read back from
+    last_propose_timings), then time part-set construction over the
+    IDENTICAL block bytes three ways — serial (host pool forced off,
+    PartSet.from_data), pooled (from_data with the lanepool on), and
+    streaming (from_data_streaming over proto_regions) — plus the
+    streaming first-part-out latency (header + part 0 WITH its proof:
+    the moment gossip can start) against the full-split wall.
+    Host-only by design: nothing here wants an accelerator."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.crypto import ed25519 as edkeys
+    from tendermint_tpu.crypto import lanepool
+    from tendermint_tpu.libs import trace
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.part_set import PartSet
+
+    privs = [edkeys.PrivKey((0xBEE + i).to_bytes(32, "big"))
+             for i in range(4)]
+    gdoc = GenesisDoc(
+        chain_id="bench-propose", genesis_time=Timestamp(1700000000, 0),
+        validators=[GenesisValidator(
+            address=p.pub_key().address(), pub_key_type="ed25519",
+            pub_key_bytes=p.pub_key().bytes(), power=10)
+            for p in privs])
+    proposer = privs[0].pub_key().address()
+
+    def best(fn, *a):
+        """Best-of-reps wall in ms (+ last result) — the floor is the
+        honest shape here: every rep does identical work on identical
+        bytes, so the min is the code path, the rest is scheduler."""
+        walls, out = [], None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            walls.append(time.perf_counter() - t0)
+        return round(min(walls) * 1e3, 3), out
+
+    rows = []
+    for n in sizes:
+        app = KVStoreApplication()
+        mp = Mempool(app, size_limit=n + 10)
+        pad = b"v" * max(1, tx_bytes - 12)
+        for i in range(n):
+            mp.check_tx(b"b%07d=" % i + pad)
+        state = state_from_genesis(gdoc)
+        ex = BlockExecutor(None, app, mempool=mp)
+        with trace.span("bench.propose", txs=n):
+            create_ms, block = best(
+                ex.create_proposal_block, 1, state, None, proposer)
+        t = ex.last_propose_timings
+        data = block.proto()
+
+        # every leg starts from the BLOCK object — the shape the
+        # proposer actually has — so the serial legs pay the monolithic
+        # proto() materialization the streaming leg replaces
+        def serial_split(block=block):
+            return PartSet.from_data(block.proto())
+
+        lanepool.set_workers(1)  # pool() -> None: forced-serial leg
+        lanepool.close()
+        serial_ms, ref = best(serial_split)
+        lanepool.set_workers(None)
+        lanepool.close()
+        pooled_ms, ps = best(serial_split)
+        assert ps.header() == ref.header()
+
+        def stream_first(block=block):
+            sps = PartSet.from_data_streaming(block.proto_regions())
+            sps.get_part(0)
+            return sps
+
+        def stream_full(block=block):
+            sps = PartSet.from_data_streaming(block.proto_regions())
+            for _ in sps.iter_parts():
+                pass
+            return sps
+
+        first_ms, sps = best(stream_first)
+        assert sps.header() == ref.header()
+        stream_ms, _ = best(stream_full)
+        lanepool.set_workers(None)
+        lanepool.close()
+        rows.append({
+            "mempool_txs": n, "block_txs": len(block.data.txs),
+            "block_bytes": len(data), "parts": ref.header().total,
+            "create_ms": create_ms,
+            "reap_ms": round(t["reap_s"] * 1e3, 3),
+            "prepare_ms": round(t["prepare_s"] * 1e3, 3),
+            "assemble_ms": round(t["assemble_s"] * 1e3, 3),
+            "split_serial_ms": serial_ms,
+            "split_pooled_ms": pooled_ms,
+            "split_streaming_ms": stream_ms,
+            "first_part_out_ms": first_ms,
+        })
+    return {"rows": rows, "sizes": list(sizes), "tx_bytes": tx_bytes,
+            "reps": reps}
+
+
+def _propose_main():
+    """Proposer fast-path config (BENCH_PROPOSE=1, ADR-024, bench_report
+    config14): one rc=0 JSON line with the per-mempool-size
+    reap -> prepare -> assemble -> split -> first-part-out
+    decomposition and the serial/pooled/streaming part-set legs on
+    identical data.  Headline is throughput-shaped for bench_trend:
+    serial full-split wall over streaming first-part-out at the
+    largest mempool (how much sooner gossip starts)."""
+    sizes = tuple(int(s) for s in os.environ.get(
+        "BENCH_PROP_SIZES", "1000,10000,50000").split(","))
+    tx_bytes = int(os.environ.get("BENCH_PROP_TX_BYTES", "100"))
+    reps = int(os.environ.get("BENCH_PROP_REPS", "3"))
+    r = run_propose_fastpath(sizes=sizes, tx_bytes=tx_bytes, reps=reps)
+    big = r["rows"][-1]
+    speedup = (round(big["split_serial_ms"] / big["first_part_out_ms"], 2)
+               if big["first_part_out_ms"] else None)
+    line = {
+        "metric": "propose_first_part_out_speedup",
+        "value": speedup,
+        "unit": "x_vs_serial_split",
+        "rows": r["rows"],
+        "tx_bytes": tx_bytes, "reps": reps,
+        "note": "host-only by design: budgeted reap/prepare/assemble "
+                "decomposition + serial vs pooled vs streaming part-set "
+                "construction on identical block bytes; value = serial "
+                "full-split wall / streaming first-part-out at the "
+                "largest mempool",
+        "trace": _trace_artifact("propose"),
+    }
+    _emit(line)
+    print(f"# propose bench: sizes={list(sizes)} "
+          f"block_bytes={big['block_bytes']} parts={big['parts']} "
+          f"first_part_out={big['first_part_out_ms']}ms "
+          f"serial_split={big['split_serial_ms']}ms", file=sys.stderr)
+
+
 def run_statesync_restore(n_heights=24, n_vals=4, n_txs=8,
                           chunk_size=512, fetchers=4, group_every=8,
                           resume_frac=0.5):
@@ -1430,6 +1571,9 @@ def main():
         return
     if os.environ.get("BENCH_CONSENSUS") == "1":
         _consensus_main()
+        return
+    if os.environ.get("BENCH_PROPOSE") == "1":
+        _propose_main()
         return
     if os.environ.get("BENCH_MEMPOOL") == "1":
         _mempool_main()

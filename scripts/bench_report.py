@@ -623,6 +623,29 @@ def config13_control(phases=8):
             "knob_moves": moves}
 
 
+def config14_propose(sizes=(1000, 10000)):
+    """Proposer fast path (ADR-024): create_proposal_block decomposed
+    (reap/prepare/assemble) plus serial vs pooled vs streaming
+    part-set construction on identical block bytes.  Columns mirror
+    the BENCH_PROPOSE=1 bench.py line at the largest mempool size:
+    first-part-out (when gossip can start) against the serial
+    full-split wall."""
+    from bench import run_propose_fastpath
+
+    r = run_propose_fastpath(sizes=sizes)
+    big = r["rows"][-1]
+    return {"config": f"14: propose fast path {big['mempool_txs']} txs",
+            "reap_ms": big["reap_ms"],
+            "prepare_ms": big["prepare_ms"],
+            "assemble_ms": big["assemble_ms"],
+            "split_serial_ms": big["split_serial_ms"],
+            "split_pooled_ms": big["split_pooled_ms"],
+            "split_streaming_ms": big["split_streaming_ms"],
+            "first_part_out_ms": big["first_part_out_ms"],
+            "parts": big["parts"],
+            "block_bytes": big["block_bytes"]}
+
+
 def main():
     import json
 
@@ -643,7 +666,8 @@ def main():
     fns = (config2_commit_150, config3_light_10k, config4_blocksync,
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
-           config11_consensus, config12_statesync, config13_control)
+           config11_consensus, config12_statesync, config13_control,
+           config14_propose)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

@@ -32,14 +32,23 @@ from typing import List, Optional, Tuple
 from tendermint_tpu.crypto import scheduler as vsched
 from tendermint_tpu.types.block import Block
 from tendermint_tpu.types.basic import BlockID
-from tendermint_tpu.types.part_set import PartSet, BLOCK_PART_SIZE_BYTES
+from tendermint_tpu.types.part_set import (
+    PartSet, BLOCK_PART_SIZE_BYTES, make_block_parts)
 from tendermint_tpu.types.validator_set import CommitVerifyError
 
 
 def block_id_of(block: Block) -> Tuple[BlockID, PartSet]:
     """BlockID as gossiped/signed: block hash + part-set header
-    (reference blocksync/reactor.go:365-369)."""
-    parts = PartSet.from_data(block.proto())
+    (reference blocksync/reactor.go:365-369).
+
+    The part set rides the proposer's streaming path (ADR-024): the
+    header needs only the chunking + bulk-hashed leaf layer, and
+    per-part proofs are extracted lazily — a consumer that never reads
+    the parts (the crash-resume identity check in _apply_one, a
+    store-less replay, a header-only verification failure) never pays
+    for proof construction at all; store.save_block materializes each
+    part's proof on first access at save time."""
+    parts = make_block_parts(block)
     return BlockID(hash=block.hash(), part_set_header=parts.header()), parts
 
 

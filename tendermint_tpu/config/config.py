@@ -653,6 +653,9 @@ timeout_commit = {c.timeout_commit}
 skip_timeout_commit = {str(c.skip_timeout_commit).lower()}
 create_empty_blocks = {str(c.create_empty_blocks).lower()}
 create_empty_blocks_interval = {c.create_empty_blocks_interval}
+propose_reap_budget_ms = {c.propose_reap_budget_ms}
+propose_prepare_budget_ms = {c.propose_prepare_budget_ms}
+propose_max_bytes = {c.propose_max_bytes}
 """
         with open(os.path.join(self.config_dir(), "config.toml"), "w") as f:
             f.write(text)
@@ -768,11 +771,14 @@ create_empty_blocks_interval = {c.create_empty_blocks_interval}
         for k in ("timeout_propose", "timeout_propose_delta",
                   "timeout_prevote", "timeout_prevote_delta",
                   "timeout_precommit", "timeout_precommit_delta",
-                  "timeout_commit", "create_empty_blocks_interval"):
+                  "timeout_commit", "create_empty_blocks_interval",
+                  "propose_reap_budget_ms", "propose_prepare_budget_ms"):
             if k in c:
                 setattr(cc, k, float(c[k]))
         for k in ("skip_timeout_commit", "create_empty_blocks"):
             if k in c:
                 setattr(cc, k, bool(c[k]))
+        if "propose_max_bytes" in c:
+            cc.propose_max_bytes = int(c["propose_max_bytes"])
         cfg.consensus = cc
         return cfg

@@ -21,6 +21,14 @@ class ConsensusConfig:
     create_empty_blocks: bool = True
     create_empty_blocks_interval: float = 0.0
     double_sign_check_height: int = 0
+    # proposer fast-path budgets (ADR-024), all 0 = unlimited (the
+    # reference behavior): wall-clock caps on the mempool reap scan and
+    # the PrepareProposal round trip, plus a byte cap below the
+    # consensus-params block limit — a huge mempool or a slow app
+    # degrades the BLOCK (fewer/raw txs), never the round
+    propose_reap_budget_ms: float = 0.0
+    propose_prepare_budget_ms: float = 0.0
+    propose_max_bytes: int = 0
 
     def validate_basic(self):
         """Reference config/config.go:939-956 ConsensusConfig.ValidateBasic:
@@ -28,7 +36,9 @@ class ConsensusConfig:
         for name in ("timeout_propose", "timeout_propose_delta",
                      "timeout_prevote", "timeout_prevote_delta",
                      "timeout_precommit", "timeout_precommit_delta",
-                     "timeout_commit", "create_empty_blocks_interval"):
+                     "timeout_commit", "create_empty_blocks_interval",
+                     "propose_reap_budget_ms",
+                     "propose_prepare_budget_ms", "propose_max_bytes"):
             if getattr(self, name) < 0:
                 raise ValueError(f"consensus.{name} cannot be negative")
         if self.double_sign_check_height < 0:

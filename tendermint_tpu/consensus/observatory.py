@@ -19,6 +19,11 @@ takes multiple rounds keeps its FIRST occurrence of each stage and
   propose_start     entered PROPOSE (round recorded; proposer id too)
   proposal_signed   we ARE the proposer: proposal signed + broadcast
   proposal          a valid proposal accepted (ours or a peer's)
+  first_part_out    we ARE the proposer: first part handed to gossip
+                    (ADR-024 streaming split — availability of part 0,
+                    not completion of the split; proposal_signed's
+                    reap/prepare/assemble/split info attrs carry the
+                    full propose decomposition)
   first_part        first block part landed in the part set
   parts_complete    the proposal block fully assembled
   prevote_any       2/3-any prevote power seen this round
@@ -98,9 +103,9 @@ _MAX_PENDING = 4096
 # trace.KNOWN_SPANS / fail.REGISTERED_SITES)
 KNOWN_STAMPS = frozenset({
     "new_height", "propose_start", "proposal_signed", "proposal",
-    "first_part", "parts_complete", "prevote_any", "prevote_quorum",
-    "precommit_quorum", "commit", "apply_start", "apply_done",
-    "durable",
+    "first_part", "first_part_out", "parts_complete", "prevote_any",
+    "prevote_quorum", "precommit_quorum", "commit", "apply_start",
+    "apply_done", "durable",
 })
 
 # (stage, start stamp, end stamp) — the decomposition table, in

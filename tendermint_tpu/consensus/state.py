@@ -34,7 +34,7 @@ from tendermint_tpu.types.basic import (
     BlockID, PartSetHeader, SignedMsgType, Timestamp)
 from tendermint_tpu.types.block import Block
 from tendermint_tpu.types.commit import Commit
-from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.part_set import Part, PartSet, make_block_parts
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.types.vote_set import (
@@ -562,17 +562,29 @@ class ConsensusState(BaseService):
             self._enter_prevote(height, round_)
 
     def _default_decide_proposal(self, height: int, round_: int):
-        """Reference defaultDecideProposal :1133."""
+        """Reference defaultDecideProposal :1133, restructured as the
+        proposer fast path (ADR-024): budgeted block creation
+        (create_proposal_block), streaming part-set construction
+        (types/part_set.py make_block_parts), and ONE per-part send
+        loop — the proposal and part 0 reach gossip while later parts'
+        merkle proofs are still unextracted."""
         rs = self.rs
-        if rs.valid_block is not None:
+        created = rs.valid_block is None
+        if not created:
             block, parts = rs.valid_block, rs.valid_block_parts
         else:
             commit = self._commit_for_proposal(height)
             if commit is None:
                 return
+            c = self.config
             block = self.block_exec.create_proposal_block(
-                height, self.state, commit, self.priv_pub_key.address())
-            parts = PartSet.from_data(block.proto())
+                height, self.state, commit, self.priv_pub_key.address(),
+                reap_budget_s=(c.propose_reap_budget_ms / 1e3
+                               if c.propose_reap_budget_ms else None),
+                prepare_budget_s=(c.propose_prepare_budget_ms / 1e3
+                                  if c.propose_prepare_budget_ms else None),
+                max_bytes_cap=c.propose_max_bytes or None)
+            parts = make_block_parts(block)
         block_id = BlockID(block.hash(), parts.header())
         proposal = Proposal(height=height, round=round_,
                             pol_round=rs.valid_round, block_id=block_id,
@@ -584,18 +596,43 @@ class ConsensusState(BaseService):
                 self.state.chain_id, proposal)
         except Exception:
             return
-        # send to ourselves via internal queue, then gossip
+        # proposal first (internal + gossip: peers drop parts for an
+        # unknown proposal), then parts ride one loop — internal queue
+        # put and every broadcast hook per part, in index order — so
+        # each part ships the moment its proof exists.  The seed code
+        # iterated parts.get_part(i) once per destination and re-called
+        # parts.header() per iteration.
         self._internal_queue.put((ProposalMessage(proposal), ""))
-        for i in range(parts.header().total):
-            self._internal_queue.put(
-                (BlockPartMessage(height, round_, parts.get_part(i)), ""))
         for fn in self.broadcast_proposal:
             fn(proposal)
-        for fn in self.broadcast_block_part:
-            for i in range(parts.header().total):
-                fn(height, round_, parts.get_part(i))
+        total = parts.header().total
+        streamed = not isinstance(parts, PartSet)
+        t_split = time.perf_counter()
+        with trace.span("propose.split", parts=total, height=height):
+            first = True
+            for part in parts.iter_parts():
+                self._internal_queue.put(
+                    (BlockPartMessage(height, round_, part), ""))
+                for fn in self.broadcast_block_part:
+                    fn(height, round_, part)
+                if first:
+                    first = False
+                    obsv.stamp(self.name, height, "first_part_out",
+                               round_=round_)
+        split_s = time.perf_counter() - t_split
+        m = self.block_exec.metrics
+        m.proposal_create_seconds.observe(split_s, stage="split")
+        m.parts_streamed_total.inc(
+            total, path="streaming" if streamed else "serial")
+        # the propose decomposition rides proposal_signed's info attrs
+        # (reap/prepare/assemble from the executor's last create, split
+        # measured here) — only for a block created THIS round; a
+        # reproposed valid block has no create stages
+        timings = dict(self.block_exec.last_propose_timings) if created \
+            else {}
+        timings["split_s"] = round(split_s, 6)
         obsv.stamp(self.name, height, "proposal_signed", round_=round_,
-                   parts_total=parts.header().total)
+                   parts_total=total, **timings)
 
     def _commit_for_proposal(self, height: int) -> Optional[Commit]:
         if height == self.state.initial_height:

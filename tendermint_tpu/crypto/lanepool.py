@@ -272,6 +272,76 @@ def run_lanes(thunks: Sequence[Callable]) -> List:
 
 
 # ---------------------------------------------------------------------------
+# generic chunk-level map: one pure per-chunk function sharded across
+# cores (the bulk SHA-256 leaf layer, ADR-024, is the first consumer)
+# ---------------------------------------------------------------------------
+
+def map_sharded(fn: Callable[[Sequence], List], items: Sequence,
+                min_chunk: int = MIN_CHUNK) -> Optional[List]:
+    """Apply a chunk function across `items` on idle pool workers and
+    merge the results back in index order.  `fn` takes a contiguous
+    slice of `items` and returns one result per row; chunk boundaries
+    must not change per-row results (pure row-wise functions only).
+
+    Returns the merged list, or None when the pool declines (disabled,
+    resolved size < 2, or the list is too small to shard) — the caller
+    runs its own serial loop, exactly the verify_sharded contract.
+    Chunk 0 always runs in the submitting thread, every admitted
+    future is settled even when another chunk raises, and the first
+    exception (including a chunk returning the wrong row count)
+    propagates so the caller can fall back serially."""
+    n = len(items)
+    if n < 2 * min_chunk:  # size-check FIRST: a tiny list must not
+        return None        # even construct the pool
+    p = pool()
+    if p is None:
+        return None
+    k = min(p.workers, n // min_chunk)
+    if k < 2:
+        return None
+    bounds = [(i * n) // k for i in range(k + 1)]
+
+    def chunk(lo, hi):
+        return fn(items[lo:hi])
+
+    futs = []
+    for i in range(1, k):
+        lo, hi = bounds[i], bounds[i + 1]
+        futs.append((lo, hi, p.try_submit(chunk, lo, hi)))
+    degrade.publish_host_pool(depth=p.depth())
+    out: List = [None] * n
+    pooled = 0
+    first_err: Optional[BaseException] = None
+
+    def settle(lo, hi, sub):
+        nonlocal first_err
+        if len(sub) != hi - lo:
+            raise RuntimeError(
+                f"map_sharded chunk returned {len(sub)} rows for "
+                f"[{lo}, {hi})")
+        out[lo:hi] = sub
+
+    try:
+        settle(bounds[0], bounds[1], chunk(bounds[0], bounds[1]))
+    except Exception as e:  # noqa: BLE001 - settle the futures first
+        first_err = e
+    for lo, hi, f in futs:
+        pooled += f is not None
+        try:
+            settle(lo, hi, f.result() if f is not None else chunk(lo, hi))
+        except Exception as e:  # noqa: BLE001 - keep settling the rest
+            if first_err is None:
+                first_err = e
+            continue
+    degrade.publish_host_pool(
+        depth=p.depth(), tasks=(("chunk", "pooled", pooled),
+                                ("chunk", "inline", k - pooled)))
+    if first_err is not None:
+        raise first_err  # -> the caller's serial fallback
+    return out
+
+
+# ---------------------------------------------------------------------------
 # chunk-level concurrency: one native C call sharded across cores
 # ---------------------------------------------------------------------------
 

@@ -48,6 +48,45 @@ def _hash_level(level: List[bytes]) -> List[bytes]:
     return nxt
 
 
+def _leaf_chunk(items: List[bytes]) -> List[bytes]:
+    """Serial leaf layer over one contiguous chunk — row-wise pure, so
+    lanepool.map_sharded chunk boundaries cannot change any digest."""
+    return [_sha256(_LEAF_PREFIX + it) for it in items]
+
+
+# rows smaller than this make the leaf layer handoff-bound (one SHA-256
+# of a ~100-byte tx costs well under a microsecond against ~50 us of
+# thread handoff), so small-row lists shard only in big slabs; 64KB
+# block parts amortize the handoff at the lanepool floor of 8
+_BULK_BIG_ROW = 4096
+_BULK_SMALL_ROW_CHUNK = 512
+
+
+def bulk_leaf_hashes(items: List[bytes]) -> List[bytes]:
+    """Leaf layer (SHA-256(0x00 || item) per row) for the whole list,
+    sharded across the crypto/lanepool host pool when the shape
+    justifies it (ADR-024).  Order-stable by construction (chunk i owns
+    rows [lo_i, hi_i)), and ANY pool-path fault — an injected fault at
+    site ``merkle.bulk_hash``, a chunk exception, a short chunk —
+    recomputes the whole layer serially in the caller: byte-identical
+    output either way, the verify_sharded discipline."""
+    n = len(items)
+    if n >= 2 * 8:  # below two lanepool.MIN_CHUNKs nothing can shard
+        min_chunk = (8 if len(items[n // 2]) >= _BULK_BIG_ROW
+                     else _BULK_SMALL_ROW_CHUNK)
+        try:
+            from tendermint_tpu.libs import fail
+            fail.inject("merkle.bulk_hash")
+            from tendermint_tpu.crypto import lanepool
+            out = lanepool.map_sharded(_leaf_chunk, items,
+                                       min_chunk=min_chunk)
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001 - any pool fault degrades to
+            pass           # the serial in-caller layer below
+    return _leaf_chunk(items)
+
+
 def hash_from_byte_slices(items: List[bytes]) -> bytes:
     """Root hash of a list of byte slices (reference crypto/merkle/tree.go:9).
 
@@ -55,17 +94,30 @@ def hash_from_byte_slices(items: List[bytes]) -> bytes:
     point is the largest power of two strictly below n, its recursive
     tree is identical to pairwise reduction with the odd node promoted
     (pinned against the recursive oracle in tests/test_pipeline.py).
-    One hashlib pass per level, no Python recursion — this runs on the
-    block pipeline's stage thread for part-set and results hashing
-    (ADR-017), where hashlib releases the GIL on large leaves.
+    The leaf layer — the dominant cost, all the input bytes — rides
+    the lanepool bulk digest path (ADR-024); reduction levels stay
+    serial (32-byte rows shrink geometrically).
     """
     n = len(items)
     if n == 0:
         return _sha256(b"")
-    level = [_sha256(_LEAF_PREFIX + it) for it in items]
+    level = bulk_leaf_hashes(items)
     while len(level) > 1:
         level = _hash_level(level)
     return level[0]
+
+
+def levels_from_byte_slices(items: List[bytes]) -> List[List[bytes]]:
+    """Every reduction level bottom-up for a NON-EMPTY item list:
+    levels[0] is the (bulk-hashed) leaf row, levels[-1] the one-row
+    root.  The streaming part set (types/part_set.py, ADR-024) keeps
+    these to extract per-part proofs lazily."""
+    if not items:
+        raise ValueError("levels need at least one item")
+    levels = [bulk_leaf_hashes(items)]
+    while len(levels[-1]) > 1:
+        levels.append(_hash_level(levels[-1]))
+    return levels
 
 
 @dataclass
@@ -118,22 +170,25 @@ def proofs_from_byte_slices(items: List[bytes]):
     n = len(items)
     if n == 0:
         return _sha256(b""), []
-    levels = [[leaf_hash(it) for it in items]]
-    while len(levels[-1]) > 1:
-        levels.append(_hash_level(levels[-1]))
+    levels = levels_from_byte_slices(items)
     root = levels[-1][0]
-    proofs = []
-    for i in range(n):
-        aunts = []
-        idx = i
-        for level in levels[:-1]:
-            sib = idx ^ 1
-            if sib < len(level):
-                aunts.append(level[sib])
-            idx >>= 1
-        proofs.append(Proof(total=n, index=i, leaf_hash=levels[0][i],
-                            aunts=aunts))
-    return root, proofs
+    return root, [proof_at(levels, i) for i in range(n)]
+
+
+def proof_at(levels: List[List[bytes]], i: int) -> Proof:
+    """The one leaf's inclusion proof read straight off prebuilt
+    reduction levels (the sibling at each level, bottom-up; a promoted
+    odd node has no aunt at that level) — identical aunt lists to the
+    reference's recursive trail construction."""
+    aunts = []
+    idx = i
+    for level in levels[:-1]:
+        sib = idx ^ 1
+        if sib < len(level):
+            aunts.append(level[sib])
+        idx >>= 1
+    return Proof(total=len(levels[0]), index=i, leaf_hash=levels[0][i],
+                 aunts=aunts)
 
 
 # ---------------------------------------------------------------------------

@@ -135,6 +135,19 @@ REGISTERED_SITES = frozenset({
     # configured value — a malfunctioning controller must fail static,
     # never fail steering; latency is absorbed into the period
     "control.decide",
+    # proposer fast path (ADR-024): propose.reap fires inside the
+    # budgeted reap stage of create_proposal_block (raise = the
+    # proposal degrades to an EMPTY tx list instead of stalling the
+    # round; latency:<ms> consumes the reap budget so a deadline-aware
+    # mempool returns a short reap), propose.parts fires at the
+    # streaming part-set construction seam shared by the proposer and
+    # blocksync (raise = fall back to the serial PartSet.from_data,
+    # byte-identical parts), and merkle.bulk_hash fires inside the
+    # pooled leaf-layer branch of the bulk digest (raise = the whole
+    # leaf layer recomputes serially in the caller, identical digests)
+    "propose.reap",
+    "propose.parts",
+    "merkle.bulk_hash",
     # bench backend probe (bench.py _probe_once, ISSUE 8): forces the
     # dead-backend (raise) and wedged-backend (latency:<ms> past the
     # probe timeout) classes deterministically, so the opportunistic

@@ -318,6 +318,19 @@ class StateMetrics:
             "state", "batch_verify_size",
             "Signatures per batched verify call (TPU data plane).",
             buckets=[1, 4, 16, 64, 256, 1024, 4096, 16384, 65536])
+        self.proposal_create_seconds = reg.histogram(
+            "state", "proposal_create_seconds",
+            "Proposer fast-path stage walls (ADR-024): reap (budgeted "
+            "mempool scan), prepare (PrepareProposal round trip), "
+            "assemble (make_block incl. data hash), split (part-set "
+            "construction + send), seconds.",
+            labels=("stage",), buckets=exp_buckets(0.0005, 4, 10))
+        self.parts_streamed_total = reg.counter(
+            "state", "parts_streamed_total",
+            "Block parts handed to gossip by the proposer's streaming "
+            "part-set path (ADR-024), by construction path (streaming "
+            "= lazy proofs, serial = PartSet.from_data fallback).",
+            labels=("path",))
 
 
 class BlockSyncMetrics:

@@ -61,7 +61,7 @@ KNOWN_SPANS = frozenset({
     # crypto/batch.py — the BatchVerifier coalesce window
     "batch.host_lane", "batch.verdict", "batch.verify",
     # bench.py
-    "bench.host_baseline", "bench.pass",
+    "bench.host_baseline", "bench.pass", "bench.propose",
     # crypto/degrade.py — breaker + device lane lifecycle
     "breaker.transition", "device.collect", "device.host_fallback",
     "device.launch",
@@ -85,7 +85,10 @@ KNOWN_SPANS = frozenset({
     # crypto/scheduler.py — the VerifyScheduler pipeline
     "sched.coalesce", "sched.deadline_miss", "sched.host_lane",
     "sched.launch", "sched.resolve", "sched.shed", "sched.submit",
-    # state/execution.py
+    # state/execution.py — the budgeted propose decomposition
+    # (ADR-024) plus block apply
+    "propose.assemble", "propose.prepare", "propose.reap",
+    "propose.split",
     "state.apply_block", "state.validate_block",
     # statesync/ — the fast-join fetch/verify/apply pipeline and the
     # bounded chunk server (ADR-022)

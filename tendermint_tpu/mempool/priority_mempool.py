@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
@@ -197,11 +198,20 @@ class PriorityMempool:
         return sorted(self._txs.values(),
                       key=lambda w: (-w.priority, w.order))
 
-    def reap_max_bytes_max_gas(self, max_bytes: int,
-                               max_gas: int) -> List[bytes]:
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int,
+                               deadline: Optional[float] = None) \
+            -> List[bytes]:
+        """Priority-order reap under byte/gas caps.  `deadline`
+        (time.monotonic-based, ADR-024) bounds the lock-held scan: the
+        skip-and-continue search for smaller txs is O(n) even once the
+        block is nearly full, so past the deadline the reap returns
+        what it has — highest-priority txs first by construction."""
         with self._lock:
             out, total_b, total_g = [], 0, 0
-            for w in self._sorted():
+            for i, w in enumerate(self._sorted()):
+                if (deadline is not None and not i & 63
+                        and time.monotonic() >= deadline):
+                    break
                 nb = total_b + len(w.tx) + 20
                 ng = total_g + w.gas_wanted
                 if max_bytes > -1 and nb > max_bytes:

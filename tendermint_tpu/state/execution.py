@@ -15,7 +15,7 @@ from typing import List, Optional, Tuple
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import merkle, tmhash
-from tendermint_tpu.libs import trace
+from tendermint_tpu.libs import fail, trace
 from tendermint_tpu.libs.fail import fail_point
 from tendermint_tpu.types.basic import BlockID, Timestamp
 from tendermint_tpu.types.block import Block
@@ -77,23 +77,132 @@ class BlockExecutor:
 
     # -- proposal creation (reference state/execution.go:95-145) -----------
 
+    # stage walls of the most recent create_proposal_block, written by
+    # the proposing thread and read back by decide_proposal for the
+    # observatory's proposal_signed sub-attrs (ADR-024); single
+    # consumer — the consensus receive thread drives both sides
+    last_propose_timings: dict = {}
+
     def create_proposal_block(self, height: int, state: State,
-                              commit: Commit,
-                              proposer_address: bytes) -> Block:
+                              commit: Commit, proposer_address: bytes, *,
+                              reap_budget_s: Optional[float] = None,
+                              prepare_budget_s: Optional[float] = None,
+                              max_bytes_cap: Optional[int] = None) -> Block:
+        """Budgeted proposal creation (ADR-024): wall-clock budgets for
+        the reap and PrepareProposal stages plus an optional byte cap
+        degrade the BLOCK (fewer/raw txs) instead of the round when the
+        mempool is huge or the app is slow.  No budgets (the default)
+        keeps the unbounded reference behavior, except that an app
+        exception in PrepareProposal now also degrades to the raw
+        reaped txs — a broken app must not stall the proposer."""
         max_bytes = state.consensus_params.block.max_bytes
+        if max_bytes_cap and (max_bytes < 0 or max_bytes_cap < max_bytes):
+            max_bytes = max_bytes_cap
         max_gas = state.consensus_params.block.max_gas
         evidence = (self.evidence_pool.pending_evidence(
             state.consensus_params.evidence.max_bytes)
             if self.evidence_pool else [])
         max_data = max_data_bytes(max_bytes, len(evidence),
                                   state.validators.size())
-        txs = (self.mempool.reap_max_bytes_max_gas(max_data, max_gas)
-               if self.mempool else [])
-        # PrepareProposal: the app may reorder/replace txs
-        rpp = self.app.prepare_proposal(abci.RequestPrepareProposal(
-            block_data=list(txs), block_data_size=max_data))
-        return state.make_block(height, list(rpp.block_data), commit,
-                                evidence, proposer_address)
+
+        t0 = time.perf_counter()
+        # the deadline is fixed BEFORE the chaos seam so an injected
+        # latency consumes the budget exactly like a slow lock queue
+        deadline = (time.monotonic() + reap_budget_s
+                    if reap_budget_s else None)
+        txs: List[bytes] = []
+        reap_degraded = False
+        with trace.span("propose.reap", height=height) as sp:
+            try:
+                fail.inject("propose.reap")
+                if self.mempool is not None:
+                    txs = self._reap(max_data, max_gas, deadline)
+            except Exception as e:  # noqa: BLE001 - a mempool fault
+                # degrades to an empty block, never a stalled round
+                txs, reap_degraded = [], True
+                if trace.is_enabled():
+                    sp.add(degraded=type(e).__name__)
+            if trace.is_enabled():
+                sp.add(txs=len(txs))
+        t1 = time.perf_counter()
+
+        # PrepareProposal: the app may reorder/replace txs.  With a
+        # budget the call runs on a bounded-join daemon thread (the
+        # bench.py backend-probe discipline): a slow or wedged app
+        # yields the raw reaped txs at the deadline.
+        prepare_degraded = False
+        with trace.span("propose.prepare", height=height) as sp:
+            req = abci.RequestPrepareProposal(
+                block_data=list(txs), block_data_size=max_data)
+            block_data, why = self._prepare(req, prepare_budget_s)
+            if why is not None:
+                block_data, prepare_degraded = list(txs), True
+                if trace.is_enabled():
+                    sp.add(degraded=why)
+        t2 = time.perf_counter()
+
+        with trace.span("propose.assemble", height=height):
+            block = state.make_block(height, block_data, commit,
+                                     evidence, proposer_address)
+        t3 = time.perf_counter()
+
+        self.metrics.proposal_create_seconds.observe(t1 - t0, stage="reap")
+        self.metrics.proposal_create_seconds.observe(
+            t2 - t1, stage="prepare")
+        self.metrics.proposal_create_seconds.observe(
+            t3 - t2, stage="assemble")
+        self.last_propose_timings = {
+            "reap_s": round(t1 - t0, 6), "prepare_s": round(t2 - t1, 6),
+            "assemble_s": round(t3 - t2, 6),
+            "reap_degraded": reap_degraded,
+            "prepare_degraded": prepare_degraded}
+        return block
+
+    def _reap(self, max_data: int, max_gas: int,
+              deadline: Optional[float]) -> List[bytes]:
+        """Reap with the deadline when the mempool understands it; the
+        in-tree mempools do, duck-typed test/harness stand-ins keep
+        the two-argument call."""
+        reap = self.mempool.reap_max_bytes_max_gas
+        if deadline is not None:
+            try:
+                import inspect
+                takes_deadline = "deadline" in \
+                    inspect.signature(reap).parameters
+            except (TypeError, ValueError):
+                takes_deadline = False
+            if takes_deadline:
+                return reap(max_data, max_gas, deadline=deadline)
+        return reap(max_data, max_gas)
+
+    def _prepare(self, req, budget_s: Optional[float]):
+        """(block_data, None) from the app, or (None, reason) when the
+        call must degrade: app exception either way, deadline overrun
+        when budgeted (the abandoned daemon thread finishes or wedges
+        harmlessly — its result is simply unused)."""
+        if not budget_s:
+            try:
+                return list(self.app.prepare_proposal(req).block_data), None
+            except Exception as e:  # noqa: BLE001 - degrade, don't stall
+                return None, type(e).__name__
+        import threading
+        box: dict = {}
+
+        def call():
+            try:
+                box["data"] = list(self.app.prepare_proposal(req).block_data)
+            except BaseException as e:  # noqa: BLE001 - carried to joiner
+                box["err"] = e
+
+        t = threading.Thread(target=call, daemon=True,
+                             name="propose-prepare")
+        t.start()
+        t.join(budget_s)
+        if t.is_alive():
+            return None, "deadline"
+        if "err" in box:
+            return None, type(box["err"]).__name__
+        return box["data"], None
 
     def process_proposal(self, block: Block, state: State) -> bool:
         """ProcessProposal ABCI gate (reference state/execution.go:147)."""

@@ -186,6 +186,39 @@ class Block:
             out += pe.message_field_always(4, self.last_commit.proto())
         return out
 
+    def proto_regions(self):
+        """The serialized block as an ordered sequence of byte regions:
+        ``b"".join(proto_regions())`` is byte-identical to ``proto()``
+        (pinned in tests/test_propose_fastpath.py).  The data region —
+        the bulk of a full block — is emitted per-tx after a precomputed
+        length prefix, so the streaming part-set builder (ADR-024) can
+        chunk and leaf-hash without ever materializing one contiguous
+        copy of the whole block."""
+        yield pe.message_field_always(1, self.header.proto())
+        # per-tx entries encoded ONCE: their lengths give the field-2
+        # body length, then they flow out coalesced into ~part-size
+        # regions, so the streaming chunker's per-region cost scales
+        # with part count, not tx count, and no single contiguous copy
+        # of the whole data section ever exists
+        entries = [pe.message_field_always(1, tx)
+                   for tx in self.data.txs]
+        yield pe.tag(2, pe.WT_BYTES) + pe.uvarint(
+            sum(map(len, entries)))
+        acc, acc_len = [], 0
+        for e in entries:
+            acc.append(e)
+            acc_len += len(e)
+            if acc_len >= 1 << 16:
+                yield b"".join(acc)
+                acc, acc_len = [], 0
+        if acc:
+            yield b"".join(acc)
+        ev_body = b"".join(
+            pe.message_field_always(1, e.proto()) for e in self.evidence)
+        yield pe.message_field_always(3, ev_body)
+        if self.last_commit is not None:
+            yield pe.message_field_always(4, self.last_commit.proto())
+
     @classmethod
     def from_proto(cls, data: bytes) -> "Block":
         """Decode a wire/storage Block (inverse of proto()).  Raises

@@ -83,6 +83,17 @@ class PartSet:
             ps.add_part(Part(i, chunk, proof))
         return ps
 
+    @classmethod
+    def from_data_streaming(cls, regions,
+                            part_size: int = BLOCK_PART_SIZE_BYTES) \
+            -> "StreamingPartSet":
+        """Incremental construction (ADR-024): consume serialized byte
+        regions (or one bytes object) and defer per-part proof
+        extraction to first use — `iter_parts()` hands part 0 to gossip
+        while later parts' proofs are still unextracted.  Root- and
+        byte-identical to `from_data` on the same data."""
+        return StreamingPartSet(regions, part_size=part_size)
+
     def header(self) -> PartSetHeader:
         return self.header_
 
@@ -125,3 +136,103 @@ class PartSet:
         if not self.is_complete():
             raise ValueError("part set incomplete")
         return b"".join(p.bytes_ for p in self.parts)
+
+    def iter_parts(self):
+        """Parts in index order (None for absent indices) — the shape
+        the proposer's send loop shares with StreamingPartSet."""
+        for i in range(self.header_.total):
+            yield self.parts[i]
+
+
+class StreamingPartSet:
+    """Proposer/blocksync-side complete part set with LAZY proofs
+    (ADR-024).
+
+    Construction consumes the block's serialized byte regions
+    (types/block.py proto_regions), chunks them to `part_size`, and
+    bulk-hashes the leaf layer across the lanepool host pool
+    (crypto/merkle.levels_from_byte_slices); the reduction levels are
+    kept so each part's inclusion proof is extracted only when that
+    part is first requested.  `iter_parts()` therefore yields a
+    proof-complete part 0 while parts 1..N-1 are still proof-less, and
+    a consumer that only needs the root (blocksync's crash-resume
+    identity check, a store-less replay) never pays for proofs at all.
+
+    Exposes the read-only surface of a COMPLETE PartSet — header /
+    is_complete / get_part / iter_parts / byte_size / count / assemble
+    — so store.save_block and the block pipeline take it unchanged.
+    Byte- and root-identical to PartSet.from_data on the same data
+    (pinned in tests/test_propose_fastpath.py).  Not internally locked:
+    single consumer at a time (the constructing thread, or whoever it
+    hands the set to), matching how decide_proposal and the pipeline
+    stage->writer handoff use it.
+    """
+
+    def __init__(self, regions, part_size: int = BLOCK_PART_SIZE_BYTES):
+        if isinstance(regions, (bytes, bytearray, memoryview)):
+            regions = (bytes(regions),)
+        buf = bytearray()
+        chunks: List[bytes] = []
+        for region in regions:
+            buf += region
+            while len(buf) >= part_size:
+                chunks.append(bytes(buf[:part_size]))
+                del buf[:part_size]
+        if buf or not chunks:
+            chunks.append(bytes(buf))
+        self._chunks = chunks
+        self._levels = merkle.levels_from_byte_slices(chunks)
+        self.header_ = PartSetHeader(total=len(chunks),
+                                     hash=self._levels[-1][0])
+        self._parts: List[Optional[Part]] = [None] * len(chunks)
+        self.count = len(chunks)
+        self.byte_size = sum(len(c) for c in chunks)
+
+    def header(self) -> PartSetHeader:
+        return self.header_
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self.header_ == header
+
+    def is_complete(self) -> bool:
+        return True
+
+    def get_part(self, index: int) -> Optional[Part]:
+        if not 0 <= index < len(self._chunks):
+            return None
+        part = self._parts[index]
+        if part is None:
+            part = Part(index, self._chunks[index],
+                        merkle.proof_at(self._levels, index))
+            self._parts[index] = part
+        return part
+
+    def iter_parts(self):
+        for i in range(len(self._chunks)):
+            yield self.get_part(i)
+
+    def assemble(self) -> bytes:
+        return b"".join(self._chunks)
+
+    def part_set(self) -> PartSet:
+        """Materialize the concrete PartSet (every proof built AND
+        verified against the header via add_part)."""
+        ps = PartSet(self.header_)
+        for part in self.iter_parts():
+            ps.add_part(part)
+        return ps
+
+
+def make_block_parts(block) -> "StreamingPartSet | PartSet":
+    """The ONE block->parts path the proposer (consensus/state.py
+    decide_proposal) and blocksync (blocksync/replay.py block_id_of)
+    share: streaming construction over the block's serialized regions,
+    degrading to the serial PartSet.from_data on any fault — chaos site
+    ``propose.parts`` (raise = serial fallback with byte-identical
+    parts; latency = a slow split, absorbed)."""
+    from tendermint_tpu.libs import fail
+    try:
+        fail.inject("propose.parts")
+        return PartSet.from_data_streaming(block.proto_regions())
+    except Exception:  # noqa: BLE001 - any streaming fault degrades to
+        return PartSet.from_data(block.proto())  # the seed-era path

@@ -396,8 +396,8 @@ CHAOS_TEST_FILES = ("test_chaos_matrix.py", "test_comb.py",
                     "test_devobs.py", "test_ingress.py",
                     "test_latency_observatory.py",
                     "test_netharness.py", "test_observatory.py",
-                    "test_pipeline.py", "test_scheduler.py",
-                    "test_statesync.py")
+                    "test_pipeline.py", "test_propose_fastpath.py",
+                    "test_scheduler.py", "test_statesync.py")
 
 
 def _armed_sites() -> set:

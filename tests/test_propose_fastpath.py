@@ -1,0 +1,331 @@
+"""Proposer fast path (ADR-024): streaming part sets, pooled bulk
+hashing, budgeted reap/PrepareProposal — identity + chaos coverage.
+
+Three contracts pinned here:
+
+1. StreamingPartSet is BYTE- and ROOT-identical to PartSet.from_data on
+   the same data — root, every per-part proof, byte sizes — across part
+   counts 1/2/odd/pow2/large and empty data, and regardless of how the
+   input is sliced into regions.
+2. merkle.bulk_leaf_hashes equals the serial hashlib oracle with the
+   host pool on, off, or faulting (order-stability hammer + chaos
+   fallback at "merkle.bulk_hash").
+3. The budgeted proposal path degrades the BLOCK, never the round:
+   chaos raise at "propose.reap" -> empty-tx block; latency consumes
+   the reap budget; "propose.parts" raise -> serial PartSet fallback
+   with identical header/parts; a slow or raising PrepareProposal app
+   -> the raw reaped txs.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import lanepool, merkle
+from tendermint_tpu.libs import fail
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.priority_mempool import PriorityMempool
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.types.part_set import (
+    BLOCK_PART_SIZE_BYTES, PartSet, StreamingPartSet, make_block_parts)
+
+from helpers import Node, make_genesis
+
+PS = BLOCK_PART_SIZE_BYTES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    lanepool.set_workers(None)
+    lanepool.close()
+    fail.reset()
+    yield
+    fail.reset()
+    lanepool.set_workers(None)
+    lanepool.close()
+
+
+def _deterministic(size: int, seed: int = 7) -> bytes:
+    out = bytearray()
+    x = seed
+    while len(out) < size:
+        x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+        out += x.to_bytes(4, "little")
+    return bytes(out[:size])
+
+
+# ---------------------------------------------------------------------------
+# 1. streaming vs from_data identity
+# ---------------------------------------------------------------------------
+
+# part counts: 1, 2, odd, pow2, large (+ boundary stragglers)
+IDENTITY_SIZES = (0, 1, 5, PS - 1, PS, PS + 1, 2 * PS, 3 * PS,
+                  4 * PS, 7 * PS + 123, 17 * PS + 1)
+
+
+@pytest.mark.parametrize("size", IDENTITY_SIZES)
+def test_streaming_identity_sweep(size):
+    """Root, EVERY proof, and byte sizes match PartSet.from_data."""
+    data = _deterministic(size)
+    ref = PartSet.from_data(data)
+    sps = PartSet.from_data_streaming(data)
+    assert isinstance(sps, StreamingPartSet)
+    assert sps.header() == ref.header()
+    assert sps.count == ref.count
+    assert sps.byte_size == ref.byte_size
+    assert sps.is_complete()
+    root = ref.header().hash
+    for i in range(ref.header().total):
+        a, b = sps.get_part(i), ref.get_part(i)
+        assert a.bytes_ == b.bytes_
+        assert a.proof.leaf_hash == b.proof.leaf_hash
+        assert a.proof.aunts == b.proof.aunts
+        assert a.proof.total == b.proof.total and a.proof.index == i
+        assert a.proof.verify(root, a.bytes_)
+    assert sps.assemble() == data
+    # out-of-range behaves like PartSet
+    assert sps.get_part(ref.header().total) is None
+    assert sps.get_part(-1) is None
+
+
+def test_streaming_ragged_regions_identity():
+    """Region slicing must not affect the result: feed the same bytes
+    as one blob, per-byte-ish shards, and uneven big slabs."""
+    data = _deterministic(3 * PS + 77)
+    ref = PartSet.from_data(data)
+
+    def shards(sizes):
+        i, out = 0, []
+        for s in sizes:
+            out.append(data[i:i + s])
+            i += s
+        out.append(data[i:])
+        return out
+
+    for regions in (
+            [data],
+            shards([1, 2, 3, 5, 8, 13, 21] * 3),
+            shards([PS // 2, PS, PS + 1, 17]),
+            shards([len(data) - 1]),
+    ):
+        sps = PartSet.from_data_streaming(iter(regions))
+        assert sps.header() == ref.header()
+        for a, b in zip(sps.iter_parts(), ref.iter_parts()):
+            assert a.bytes_ == b.bytes_ and a.proof.aunts == b.proof.aunts
+
+
+def test_streaming_part_set_materializes_verified():
+    """part_set() routes every lazy proof through add_part's verify."""
+    sps = PartSet.from_data_streaming(_deterministic(5 * PS + 9))
+    ps = sps.part_set()
+    assert ps.is_complete()
+    assert ps.header() == sps.header()
+    assert ps.assemble() == sps.assemble()
+
+
+def test_proto_regions_join_equals_proto():
+    """b"".join(block.proto_regions()) is byte-identical to proto()."""
+    gdoc, privs = make_genesis(1)
+    state = state_from_genesis(gdoc)
+    addr = privs[0].pub_key().address()
+    for txs in ([], [b"a"], [b"", b"xy" * 1000],
+                [bytes([i & 0xFF]) * (i * 37) for i in range(40)]):
+        block = state.make_block(1, txs, None, [], addr)
+        assert b"".join(block.proto_regions()) == block.proto()
+        # and the shared parts path round-trips to the same root
+        assert make_block_parts(block).header() == \
+            PartSet.from_data(block.proto()).header()
+
+
+# ---------------------------------------------------------------------------
+# 2. bulk leaf hashing vs the serial hashlib oracle
+# ---------------------------------------------------------------------------
+
+def _oracle_leaves(items):
+    return [hashlib.sha256(b"\x00" + it).digest() for it in items]
+
+
+@pytest.mark.parametrize("n,row", [(1, 10), (15, 3), (16, 64), (100, 1),
+                                   (257, 200), (1200, 4096), (3000, 0)])
+def test_bulk_leaf_hashes_matches_oracle(n, row):
+    items = [_deterministic(row, seed=i) if row else b"" for i in range(n)]
+    assert merkle.bulk_leaf_hashes(items) == _oracle_leaves(items)
+
+
+def test_bulk_hash_order_stability_hammer():
+    """Repeated pooled runs are identical to each other AND to the
+    forced-serial run — shard merge must be order-stable."""
+    items = [_deterministic(100 + (i % 13), seed=i) for i in range(4096)]
+    want = _oracle_leaves(items)
+    lanepool.set_workers(1)          # pool() -> None: forced serial
+    assert merkle.bulk_leaf_hashes(items) == want
+    lanepool.set_workers(None)
+    lanepool.close()
+    lanepool.set_workers(4)
+    for _ in range(5):
+        assert merkle.bulk_leaf_hashes(items) == want
+
+
+def test_bulk_hash_pool_fault_falls_back_serial():
+    """raise at merkle.bulk_hash -> the WHOLE leaf layer recomputes in
+    the caller, identical digests; latency is absorbed."""
+    items = [_deterministic(64, seed=i) for i in range(600)]
+    want = _oracle_leaves(items)
+    fail.set_mode("merkle.bulk_hash", "raise")
+    assert merkle.bulk_leaf_hashes(items) == want
+    assert fail.fired("merkle.bulk_hash", "raise") >= 1
+    fail.clear("merkle.bulk_hash")
+    fail.set_mode("merkle.bulk_hash", "latency:5")
+    assert merkle.bulk_leaf_hashes(items) == want
+    assert fail.fired("merkle.bulk_hash", "latency:5") >= 1
+
+
+def test_bulk_hash_feeds_merkle_root_and_proofs():
+    """hash/proofs_from_byte_slices over the bulk path still equal the
+    recursive-reference results the existing merkle tests pin; cross
+    check proofs verify against the root here."""
+    items = [_deterministic(50, seed=i) for i in range(513)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, (it, pf) in enumerate(zip(items, proofs)):
+        assert pf.index == i and pf.verify(root, it)
+
+
+def test_map_sharded_small_input_declines():
+    assert lanepool.map_sharded(lambda xs: xs, [b"a"] * 3) is None
+
+
+# ---------------------------------------------------------------------------
+# 3. chaos at propose.reap / propose.parts; budget degrade semantics
+# ---------------------------------------------------------------------------
+
+def _node():
+    gdoc, privs = make_genesis(1)
+    return Node(gdoc, privs[0], name="p0"), privs[0]
+
+
+def privs_addr(node):
+    return node.pv.priv_key.pub_key().address()
+
+
+def test_chaos_propose_reap_raise_empty_block():
+    node, _ = _node()
+    for i in range(5):
+        node.mempool.check_tx(b"k%d=v" % i)
+    assert node.mempool.size() == 5
+    fail.set_mode("propose.reap", "raise")
+    block = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node))
+    assert fail.fired("propose.reap", "raise") >= 1
+    assert block.data.txs == []
+    assert node.exec.last_propose_timings["reap_degraded"] is True
+    fail.clear("propose.reap")
+    # and without chaos the same call reaps them all
+    block = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node))
+    assert len(block.data.txs) == 5
+    assert node.exec.last_propose_timings["reap_degraded"] is False
+
+
+def test_chaos_propose_reap_latency_consumes_budget():
+    """latency:<ms> past the reap budget -> the deadline-aware mempool
+    returns a SHORT (here: empty) reap; the block still forms."""
+    node, _ = _node()
+    for i in range(200):
+        node.mempool.check_tx(b"tx%d=v" % i)
+    fail.set_mode("propose.reap", "latency:80")
+    block = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node),
+        reap_budget_s=0.02)
+    assert fail.fired("propose.reap", "latency:80") >= 1
+    # deadline passed before the scan started: at most one 64-tx stride
+    assert len(block.data.txs) < 200
+    assert node.exec.last_propose_timings["reap_degraded"] is False
+
+
+def test_chaos_propose_parts_serial_fallback_identical():
+    gdoc, privs = make_genesis(1)
+    state = state_from_genesis(gdoc)
+    block = state.make_block(
+        1, [_deterministic(9000, seed=i) for i in range(30)], None, [],
+        privs[0].pub_key().address())
+    streamed = make_block_parts(block)
+    assert isinstance(streamed, StreamingPartSet)
+    fail.set_mode("propose.parts", "raise")
+    serial = make_block_parts(block)
+    assert fail.fired("propose.parts", "raise") >= 1
+    assert isinstance(serial, PartSet) and serial.is_complete()
+    assert serial.header() == streamed.header()
+    for a, b in zip(serial.iter_parts(), streamed.iter_parts()):
+        assert a.bytes_ == b.bytes_ and a.proof.aunts == b.proof.aunts
+
+
+def test_prepare_budget_slow_app_degrades_to_raw_txs():
+    node, _ = _node()
+    for i in range(3):
+        node.mempool.check_tx(b"s%d=v" % i)
+    orig = node.app.prepare_proposal
+
+    def slow(req):
+        time.sleep(0.5)
+        return orig(req)
+
+    node.app.prepare_proposal = slow
+    t0 = time.monotonic()
+    block = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node),
+        prepare_budget_s=0.05)
+    assert time.monotonic() - t0 < 0.45  # did NOT wait out the app
+    assert len(block.data.txs) == 3      # raw reaped txs
+    assert node.exec.last_propose_timings["prepare_degraded"] is True
+
+
+def test_prepare_app_exception_degrades_to_raw_txs():
+    node, _ = _node()
+    for i in range(2):
+        node.mempool.check_tx(b"e%d=v" % i)
+
+    def boom(req):
+        raise RuntimeError("app broke")
+
+    node.app.prepare_proposal = boom
+    for budget in (None, 0.2):  # unbudgeted AND budgeted paths
+        block = node.exec.create_proposal_block(
+            1, node.exec.state_store.load(), None, privs_addr(node),
+            prepare_budget_s=budget)
+        assert len(block.data.txs) == 2
+        assert node.exec.last_propose_timings["prepare_degraded"] is True
+
+
+def test_propose_max_bytes_cap():
+    node, _ = _node()
+    for i in range(50):
+        node.mempool.check_tx(b"c%03d=" % i + b"x" * 400)
+    capped = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node),
+        max_bytes_cap=4096)
+    free = node.exec.create_proposal_block(
+        1, node.exec.state_store.load(), None, privs_addr(node))
+    assert 0 < len(capped.data.txs) < len(free.data.txs) == 50
+
+
+@pytest.mark.parametrize("mk", [
+    lambda app: Mempool(app),
+    lambda app: PriorityMempool(app),
+], ids=["fifo", "priority"])
+def test_mempool_reap_deadline(mk):
+    """Both mempools honor the deadline: an already-expired deadline
+    reaps at most one 64-tx clock stride; no deadline reaps all."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    mp = mk(KVStoreApplication())
+    for i in range(500):
+        mp.check_tx(b"d%03d=v" % i)
+    assert len(mp.reap_max_bytes_max_gas(-1, -1)) == 500
+    short = mp.reap_max_bytes_max_gas(
+        -1, -1, deadline=time.monotonic() - 1.0)
+    assert len(short) <= 64
+    # future deadline: unconstrained
+    assert len(mp.reap_max_bytes_max_gas(
+        -1, -1, deadline=time.monotonic() + 60.0)) == 500
