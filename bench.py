@@ -1264,6 +1264,149 @@ def _consensus_main():
           file=sys.stderr)
 
 
+def run_gossip_observatory(validators=4, heights=8, seed=7,
+                           latency_ms=5.0, dup_pct=0.10,
+                           workdir=None) -> dict:
+    """Gossip observatory core (ADR-025; shared by BENCH_GOSSIP=1 and
+    bench_report config15): boot a 4-node NetHarness over the vnet
+    with a uniform LinkPolicy armed (fixed one-way latency + a small
+    duplicate probability), commit `heights` heights, and read the
+    gossip observatory's per-link table: bytes per committed block,
+    the duplicate-waste ratio (dup part/vote receipts over all
+    receipts), the per-link RTT spread (max-min of per-link RTT means
+    — how asymmetric the armed WAN looks from inside), and the
+    correlation between each height's gossip-stage seconds and its
+    part-receipt count (does the consensus stage the observatory
+    blames actually track the traffic netobs counted).  Host-only by
+    design: 4-lane vote batches stay below tpu_threshold."""
+    from tendermint_tpu.consensus import observatory as obsv
+    from tendermint_tpu.libs import log as tmlog
+    from tendermint_tpu.networks.harness import NetHarness
+    from tendermint_tpu.p2p import netobs
+
+    tmlog.setup(level="error", stream=sys.stderr)
+
+    sc = {"name": "bench_gossip_observatory", "validators": validators,
+          "steps": [{"op": "wait_height", "delta": heights,
+                     "timeout": 60.0 + 12.0 * heights}]}
+    h = NetHarness(validators=validators, seed=seed, workdir=workdir)
+    h.start()
+    # arm every directed link the same way so the RTT spread reads the
+    # vnet's scheduling noise, not an asymmetric policy
+    for i in range(validators):
+        for j in range(validators):
+            if i != j:
+                h.set_link(i, j, latency_s=latency_ms / 1e3,
+                           dup=dup_pct)
+    t0 = time.perf_counter()
+    try:
+        h.run_scenario(sc)
+        wall = time.perf_counter() - t0
+        obsv.publish_pending()
+        recs = {n: obsv.records(n) for n in obsv.OBS.nodes()}
+        gossip = h.gossip_table()
+        rep = netobs.report()
+    finally:
+        h.stop()
+
+    totals = rep["totals"]
+    link_rtts = [row["rtt"]["mean_s"]
+                 for row in gossip["links"].values()
+                 if row.get("rtt")]
+    # per-height (gossip-stage seconds, part receipts) pairs pooled
+    # across nodes; Pearson r says whether the stage the consensus
+    # observatory blames tracks the traffic netobs counted
+    xs, ys = [], []
+    for node_recs in recs.values():
+        for r in node_recs:
+            g = r["stages"].get("gossip")
+            parts = sum(r["parts_from"].values())
+            if g is not None and parts:
+                xs.append(g)
+                ys.append(parts)
+    corr = None
+    if len(xs) >= 3:
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        sxy = sum((a - mx) * (b - my) for a, b in zip(xs, ys))
+        sxx = sum((a - mx) ** 2 for a in xs)
+        syy = sum((b - my) ** 2 for b in ys)
+        if sxx > 0 and syy > 0:
+            corr = round(sxy / (sxx * syy) ** 0.5, 3)
+    return {
+        "sent_bytes": totals["sent_bytes"],
+        "delivered_bytes": totals["recv_bytes"],
+        "bytes_per_block": round(totals["sent_bytes"] / heights, 1)
+        if heights else None,
+        "duplicate_ratio": totals["duplicate_ratio"],
+        "useful_receipts": totals["useful_receipts"],
+        "duplicate_receipts": totals["duplicate_receipts"],
+        "rtt_links": len(link_rtts),
+        "rtt_mean_ms": round(
+            sum(link_rtts) / len(link_rtts) * 1e3, 3)
+        if link_rtts else None,
+        "rtt_spread_ms": round(
+            (max(link_rtts) - min(link_rtts)) * 1e3, 3)
+        if link_rtts else None,
+        "gossip_stage_vs_parts_r": corr,
+        "stage_samples": len(xs),
+        "shed": gossip.get("shed", {}),
+        "validators": validators, "heights": heights,
+        "latency_ms": latency_ms, "dup_pct": dup_pct,
+        "wall_s": round(wall, 2),
+    }
+
+
+def _gossip_main():
+    """Gossip observatory config (BENCH_GOSSIP=1, ADR-025, bench_report
+    config15): the gossip cost of a committed block as a tracked
+    number — wire bytes per block over a 4-node vnet with a uniform
+    WAN policy armed, plus the waste (duplicate receipts) and the
+    per-link RTT spread the observatory attributes them to.  Entirely
+    host-capable by design (rc=0 with no accelerator)."""
+    validators = int(os.environ.get("BENCH_GOSSIP_VALS", "4"))
+    heights = int(os.environ.get("BENCH_GOSSIP_HEIGHTS", "8"))
+    seed = int(os.environ.get("BENCH_GOSSIP_SEED", "7"))
+    latency_ms = float(os.environ.get("BENCH_GOSSIP_LAT_MS", "5.0"))
+    dup_pct = float(os.environ.get("BENCH_GOSSIP_DUP", "0.10"))
+
+    r = run_gossip_observatory(validators=validators, heights=heights,
+                               seed=seed, latency_ms=latency_ms,
+                               dup_pct=dup_pct)
+    # headline value is bytes-per-block: gossip efficiency work should
+    # push it DOWN, so bench_trend reads it with lower-is-better
+    line = {
+        "metric": "gossip_bytes_per_block",
+        "value": r["bytes_per_block"],
+        "unit": "bytes/block",
+        "lower_is_better": True,
+        "sent_bytes": r["sent_bytes"],
+        "delivered_bytes": r["delivered_bytes"],
+        "duplicate_ratio": r["duplicate_ratio"],
+        "useful_receipts": r["useful_receipts"],
+        "duplicate_receipts": r["duplicate_receipts"],
+        "rtt_links": r["rtt_links"],
+        "rtt_mean_ms": r["rtt_mean_ms"],
+        "rtt_spread_ms": r["rtt_spread_ms"],
+        "gossip_stage_vs_parts_r": r["gossip_stage_vs_parts_r"],
+        "stage_samples": r["stage_samples"],
+        "shed": r["shed"],
+        "validators": validators, "heights": heights,
+        "latency_ms": latency_ms, "dup_pct": dup_pct,
+        "wall_s": r["wall_s"],
+        "note": "host-only by design: measures the wire cost of a "
+                "committed block on the in-memory vnet with a uniform "
+                "WAN policy armed (ADR-025)",
+        "trace": _trace_artifact("gossip"),
+    }
+    _emit(line)
+    print(f"# gossip bench: vals={validators} heights={heights} "
+          f"bytes/block={r['bytes_per_block']} "
+          f"dup_ratio={r['duplicate_ratio']} "
+          f"rtt_spread_ms={r['rtt_spread_ms']} wall_s={r['wall_s']:.1f}",
+          file=sys.stderr)
+
+
 def run_propose_fastpath(sizes=(1000, 10000, 50000), tx_bytes=100,
                          reps=3) -> dict:
     """Proposer fast-path core (ADR-024; shared by BENCH_PROPOSE=1 and
@@ -1571,6 +1714,9 @@ def main():
         return
     if os.environ.get("BENCH_CONSENSUS") == "1":
         _consensus_main()
+        return
+    if os.environ.get("BENCH_GOSSIP") == "1":
+        _gossip_main()
         return
     if os.environ.get("BENCH_PROPOSE") == "1":
         _propose_main()

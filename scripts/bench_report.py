@@ -646,6 +646,27 @@ def config14_propose(sizes=(1000, 10000)):
             "block_bytes": big["block_bytes"]}
 
 
+def config15_gossip(validators=4, heights=8):
+    """Gossip observatory (p2p/netobs.py, ADR-025): the wire cost of a
+    committed block on a 4-node vnet with a uniform WAN policy armed
+    (fixed latency + duplicate probability).  Columns mirror the
+    BENCH_GOSSIP=1 bench.py line: bytes per block, duplicate-waste
+    ratio, the per-link RTT spread, and how well the gossip stage the
+    consensus observatory blames tracks the traffic netobs counted."""
+    from bench import run_gossip_observatory
+
+    r = run_gossip_observatory(validators=validators, heights=heights)
+    return {"config": f"15: gossip observatory {validators} nodes",
+            "bytes_per_block": r["bytes_per_block"],
+            "duplicate_ratio": r["duplicate_ratio"],
+            "useful_receipts": r["useful_receipts"],
+            "duplicate_receipts": r["duplicate_receipts"],
+            "rtt_mean_ms": r["rtt_mean_ms"],
+            "rtt_spread_ms": r["rtt_spread_ms"],
+            "gossip_stage_vs_parts_r": r["gossip_stage_vs_parts_r"],
+            "sent_bytes": r["sent_bytes"]}
+
+
 def main():
     import json
 
@@ -667,7 +688,7 @@ def main():
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
            config11_consensus, config12_statesync, config13_control,
-           config14_propose)
+           config14_propose, config15_gossip)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

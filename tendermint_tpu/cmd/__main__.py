@@ -469,6 +469,33 @@ def cmd_debug_device(args):
         print(json.dumps(json.loads(body), indent=2))
 
 
+def cmd_debug_net(args):
+    """Snapshot the running node's gossip observatory
+    (p2p/netobs.py, ADR-025) via its pprof listener's GET /debug/net —
+    per-peer/per-channel flow ledgers (bytes, queue wait, send/recv
+    wall, flowrate stall), per-peer RTT, and the useful/duplicate
+    receipt split the consensus state machine judged."""
+    import urllib.request
+
+    addr = _pprof_addr(args, "the gossip observatory records by "
+                             "default; TM_TPU_NETOBS=0 disables it")
+    url = f"http://{addr}/debug/net"
+    if args.node:
+        url += f"?node={args.node}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        npeers = sum(len(v) for v in (doc.get("nodes") or {}).values())
+        print(f"wrote gossip observatory report ({npeers} peer flows) "
+              f"to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
 def cmd_debug_control(args):
     """Snapshot the running node's adaptive control plane
     (libs/control.py, ADR-023) via its pprof listener's
@@ -824,6 +851,16 @@ def main(argv=None):
                     help="newest N launch records")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_device)
+    sp = sub.add_parser("debug-net",
+                        help="snapshot the node's gossip observatory "
+                             "(per-peer/per-channel flow + RTT + "
+                             "duplicate-waste accounting)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--node", default="",
+                    help="restrict to one node name (harness runs)")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_net)
     sp = sub.add_parser("debug-control",
                         help="snapshot the node's adaptive control "
                              "plane (knob values + decision ring + "

@@ -288,11 +288,12 @@ class SLOConfig:
     quantiles but no target (no burn-rate gauge)."""
     # the per-priority verify streams (ADR-016) plus the consensus
     # observatory's height-lifecycle streams (ADR-020), the device
-    # observatory's per-launch wall stream (ADR-021), and the
-    # statesync per-chunk fetch-to-applied stream (ADR-022)
+    # observatory's per-launch wall stream (ADR-021), the statesync
+    # per-chunk fetch-to-applied stream (ADR-022), and the gossip
+    # observatory's proposal -> useful-part receipt latency (ADR-025)
     STREAMS = ("consensus", "commit", "blocksync", "mempool",
                "block_interval", "propose", "quorum_prevote", "apply",
-               "device_launch", "statesync")
+               "device_launch", "statesync", "gossip")
 
     enable: bool = False
     window: int = 1024
@@ -306,6 +307,7 @@ class SLOConfig:
     apply_p99_ms: float = 0.0
     device_launch_p99_ms: float = 0.0
     statesync_p99_ms: float = 0.0
+    gossip_p99_ms: float = 0.0
     # per-stream error budgets in PERCENT of windowed requests allowed
     # over the p99 target (the burn-rate denominator; 1.0 = the p99
     # convention).  Replaces the old hardcoded _P99_BUDGET constant
@@ -319,6 +321,7 @@ class SLOConfig:
     apply_budget_pct: float = 1.0
     device_launch_budget_pct: float = 1.0
     statesync_budget_pct: float = 1.0
+    gossip_budget_pct: float = 1.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
@@ -605,6 +608,7 @@ quorum_prevote_p99_ms = {self.slo.quorum_prevote_p99_ms}
 apply_p99_ms = {self.slo.apply_p99_ms}
 device_launch_p99_ms = {self.slo.device_launch_p99_ms}
 statesync_p99_ms = {self.slo.statesync_p99_ms}
+gossip_p99_ms = {self.slo.gossip_p99_ms}
 consensus_budget_pct = {self.slo.consensus_budget_pct}
 commit_budget_pct = {self.slo.commit_budget_pct}
 blocksync_budget_pct = {self.slo.blocksync_budget_pct}
@@ -615,6 +619,7 @@ quorum_prevote_budget_pct = {self.slo.quorum_prevote_budget_pct}
 apply_budget_pct = {self.slo.apply_budget_pct}
 device_launch_budget_pct = {self.slo.device_launch_budget_pct}
 statesync_budget_pct = {self.slo.statesync_budget_pct}
+gossip_budget_pct = {self.slo.gossip_budget_pct}
 
 [control]
 enable = {str(self.control.enable).lower()}

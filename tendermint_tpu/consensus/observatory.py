@@ -134,7 +134,8 @@ class HeightRecord:
     observatory lock; reader methods take copies."""
 
     __slots__ = ("height", "wall0", "stamps", "final_round", "proposer",
-                 "parts_from", "votes_from", "info", "published",
+                 "parts_from", "votes_from", "useful_from",
+                 "first_useful", "info", "published",
                  "persist_published")
 
     def __init__(self, height: int):
@@ -145,6 +146,13 @@ class HeightRecord:
         self.proposer: Optional[str] = None
         self.parts_from: Dict[str, int] = {}
         self.votes_from: Dict[str, int] = {}
+        # the gossip observatory's join (ADR-025): receipts the state
+        # machine judged USEFUL, per peer — parts_from/votes_from above
+        # count every delivery, so useful/total is this height's
+        # duplicate-waste split per peer
+        self.useful_from: Dict[str, Dict[str, int]] = {}
+        # kind -> the peer whose delivery was useful FIRST this height
+        self.first_useful: Dict[str, str] = {}
         self.info: Dict[str, float] = {}
         self.published = False
         self.persist_published = False
@@ -168,6 +176,9 @@ class HeightRecord:
             "stages": self.stage_seconds(),
             "parts_from": dict(self.parts_from),
             "votes_from": dict(self.votes_from),
+            "useful_from": {k: dict(v)
+                            for k, v in self.useful_from.items()},
+            "first_useful": dict(self.first_useful),
             "info": dict(self.info),
         }
 
@@ -309,6 +320,33 @@ class Observatory:
                 if rec is None:
                     return
                 m = rec.parts_from if kind == "part" else rec.votes_from
+                if peer in m:
+                    m[peer] += 1
+                elif len(m) < _MAX_PEERS:
+                    m[peer] = 1
+        except Exception:  # noqa: BLE001 - shed, never propagate
+            with self._lock:
+                self._shed["chaos"] += 1
+
+    def useful_receipt(self, node: str, height: int, kind: str,
+                       peer: str):
+        """The consensus state machine's verdict side of the gossip
+        observatory join (ADR-025): a part/vote receipt that actually
+        ADVANCED this height, per peer — against receipt()'s
+        every-delivery totals this is the per-height duplicate-waste
+        split, and the first useful peer per kind is the
+        first-useful-delivery attribution.  Same update-existing-only
+        and peer-cap rules as receipt()."""
+        if not self._enabled:
+            return
+        try:
+            fail.inject("observatory.record")
+            with self._lock:
+                rec = self._record_locked(node, height, create=False)
+                if rec is None:
+                    return
+                rec.first_useful.setdefault(kind, peer)
+                m = rec.useful_from.setdefault(kind, {})
                 if peer in m:
                     m[peer] += 1
                 elif len(m) < _MAX_PEERS:
@@ -494,6 +532,13 @@ def receipt(node: str, height: int, kind: str, peer: str):
     if not o._enabled:
         return
     o.receipt(node, height, kind, peer)
+
+
+def useful_receipt(node: str, height: int, kind: str, peer: str):
+    o = OBS
+    if not o._enabled:
+        return
+    o.useful_receipt(node, height, kind, peer)
 
 
 def publish_pending():

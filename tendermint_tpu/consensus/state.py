@@ -40,6 +40,8 @@ from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.types.vote_set import (
     ConflictingVoteError, VoteSet, VoteSetError)
 
+from tendermint_tpu.p2p import netobs
+
 from . import observatory as obsv
 from .config import ConsensusConfig
 from .round_types import (
@@ -87,6 +89,9 @@ class ConsensusState(BaseService):
         # under _mtx; cleared at every height transition) — post-quorum
         # vote storms skip the observatory entirely
         self._obs_stamped: set = set()
+        # (height, monotonic proposal-accepted time) — the gossip SLO
+        # latency anchor (ADR-025); None until the first proposal
+        self._proposal_mono: Optional[tuple] = None
         self._ticker = TimeoutTicker(self._on_ticker_timeout)
         self._thread: Optional[threading.Thread] = None
         self._mtx = threading.RLock()
@@ -253,6 +258,10 @@ class ConsensusState(BaseService):
                 # consensus-critical lock (the scheduler's PR 6
                 # discipline, docs/adr/adr-020)
                 obsv.publish_pending()
+                # same hoist for the gossip observatory; the min
+                # interval amortizes the registry walk across messages
+                # (debug endpoints drain with 0 for a fresh read)
+                netobs.publish_pending(min_interval_s=0.5)
             except Exception:  # noqa: BLE001 - consensus failure is fatal
                 traceback.print_exc()
                 # reference panics with "CONSENSUS FAILURE!!!"
@@ -680,6 +689,10 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(psh)
+        # anchor for the [slo] gossip stream: useful part receipts for
+        # THIS height measure their latency from proposal acceptance
+        # (netobs.gossip_receipt below)
+        self._proposal_mono = (rs.height, time.monotonic())
         ts = proposal.timestamp
         obsv.stamp(self.name, rs.height, "proposal", round_=rs.round,
                    proposal_ts=ts.seconds + ts.nanos * 1e-9,
@@ -692,6 +705,20 @@ class ConsensusState(BaseService):
         if rs.proposal_block_parts is None:
             return
         added = rs.proposal_block_parts.add_part(msg.part)
+        if peer_id:
+            # duplicate-waste accounting (ADR-025): the part-set's
+            # verdict IS the useful/duplicate bit; useful receipts also
+            # carry the proposal -> part latency into the [slo] gossip
+            # stream and the first-useful attribution join
+            lat = None
+            if added and self._proposal_mono is not None \
+                    and self._proposal_mono[0] == rs.height:
+                lat = time.monotonic() - self._proposal_mono[1]
+            netobs.gossip_receipt(self.name, peer_id, "part",
+                                  useful=added, latency_s=lat)
+            if added:
+                obsv.useful_receipt(self.name, rs.height, "part",
+                                    peer_id)
         if not added:
             return
         if peer_id:
@@ -1010,6 +1037,14 @@ class ConsensusState(BaseService):
             return
 
         added = rs.votes.add_vote(vote, peer_id)
+        if peer_id:
+            # duplicate-waste accounting (ADR-025): own votes
+            # (peer_id="") are not gossip and stay out of the ledger
+            netobs.gossip_receipt(self.name, peer_id, "vote",
+                                  useful=added)
+            if added:
+                obsv.useful_receipt(self.name, vote.height, "vote",
+                                    peer_id)
         if not added:
             return
         if self.event_bus is not None:

@@ -134,6 +134,13 @@ LOCK_ORDER = {
     "tendermint_tpu/libs/kvdb.py:SQLiteDB._lock": 69,
     "tendermint_tpu/libs/autofile.py:Group._lock": 70,
     "tendermint_tpu/libs/flowrate.py:Monitor._lock": 72,
+    # gossip observatory table (p2p/netobs.py, ADR-025): a leaf —
+    # every recorder takes it alone (fail.inject runs BEFORE
+    # acquisition) and may be called under the vnet engine condition
+    # (15) or a consensus seam, so it must outrank both;
+    # publish_pending() releases it before touching slo (76) or the
+    # metrics locks (80/84)
+    "tendermint_tpu/p2p/netobs.py:NetObs._lock": 73,
     # consensus observatory ring (consensus/observatory.py, ADR-020):
     # a leaf — stamp()/receipt() take it alone (fail.inject runs
     # BEFORE acquisition), and publish_pending() releases it before

@@ -154,6 +154,12 @@ REGISTERED_SITES = frozenset({
     # probe-retry window and the rc=0 host-fallback line are testable
     # without a real tunnel
     "bench.probe",
+    # gossip observatory (p2p/netobs.py, ADR-025): fires on every
+    # flow/rtt/receipt recording.  raise = the sample sheds (counted
+    # in p2p_netobs_shed_total{reason=chaos}) while the frame's
+    # delivery proceeds untouched — the same contract
+    # observatory.record / devobs.record proved for their planes
+    "netobs.record",
 })
 
 # families for sites assembled at runtime ONLY (f"batch.{scheme}" in

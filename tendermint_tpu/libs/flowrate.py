@@ -23,8 +23,10 @@ class Monitor:
         self._bucket = float(limit_bytes_per_s)  # burst = 1s of tokens
         self._bucket_t = time.monotonic()
 
-    def update(self, n: int) -> None:
-        """Record n transferred bytes; blocks to enforce the limit."""
+    def update(self, n: int) -> float:
+        """Record n transferred bytes; blocks to enforce the limit.
+        Returns the seconds slept so callers can account throttle
+        stall (p2p/netobs.py) — 0.0 when the bucket had tokens."""
         sleep_for = 0.0
         with self._lock:
             self._total += n
@@ -50,6 +52,7 @@ class Monitor:
             # updates (e.g. 32 MB frames vs a 5 MB/s limit) stream faster
             # than the configured rate while the debt grows unboundedly
             time.sleep(sleep_for)
+        return sleep_for
 
     def rate(self) -> float:
         with self._lock:

@@ -668,7 +668,11 @@ class DevObsMetrics:
 
 
 class P2PMetrics:
-    """Reference p2p/metrics.go."""
+    """Reference p2p/metrics.go, extended by the gossip observatory
+    (p2p/netobs.py, ADR-025).  The byte counters and everything below
+    them are fed by netobs.publish_pending() — the per-frame recorders
+    never touch the registry (deferred-drain discipline); peer label
+    cardinality is bounded by the observatory's 128-peer cap."""
 
     def __init__(self, reg: Optional[Registry] = None):
         reg = reg or DEFAULT
@@ -677,6 +681,49 @@ class P2PMetrics:
                                       "Bytes sent.", labels=("ch_id",))
         self.bytes_recv = reg.counter("p2p", "message_receive_bytes_total",
                                       "Bytes received.", labels=("ch_id",))
+        self.queue_wait = reg.histogram(
+            "p2p", "channel_queue_wait_seconds",
+            "Send-queue wait per frame (enqueue -> wire) by channel — "
+            "how long a frame sat behind its channel's priority before "
+            "the send routine picked it.",
+            labels=("ch_id",),
+            buckets=[.0001, .0005, .001, .005, .01, .05, .1, .5, 1, 5])
+        self.queue_depth = reg.gauge(
+            "p2p", "channel_queue_depth",
+            "Last observed send-queue depth by channel (max across "
+            "peers at the most recent netobs drain).", labels=("ch_id",))
+        self.peer_flow = reg.gauge(
+            "p2p", "peer_flow_bytes_per_s",
+            "Per-peer goodput over the last netobs drain interval "
+            "(byte-ledger delta / elapsed).",
+            labels=("peer", "direction"))
+        self.flow_rate = reg.gauge(
+            "p2p", "flow_rate_bytes_per_s",
+            "Flowrate Monitor EMA rate per peer (the token-bucket "
+            "limiter's own view; reference flowrate.Status.CurRate).",
+            labels=("peer", "direction"))
+        self.peer_rtt = reg.gauge(
+            "p2p", "peer_rtt_seconds",
+            "Most recent ping->pong round-trip per peer.",
+            labels=("peer",))
+        self.throttle_stall = reg.counter(
+            "p2p", "throttle_stall_seconds_total",
+            "Seconds the send/recv routines slept in the flowrate "
+            "token bucket — a bandwidth-capped link shows up here "
+            "instead of as unexplained queue wait.",
+            labels=("direction",))
+        self.gossip_receipts = reg.counter(
+            "p2p", "gossip_receipts_total",
+            "Consensus gossip receipts by the state machine's verdict "
+            "(outcome=useful advanced the height; outcome=duplicate "
+            "was redundant gossip — pure wasted bytes).",
+            labels=("kind", "outcome"))
+        self.netobs_shed = reg.counter(
+            "p2p", "netobs_shed_total",
+            "Gossip-observatory samples shed (reason=chaos: a "
+            "recording fault was swallowed, delivery proceeded; "
+            "reason=evict: peer/channel/sample-queue cap overflow).",
+            labels=("reason",))
 
 
 class NetMetrics:

@@ -38,6 +38,10 @@ image) and with near-zero overhead when idle:
                                vs static value and safe range, the
                                bounded decision ring, and the
                                kill-switch state
+  GET /debug/net?node=NAME     gossip observatory (p2p/netobs.py,
+                               ADR-025): per-peer/per-channel flow
+                               ledgers, queue wait, flowrate stall,
+                               RTT, duplicate-waste accounting
   GET /debug                   index: every registered debug endpoint
                                with a one-line description, so
                                operators stop guessing URLs
@@ -93,6 +97,9 @@ DEBUG_ENDPOINTS = (
     ("/debug/control",
      "adaptive control plane: knob values, decision ring, kill state "
      "(ADR-023)"),
+    ("/debug/net?node=NAME",
+     "gossip observatory: per-peer/per-channel flow, queue wait, "
+     "stall, RTT, duplicate-waste accounting (ADR-025)"),
 )
 
 
@@ -257,6 +264,20 @@ class _Handler(BaseHTTPRequestHandler):
                     "last_lane_report": _cbatch.last_lane_report(),
                 }
                 self._send(200, json.dumps(body, default=str),
+                           ctype="application/json")
+            elif url.path == "/debug/net":
+                # the gossip observatory (ADR-025): per-peer/
+                # per-channel flow ledgers, queue wait, flowrate stall,
+                # RTT and the useful/duplicate receipt split.  Reading
+                # flushes deferred publication so /metrics agrees with
+                # the JSON.  Lazy import: the pprof listener must stay
+                # importable without the p2p stack
+                from tendermint_tpu.p2p import netobs
+                q = parse_qs(url.query)
+                node = q.get("node", [None])[0]
+                netobs.publish_pending()
+                self._send(200, json.dumps(netobs.report(node),
+                                           default=str),
                            ctype="application/json")
             elif url.path == "/debug/control":
                 # the adaptive control plane (ADR-023): every governed

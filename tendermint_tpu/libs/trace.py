@@ -72,6 +72,8 @@ KNOWN_SPANS = frozenset({
     "lanepool.verify",
     # networks/ — the in-process multi-node harness (ADR-019)
     "harness.scenario", "harness.step", "vnet.deliver",
+    # p2p/netobs.py — the gossip observatory's deferred drain (ADR-025)
+    "netobs.drain",
     # mempool/ingress.py — overload-safe admission (ADR-018)
     "ingress.admit", "ingress.batch", "ingress.checktx",
     "ingress.recheck",

@@ -336,6 +336,13 @@ class NetHarness:
         self._obs_was_enabled = obsv.is_enabled()
         obsv.reset()
         obsv.enable()
+        # same contract for the gossip observatory (ADR-025): the
+        # failure artifact's per-link gossip table and BENCH_GOSSIP
+        # read its flow ledgers, so reset + force-enable per run
+        from tendermint_tpu.p2p import netobs
+        self._netobs_was_enabled = netobs.is_enabled()
+        netobs.reset()
+        netobs.enable()
         self.net.start()
         for hn in self.nodes:
             hn.start()
@@ -364,6 +371,9 @@ class NetHarness:
         if not getattr(self, "_obs_was_enabled", True):
             from tendermint_tpu.consensus import observatory as obsv
             obsv.disable()
+        if not getattr(self, "_netobs_was_enabled", True):
+            from tendermint_tpu.p2p import netobs
+            netobs.disable()
 
     def running_nodes(self) -> List[HarnessNode]:
         return [hn for hn in self.nodes if hn.running]
@@ -1030,6 +1040,69 @@ class NetHarness:
         finally:
             h.stop()
 
+    def gossip_table(self) -> dict:
+        """The per-link gossip table (ADR-025): for every directed
+        link src->dst, the gossip observatory's two ledgers (the
+        sender's sent view, the receiver's delivered view + the
+        consensus duplicate-waste verdicts) JOINed with the armed vnet
+        LinkPolicy.  Node keys are canonical harness names — netobs
+        records under vnet addresses (transport seam) AND under
+        monikers/node ids (consensus seam), and both fold here."""
+        from tendermint_tpu.p2p import netobs
+        netobs.publish_pending()
+        table = netobs.flow_table()
+        policies = self.net.policy_matrix()
+        to_name = {}
+        to_addr = {}
+        for hn in self.nodes:
+            to_name[hn.addr] = hn.name
+            to_name[hn.name] = hn.name
+            to_name[hn.node_key.node_id] = hn.name
+            to_addr[hn.name] = hn.addr
+        links: Dict[str, dict] = {}
+
+        def link_row(src: str, dst: str) -> dict:
+            key = f"{src}->{dst}"
+            row = links.get(key)
+            if row is None:
+                pkey = f"{to_addr.get(src, src)}->{to_addr.get(dst, dst)}"
+                row = links[key] = {
+                    "policy": policies.get(pkey, policies["default"]),
+                    "sent_bytes": 0, "sent_msgs": 0,
+                    "delivered_bytes": 0, "delivered_msgs": 0,
+                    "queue_wait_s": 0.0, "stall_send_s": 0.0,
+                    "rtt": None,
+                    "useful_parts": 0, "dup_parts": 0,
+                    "useful_votes": 0, "dup_votes": 0,
+                }
+            return row
+
+        for node, peers in table.items():
+            nname = to_name.get(node, node)
+            for peer, flow in peers.items():
+                pname = to_name.get(peer, peer)
+                # the node's SENT ledger describes the node->peer link
+                out_row = link_row(nname, pname)
+                for cf in flow["channels"].values():
+                    out_row["sent_bytes"] += cf["sent_bytes"]
+                    out_row["sent_msgs"] += cf["sent_msgs"]
+                    out_row["queue_wait_s"] += cf["queue_wait_s"]
+                out_row["stall_send_s"] += flow["stall_send_s"]
+                if flow["rtt"] is not None:
+                    out_row["rtt"] = flow["rtt"]
+                # its RECV ledger and the consensus verdicts describe
+                # the peer->node link
+                in_row = link_row(pname, nname)
+                for cf in flow["channels"].values():
+                    in_row["delivered_bytes"] += cf["recv_bytes"]
+                    in_row["delivered_msgs"] += cf["recv_msgs"]
+                in_row["useful_parts"] += flow["useful_parts"]
+                in_row["dup_parts"] += flow["dup_parts"]
+                in_row["useful_votes"] += flow["useful_votes"]
+                in_row["dup_votes"] += flow["dup_votes"]
+        return {"links": dict(sorted(links.items())),
+                "shed": netobs.NOBS.shed_counts()}
+
     def _dump_artifact(self, name: str, steps_log: List[dict],
                        error: str) -> dict:
         nodes_summary = [{
@@ -1039,8 +1112,13 @@ class NetHarness:
                       if hn.node is not None else 0),
         } for hn in self.nodes]
         try:
+            gossip = self.gossip_table()
+        except Exception:  # noqa: BLE001 - the join is best-effort
+            gossip = {}
+        try:
             return export_artifact(
                 self.workdir, name, self.seed, steps_log, self.watcher,
-                nodes_summary, self.net.decisions(), error=error)
+                nodes_summary, self.net.decisions(), error=error,
+                gossip=gossip)
         except Exception:  # noqa: BLE001 - artifact write must not mask
             return {}       # the scenario failure itself

@@ -149,7 +149,8 @@ def committed_evidence(node, since_height: int = 1) -> list:
 def export_artifact(workdir: str, scenario: str, seed: int,
                     steps_log: List[dict], watcher: ChainWatcher,
                     nodes_summary: List[dict], decisions: list,
-                    error: Optional[str] = None) -> dict:
+                    error: Optional[str] = None,
+                    gossip: Optional[dict] = None) -> dict:
     """Stitch the run into replay artifacts.  Returns the paths dict;
     the JSON timeline is always written, the Chrome-trace span dump
     only when the flight recorder is enabled.
@@ -160,7 +161,10 @@ def export_artifact(workdir: str, scenario: str, seed: int,
     store-height polling PR 11 shipped — together with the cross-node
     skew report (the same height's stamps compared across nodes: how
     far apart did the proposal land, the parts complete, the commit
-    fire)."""
+    fire).  `gossip` is the harness's per-link gossip table (ADR-025):
+    the gossip observatory's flow/RTT ledgers JOINed with the vnet
+    LinkPolicy matrix per directed link — read next to "skew" to
+    attribute a slow stage to the link that caused it."""
     from tendermint_tpu.consensus import observatory as obsv
 
     os.makedirs(workdir, exist_ok=True)
@@ -182,6 +186,9 @@ def export_artifact(workdir: str, scenario: str, seed: int,
         # the replayable fault schedule: (src, dst, link msg idx,
         # channel, size, verdict, delay_us)
         "vnet_decisions": [list(d) for d in decisions],
+        # per-link WAN attribution (ADR-025): netobs flow/RTT x
+        # LinkPolicy per directed link
+        "gossip": gossip or {},
     }
     with open(timeline_path, "w") as f:
         json.dump(payload, f, default=str)

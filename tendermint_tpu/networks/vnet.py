@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from tendermint_tpu.libs import fail, trace
 from tendermint_tpu.libs.metrics import NetMetrics
+from tendermint_tpu.p2p import netobs
 
 SEND_TIMEOUT_S = 10.0       # blocking-send park bound (MConnection parity)
 DEFAULT_CAPACITY = 100      # per-channel in-flight cap without a descriptor
@@ -211,9 +212,16 @@ class VirtualNetwork:
 
     def __init__(self, seed: int = 0, metrics_registry=None,
                  record_decisions: bool = True,
-                 default_policy: Optional[LinkPolicy] = None):
+                 default_policy: Optional[LinkPolicy] = None,
+                 ping_interval_s: float = 0.5):
         self.seed = seed
         self.metrics = NetMetrics(metrics_registry)
+        # control-plane RTT pinger cadence (0 disables): pings ride the
+        # delivery heap directly — they bypass _submit, consume NO link
+        # RNG rolls and record NO decisions, so the seed-replay schedule
+        # is byte-identical with the pinger on or off
+        self.ping_interval_s = ping_interval_s
+        self._next_ping = 0.0
         self._cond = threading.Condition()
         self._endpoints: Dict[str, _Endpoint] = {}
         self._policies: Dict[Tuple[str, str], LinkPolicy] = {}
@@ -468,6 +476,18 @@ class VirtualNetwork:
         per-link send sequences."""
         return list(self._decisions or ())
 
+    def policy_matrix(self) -> dict:
+        """The armed LinkPolicy per directed link plus the default —
+        the JOIN key the harness artifact pairs with the gossip
+        observatory's per-link flow table (ADR-025)."""
+        def as_dict(p: LinkPolicy) -> dict:
+            return {f.name: getattr(p, f.name) for f in fields(p)}
+        with self._cond:
+            out = {"default": as_dict(self._default)}
+            for (src, dst), pol in sorted(self._policies.items()):
+                out[f"{src}->{dst}"] = as_dict(pol)
+        return out
+
     def _forget(self, conn: VirtualConnection):
         """Drop a dead connection from its endpoint's live set (stop()
         and _fail() both route here, so a conn that died via remote
@@ -497,8 +517,10 @@ class VirtualNetwork:
                 for _ in range(4):
                     rng.random()
             self._drop(key, idx, ch_id, len(msg), "chaos")
+            netobs.sent(key[0], key[1], ch_id, len(msg))
             return True
-        deadline = time.monotonic() + SEND_TIMEOUT_S
+        t_submit = time.monotonic()
+        deadline = t_submit + SEND_TIMEOUT_S
         with self._cond:
             # index assignment and EVERY rng draw happen atomically
             # here, before anything can release the condition: message
@@ -514,11 +536,16 @@ class VirtualNetwork:
                 rng.random(), rng.random(), rng.random(), rng.random())
             if policy.down or self._cut_locked(*key):
                 # a partitioned link swallows frames silently (TCP into
-                # the void); the sender keeps believing it queued them
+                # the void); the sender keeps believing it queued them —
+                # so the sender's netobs ledger counts them too (the
+                # reconciliation rule: sent = every decision the sender
+                # saw succeed, i.e. everything but backpressure)
                 self._drop(key, idx, ch_id, len(msg), "partition")
+                netobs.sent(key[0], key[1], ch_id, len(msg))
                 return True
             if policy.drop > 0.0 and drop_roll < policy.drop:
                 self._drop(key, idx, ch_id, len(msg), "loss")
+                netobs.sent(key[0], key[1], ch_id, len(msg))
                 return True
             copies = 2 if (policy.dup > 0.0
                            and dup_roll < policy.dup) else 1
@@ -558,6 +585,7 @@ class VirtualNetwork:
             if reorder_hit:
                 delay += policy.reorder_window_s
             conn.pending[ch_id] = conn.pending.get(ch_id, 0) + copies
+            depth = conn.pending[ch_id]
             last_due = now + delay + (copies - 1) * 1e-4
             self._link_last_due[key] = max(
                 self._link_last_due.get(key, 0.0), last_due)
@@ -570,6 +598,10 @@ class VirtualNetwork:
         verdict = "deliver" + ("+dup" if copies == 2 else "") \
             + ("+reorder" if reorder_hit else "")
         self._record(key, idx, ch_id, len(msg), verdict, delay)
+        # queue wait here is the backpressure park (submit -> scheduled),
+        # the vnet analog of MConnection's enqueue -> wire wait
+        netobs.sent(key[0], key[1], ch_id, len(msg),
+                    queue_wait_s=now - t_submit, depth=depth)
         return True
 
     def _conn_closed(self, conn: VirtualConnection):
@@ -594,6 +626,28 @@ class VirtualNetwork:
 
     # -- delivery threads --------------------------------------------------
 
+    # control-plane heap markers (ch_id < 0; FIN is -1).  Pings carry
+    # their departure time as the msg slot and never touch _submit, the
+    # per-link RNG, or the decision log — the observatory must not
+    # perturb the schedule it is attributing (ADR-025)
+    _PING = -2
+    _PONG = -3
+
+    def _schedule_pings_locked(self, now: float):
+        self._next_ping = now + self.ping_interval_s
+        for ep in self._endpoints.values():
+            for conn in list(ep.conns):
+                if conn.closed():
+                    continue
+                key = (conn.src.addr, conn.dst.addr)
+                pol = self._policies.get(key, self._default)
+                # a dead link gets no RTT sample, not an inflated one
+                if pol.down or self._cut_locked(*key):
+                    continue
+                heapq.heappush(self._heap,
+                               (now + pol.latency_s, next(self._seq),
+                                conn, self._PING, now))
+
     def _timer_routine(self):
         while True:
             batch = []
@@ -601,6 +655,8 @@ class VirtualNetwork:
                 if not self._running:
                     return
                 now = time.monotonic()
+                if self.ping_interval_s > 0 and now >= self._next_ping:
+                    self._schedule_pings_locked(now)
                 while self._heap and self._heap[0][0] <= now:
                     batch.append(heapq.heappop(self._heap))
                 if not batch:
@@ -610,7 +666,22 @@ class VirtualNetwork:
                     self._cond.wait(max(timeout, 0.0005))
                     continue
             for _due, _seq, conn, ch_id, msg in batch:
-                if ch_id < 0:
+                if ch_id == self._PING:
+                    # the ping reached dst; bounce the pong back over
+                    # the reverse link's latency
+                    rkey = (conn.dst.addr, conn.src.addr)
+                    with self._cond:
+                        pol = self._policies.get(rkey, self._default)
+                        if pol.down or self._cut_locked(*rkey):
+                            continue
+                        heapq.heappush(
+                            self._heap,
+                            (time.monotonic() + pol.latency_s,
+                             next(self._seq), conn, self._PONG, msg))
+                elif ch_id == self._PONG:
+                    netobs.rtt(conn.src.addr, conn.dst.addr,
+                               time.monotonic() - msg)
+                elif ch_id < 0:
                     remote = conn.remote
                     if remote is not None:
                         conn.dst.push(
@@ -638,6 +709,7 @@ class VirtualNetwork:
                 continue
             _, conn, ch_id, msg = item
             remote = conn.remote
+            t0 = time.monotonic()
             with trace.span("vnet.deliver", src=conn.src.addr,
                             dst=conn.dst.addr, ch=ch_id, size=len(msg)):
                 try:
@@ -645,6 +717,10 @@ class VirtualNetwork:
                         remote._deliver(ch_id, msg)
                 except Exception:  # noqa: BLE001 - receiver errors are
                     pass           # the switch's job, not the network's
+            # the receiver's ledger: node = destination address, peer =
+            # the sending address; wall is the on_receive dispatch cost
+            netobs.recv(conn.dst.addr, conn.src.addr, ch_id, len(msg),
+                        wall_s=time.monotonic() - t0)
             with self._cond:
                 conn.pending[ch_id] = max(
                     0, conn.pending.get(ch_id, 0) - 1)

@@ -4,6 +4,19 @@ N logical channels over one SecretConnection; per-channel priority send
 queues drained by one send thread (most-behind-by-priority scheduling, the
 reference's recently-sent EMA policy in spirit); one recv thread dispatches
 to the owner's on_receive.  Ping/pong keepalive with timeout.
+
+Timekeeping is an injectable MONOTONIC clock: the pong deadline and the
+RTT sample must not move when NTP steps the wall clock — a backward step
+under the old time.time() arithmetic could suppress the 45 s pong
+timeout indefinitely, a forward step could fire it spuriously
+(ADR-025 satellite).
+
+When the gossip observatory (p2p/netobs.py) is enabled and the Switch
+threaded identity labels through (obs_node/obs_peer), the routines feed
+it: per-channel queue wait (enqueue -> wire), serialize/send wall,
+flowrate stall, recv dispatch wall, ping RTT and the Monitor EMA rates.
+Recording is fire-and-forget — netobs sheds internally and never raises
+into the send/recv path.
 """
 from __future__ import annotations
 
@@ -16,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.libs.flowrate import Monitor
 
+from . import netobs
 from .secret_connection import SecretConnection
 
 _MSG = 0x01
@@ -46,18 +60,27 @@ class MConnection:
                  on_receive: Callable[[int, bytes], None],
                  on_error: Callable[[Exception], None],
                  send_rate: int = DEFAULT_SEND_RATE,
-                 recv_rate: int = DEFAULT_RECV_RATE):
+                 recv_rate: int = DEFAULT_RECV_RATE,
+                 obs_node: str = "",
+                 obs_peer: str = "",
+                 clock: Callable[[], float] = time.monotonic):
         self.conn = conn
         self.send_monitor = Monitor(send_rate)
         self.recv_monitor = Monitor(recv_rate)
         self.on_receive = on_receive
         self.on_error = on_error
         self._chans: Dict[int, ChannelDescriptor] = {c.id: c for c in channels}
-        self._queues: Dict[int, "queue.Queue[bytes]"] = {
+        # queue items are (enqueue_t, msg): the send routine charges the
+        # gossip observatory with the enqueue -> wire wait per channel
+        self._queues: Dict[int, "queue.Queue[tuple]"] = {
             c.id: queue.Queue(maxsize=c.send_queue_capacity) for c in channels}
         self._send_event = threading.Event()
         self._stop = threading.Event()
-        self._last_pong = time.time()
+        self._clock = clock
+        self._last_pong = clock()
+        self._ping_sent_t: Optional[float] = None
+        self._obs_node = obs_node
+        self._obs_peer = obs_peer
         self._threads: List[threading.Thread] = []
 
     def start(self):
@@ -83,7 +106,8 @@ class MConnection:
         if q is None:
             raise ValueError(f"unknown channel {ch_id:#x}")
         try:
-            q.put(msg, block=block, timeout=10 if block else None)
+            q.put((self._clock(), msg),
+                  block=block, timeout=10 if block else None)
         except queue.Full:
             return False
         self._send_event.set()
@@ -105,7 +129,8 @@ class MConnection:
         if best is None:
             return None
         try:
-            return best[1], best[2].get_nowait()
+            enq_t, msg = best[2].get_nowait()
+            return best[1], enq_t, msg, best[2].qsize()
         except queue.Empty:
             return None
 
@@ -117,9 +142,15 @@ class MConnection:
                     self._send_event.wait(timeout=0.1)
                     self._send_event.clear()
                     continue
-                cid, msg = item
+                cid, enq_t, msg, depth = item
+                t0 = self._clock()
                 self.conn.send_frame(bytes([_MSG, cid]) + msg)
-                self.send_monitor.update(len(msg) + 2)
+                wall = self._clock() - t0
+                stall = self.send_monitor.update(len(msg) + 2)
+                if self._obs_node:
+                    netobs.sent(self._obs_node, self._obs_peer, cid,
+                                len(msg) + 2, queue_wait_s=t0 - enq_t,
+                                wall_s=wall, stall_s=stall, depth=depth)
         except Exception as e:  # noqa: BLE001
             self._fail(e)
 
@@ -129,16 +160,27 @@ class MConnection:
                 frame = self.conn.recv_frame()
                 if not frame:
                     continue
-                self.recv_monitor.update(len(frame))
+                stall = self.recv_monitor.update(len(frame))
                 kind = frame[0]
                 if kind == _PING:
                     self.conn.send_frame(bytes([_PONG]))
                 elif kind == _PONG:
-                    self._last_pong = time.time()
+                    now = self._clock()
+                    self._last_pong = now
+                    sent_t, self._ping_sent_t = self._ping_sent_t, None
+                    if sent_t is not None and self._obs_node:
+                        netobs.rtt(self._obs_node, self._obs_peer,
+                                   now - sent_t)
                 elif kind == _MSG:
                     if len(frame) < 2 or len(frame) > MAX_MSG_SIZE:
                         raise ValueError("bad mconn frame")
+                    t0 = self._clock()
                     self.on_receive(frame[1], frame[2:])
+                    if self._obs_node:
+                        netobs.recv(self._obs_node, self._obs_peer,
+                                    frame[1], len(frame),
+                                    wall_s=self._clock() - t0,
+                                    stall_s=stall)
                 else:
                     raise ValueError(f"unknown frame kind {kind}")
         except Exception as e:  # noqa: BLE001
@@ -150,8 +192,13 @@ class MConnection:
                 time.sleep(PING_INTERVAL)
                 if self._stop.is_set():
                     return
+                self._ping_sent_t = self._clock()
                 self.conn.send_frame(bytes([_PING]))
-                if time.time() - self._last_pong > PONG_TIMEOUT:
+                if self._obs_node:
+                    netobs.flow_rate(self._obs_node, self._obs_peer,
+                                     send_bps=self.send_monitor.rate(),
+                                     recv_bps=self.recv_monitor.rate())
+                if self._clock() - self._last_pong > PONG_TIMEOUT:
                     raise TimeoutError("pong timeout")
         except Exception as e:  # noqa: BLE001
             self._fail(e)

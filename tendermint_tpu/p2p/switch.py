@@ -361,9 +361,14 @@ class Switch(BaseService):
             raise ValueError("node id does not match secret-connection key")
 
         def make_conn(on_receive, on_error):
+            # gossip-observatory identity: the local moniker names the
+            # node (multi-node in-process harnesses share the module
+            # global), the remote node id names the peer (ADR-025)
             return MConnection(sconn, self._descriptors, on_receive,
                                on_error, send_rate=self._send_rate,
-                               recv_rate=self._recv_rate)
+                               recv_rate=self._recv_rate,
+                               obs_node=self.moniker or self.node_key.node_id,
+                               obs_peer=their_info.node_id)
         return self._register_peer(their_info, make_conn, outbound,
                                    persistent)
 

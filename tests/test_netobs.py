@@ -1,0 +1,481 @@
+"""Gossip observatory (p2p/netobs.py, docs/adr/adr-025-gossip-observatory.md):
+per-peer/per-channel flow telemetry, duplicate-waste accounting and
+per-link WAN attribution.
+
+Tier-1 carries the acceptance gates:
+
+  * exact byte reconciliation — the netobs sent/recv ledgers against
+    the vnet's replayable decision schedule (sent = every verdict but
+    backpressure; recv = deliver* sizes x copies);
+  * RTT attribution — the vnet control-plane pinger's samples against
+    the armed LinkPolicy latency, and the MConnection ping/pong RTT
+    against an injected clock;
+  * duplicate-waste accounting — useful vs duplicate receipts through
+    the consensus seam, on a real 4-node NetHarness with a `dup`
+    policy armed, reconciled against /debug/net, /metrics and the
+    harness artifact gossip table;
+  * the house observability discipline — chaos at `netobs.record`
+    sheds samples without touching delivery, and the disabled path
+    stays sub-microsecond (the same gate observatory/devobs carry).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import timeit
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.libs import fail, metrics
+from tendermint_tpu.networks.harness import NetHarness
+from tendermint_tpu.networks.vnet import VirtualNetwork
+from tendermint_tpu.p2p import connection as mconn
+from tendermint_tpu.p2p import netobs, wire
+from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+
+CH = 0x7C
+
+
+def _codec():
+    try:
+        wire.register_codec(CH, lambda m: m, lambda b: b)
+    except ValueError:
+        pass  # already registered by an earlier test in this process
+
+
+@pytest.fixture(autouse=True)
+def _fresh_netobs():
+    netobs.reset()
+    netobs.enable()
+    yield
+    netobs.reset()
+    fail.clear()
+
+
+def _chans(cap=100):
+    return [ChannelDescriptor(CH, priority=1, send_queue_capacity=cap)]
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# exact byte reconciliation against the vnet decision schedule
+# ---------------------------------------------------------------------------
+
+def test_vnet_bytes_reconcile_exactly_with_decision_schedule():
+    """The acceptance property: for every directed link, the netobs
+    sent ledger equals the sum of decision sizes for every verdict but
+    backpressure (the sender's view: a lossy/partitioned link still
+    swallowed the frame), and the recv ledger equals the deliver*
+    sizes times copies (a +dup verdict delivers twice)."""
+    net = VirtualNetwork(seed=41).start()
+    got = []
+    try:
+        a, _b = net.connect_raw("nra", "nrb", _chans(cap=10_000),
+                                on_b=lambda c, m: got.append(m))
+        net.set_link("nra", "nrb", drop=0.25, dup=0.25,
+                     latency_s=0.001)
+        n, size = 300, 4
+        for i in range(n):
+            assert a.send(CH, b"m%03d" % i)
+
+        decisions = [d for d in net.decisions()
+                     if (d[0], d[1]) == ("nra", "nrb")]
+        assert len(decisions) == n  # blocking sends: none refused
+        exp_sent = sum(d[4] for d in decisions
+                       if d[5] != "drop:backpressure")
+        exp_recv = sum(d[4] * (2 if "+dup" in d[5] else 1)
+                       for d in decisions if d[5].startswith("deliver"))
+        assert exp_sent == n * size
+        assert exp_recv > 0
+        # drain: every scheduled delivery dispatched
+        assert _wait(lambda: len(got) * size == exp_recv), \
+            f"delivered {len(got) * size}, schedule says {exp_recv}"
+
+        flow = netobs.flow_table()
+        assert flow["nra"]["nrb"]["sent_bytes"] == exp_sent
+        assert _wait(lambda: netobs.flow_table()
+                     ["nrb"]["nra"]["recv_bytes"] == exp_recv)
+        # the drop verdicts are the sent-minus-delivered gap
+        assert exp_sent - sum(
+            d[4] for d in decisions
+            if d[5].startswith("deliver")) == sum(
+            d[4] for d in decisions if d[5].startswith("drop:"))
+    finally:
+        net.stop()
+
+
+def test_vnet_rtt_tracks_injected_link_latency():
+    """The control-plane pinger: RTT samples on a link with a fixed
+    one-way latency armed both ways must straddle 2x that latency
+    (never below — the vnet cannot deliver early) within a scheduling
+    tolerance, and must consume no link RNG (the decision schedule
+    stays ping-free)."""
+    net = VirtualNetwork(seed=43, ping_interval_s=0.1).start()
+    try:
+        net.connect_raw("rta", "rtb", _chans())
+        lat = 0.02
+        net.set_link("rta", "rtb", latency_s=lat)
+        net.set_link("rtb", "rta", latency_s=lat)
+        assert _wait(lambda: (netobs.flow_table().get("rta", {})
+                              .get("rtb", {}).get("rtt") or {})
+                     .get("n", 0) >= 2, timeout=15.0)
+        rtt = netobs.flow_table()["rta"]["rtb"]["rtt"]
+        assert rtt["min_s"] >= 2 * lat
+        assert rtt["mean_s"] < 2 * lat + 0.25  # scheduling tolerance
+        assert net.decisions() == []  # pings never touch the schedule
+    finally:
+        net.stop()
+
+
+# ---------------------------------------------------------------------------
+# duplicate-waste accounting (consensus seam, unit level)
+# ---------------------------------------------------------------------------
+
+def test_gossip_receipt_accounting_and_flow_rate():
+    netobs.gossip_receipt("n", "p1", "part", useful=True,
+                          latency_s=0.01)
+    netobs.gossip_receipt("n", "p2", "part", useful=False)
+    netobs.gossip_receipt("n", "p1", "vote", useful=True)
+    netobs.flow_rate("n", "p1", send_bps=5.0, recv_bps=7.0)
+    flow = netobs.flow_table("n")["n"]
+    assert flow["p1"]["useful_parts"] == 1
+    assert flow["p1"]["useful_votes"] == 1
+    assert flow["p2"]["dup_parts"] == 1
+    assert flow["p1"]["rate_send_bps"] == 5.0
+    assert flow["p1"]["rate_recv_bps"] == 7.0
+    rep = netobs.report("n")
+    assert rep["totals"]["useful_receipts"] == 2
+    assert rep["totals"]["duplicate_receipts"] == 1
+    assert rep["totals"]["duplicate_ratio"] == round(1 / 3, 4)
+
+
+def test_observatory_first_useful_delivery_attribution():
+    """The JOIN with the consensus observatory (ADR-020): useful
+    receipts land on the EXISTING height record only (no remote-
+    controlled record creation) and pin the first-useful peer."""
+    from tendermint_tpu.consensus.observatory import Observatory
+
+    o = Observatory(enabled=True)
+    o.stamp("n", 5, "new_height")
+    o.useful_receipt("n", 5, "part", "peerX")
+    o.useful_receipt("n", 5, "part", "peerY")
+    o.useful_receipt("n", 5, "vote", "peerY")
+    rec = o.records("n")[0]
+    assert rec["first_useful"] == {"part": "peerX", "vote": "peerY"}
+    assert rec["useful_from"] == {"part": {"peerX": 1, "peerY": 1},
+                                  "vote": {"peerY": 1}}
+    o.useful_receipt("n", 99, "part", "peerZ")  # unknown height
+    assert [r["height"] for r in o.records("n")] == [5]
+
+
+# ---------------------------------------------------------------------------
+# metrics funnel (satellite: bytes_sent / bytes_recv finally move)
+# ---------------------------------------------------------------------------
+
+def _scrape_value(text: str, needle: str) -> float:
+    for ln in text.splitlines():
+        if ln.startswith(needle):
+            return float(ln.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def test_metrics_scrape_byte_counters_move():
+    send_k = 'tendermint_p2p_message_send_bytes_total{ch_id="0x7c"}'
+    recv_k = 'tendermint_p2p_message_receive_bytes_total{ch_id="0x7c"}'
+    before_s = _scrape_value(metrics.DEFAULT.render_text(), send_k)
+    before_r = _scrape_value(metrics.DEFAULT.render_text(), recv_k)
+    netobs.sent("m1", "p", CH, 111, queue_wait_s=0.001, depth=3)
+    netobs.recv("m1", "p", CH, 222)
+    netobs.publish_pending()
+    text = metrics.DEFAULT.render_text()
+    assert _scrape_value(text, send_k) == before_s + 111
+    assert _scrape_value(text, recv_k) == before_r + 222
+    assert 'tendermint_p2p_channel_queue_depth{ch_id="0x7c"} 3' in text
+    # publishing twice without new traffic must not double-count
+    netobs.publish_pending()
+    assert _scrape_value(metrics.DEFAULT.render_text(),
+                         send_k) == before_s + 111
+
+
+# ---------------------------------------------------------------------------
+# chaos: recording faults shed, delivery untouched
+# ---------------------------------------------------------------------------
+
+def test_chaos_netobs_record_sheds_without_touching_delivery():
+    net = VirtualNetwork(seed=11).start()
+    got = []
+    try:
+        a, _b = net.connect_raw("cha", "chb", _chans(),
+                                on_b=lambda c, m: got.append(m))
+        fail.set_mode("netobs.record", "raise")
+        try:
+            for _ in range(5):
+                assert a.send(CH, b"keep!")
+            assert _wait(lambda: len(got) == 5), \
+                "chaos at netobs.record must not drop deliveries"
+            assert fail.fired("netobs.record", "raise") >= 1
+            assert netobs.NOBS.shed_counts()["chaos"] >= 1
+            # every sample shed: the ledger saw nothing
+            assert netobs.flow_table().get("cha", {}) \
+                                      .get("chb", {}) \
+                                      .get("sent_bytes", 0) == 0
+        finally:
+            fail.clear("netobs.record")
+        # latency at the same site: the sample is merely late — the
+        # frame still arrives and is still counted
+        fail.set_mode("netobs.record", "latency:20")
+        try:
+            assert a.send(CH, b"after")
+            assert _wait(lambda: len(got) == 6)
+            assert _wait(lambda: netobs.flow_table()
+                         ["cha"]["chb"]["sent_bytes"] == 5)
+            assert fail.fired("netobs.record", "latency:20") >= 1
+        finally:
+            fail.clear("netobs.record")
+    finally:
+        net.stop()
+
+
+def test_disabled_is_noop_and_sub_microsecond():
+    """netobs sits on the MConnection send/recv routines and the vnet
+    delivery engine unconditionally, so the disabled path must stay
+    sub-microsecond — the same gate observatory/devobs/trace carry.
+    min-of-repeats dodges CI load spikes."""
+    netobs.disable()
+    try:
+        netobs.sent("n", "p", CH, 100)
+        netobs.recv("n", "p", CH, 100)
+        netobs.rtt("n", "p", 0.01)
+        assert netobs.flow_table() == {}
+
+        n = 20000
+
+        def site_sent():
+            netobs.sent("n", "p", CH, 100, queue_wait_s=0.001)
+
+        per_call = min(timeit.repeat(site_sent, number=n, repeat=5)) / n
+        assert per_call < 1e-6, f"disabled sent cost {per_call:.2e}s"
+
+        def site_recv():
+            netobs.recv("n", "p", CH, 100)
+
+        per_call = min(timeit.repeat(site_recv, number=n, repeat=5)) / n
+        assert per_call < 1e-6, f"disabled recv cost {per_call:.2e}s"
+    finally:
+        netobs.enable()
+
+
+# ---------------------------------------------------------------------------
+# MConnection: monotonic keepalive clock + RTT (satellite regression)
+# ---------------------------------------------------------------------------
+
+class _FakeSecret:
+    """Duck-typed SecretConnection: scripted inbound frames, captured
+    outbound frames."""
+
+    def __init__(self):
+        self.sent = []
+        self._inbox: "queue.Queue" = queue.Queue()
+        self.closed = False
+
+    def send_frame(self, frame):
+        self.sent.append(bytes(frame))
+
+    def feed(self, frame: bytes):
+        self._inbox.put(frame)
+
+    def recv_frame(self) -> bytes:
+        f = self._inbox.get()
+        if f is None:
+            raise OSError("closed")
+        return f
+
+    def close(self):
+        self.closed = True
+        self._inbox.put(None)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_mconn_default_clock_is_monotonic():
+    """The regression this satellite fixes: keepalive arithmetic on
+    time.time() let an NTP step suppress (backward) or spuriously fire
+    (forward) the pong timeout.  The deadline clock must be monotonic
+    by default and injectable for tests."""
+    fs = _FakeSecret()
+    mc = MConnection(fs, _chans(), lambda c, m: None, lambda e: None)
+    assert mc._clock is time.monotonic
+    mc.stop()
+
+
+def test_mconn_rtt_and_flow_recorded_on_injected_clock():
+    clk = _FakeClock(1000.0)
+    fs = _FakeSecret()
+    got, errs = [], []
+    mc = MConnection(fs, _chans(), lambda c, m: got.append(m),
+                     errs.append, obs_node="nodeA", obs_peer="peerB",
+                     clock=clk)
+    mc.start()
+    try:
+        # send path: frame on the wire, bytes + queue wait in the ledger
+        assert mc.send(CH, b"hello")
+        assert _wait(lambda: any(f[0] == 0x01 for f in fs.sent))
+        assert _wait(lambda: netobs.flow_table().get("nodeA", {})
+                     .get("peerB", {}).get("sent_bytes", 0) == 7)
+
+        # rtt: a pong answering an outstanding ping, 35ms later on the
+        # injected clock (wall clock irrelevant by construction)
+        mc._ping_sent_t = clk.t
+        clk.t += 0.035
+        fs.feed(bytes([mconn._PONG]))
+        assert _wait(lambda: mc._ping_sent_t is None)
+        assert mc._last_pong == clk.t
+        rtt = netobs.flow_table()["nodeA"]["peerB"]["rtt"]
+        assert rtt["last_s"] == pytest.approx(0.035)
+
+        # recv path: dispatch wall + bytes under the peer's ledger
+        fs.feed(bytes([0x01, CH]) + b"payload")
+        assert _wait(lambda: got == [b"payload"])
+        assert _wait(lambda: netobs.flow_table()
+                     ["nodeA"]["peerB"]["recv_bytes"] == 9)
+        assert errs == []
+    finally:
+        mc.stop()
+
+
+def test_mconn_pong_timeout_fires_on_monotonic_clock(monkeypatch):
+    """Advance the injected monotonic clock past PONG_TIMEOUT without
+    any wall-clock movement: the keepalive must fire (under the old
+    time.time() arithmetic a backward NTP step could postpone this
+    indefinitely)."""
+    monkeypatch.setattr(mconn, "PING_INTERVAL", 0.01)
+    clk = _FakeClock(1000.0)
+    fs = _FakeSecret()
+    errs = []
+    mc = MConnection(fs, _chans(), lambda c, m: None, errs.append,
+                     clock=clk)
+    mc.start()
+    try:
+        clk.t += mconn.PONG_TIMEOUT + 1.0
+        assert _wait(lambda: len(errs) == 1)
+        assert isinstance(errs[0], TimeoutError)
+        assert fs.closed
+    finally:
+        mc.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4-node harness smoke: /debug/net, /metrics and the artifact gossip
+# table agree with the vnet decision schedule
+# ---------------------------------------------------------------------------
+
+def test_harness_gossip_table_debug_net_and_artifact_agree(tmp_path):
+    _codec()
+    from tendermint_tpu.libs.pprof import PprofServer
+
+    h = NetHarness(validators=4, seed=515, workdir=str(tmp_path))
+    h.start()
+    stopped = False
+    try:
+        for i in range(4):
+            for j in range(4):
+                if i != j:
+                    h.set_link(i, j, dup=0.25, latency_s=0.002)
+        h.run_scenario({
+            "name": "netobs_smoke", "validators": 4,
+            "steps": [{"op": "wait_height", "delta": 2,
+                       "timeout": 120.0}]})
+        addrs = {hn.addr for hn in h.nodes}
+        names = {hn.addr: hn.name for hn in h.nodes}
+        # quiesce before reconciling: a live network never stops
+        # sending, a stopped one holds both ledgers still
+        h.stop()
+        stopped = True
+
+        # (1) sent reconciliation, exact: per directed vnet link, the
+        # netobs sent ledger == decision sizes minus backpressure
+        by_link = {}
+        for src, dst, _idx, _ch, size, verdict, _delay in \
+                h.net.decisions():
+            if verdict != "drop:backpressure":
+                by_link[(src, dst)] = by_link.get((src, dst), 0) + size
+        assert by_link, "4 nodes committing blocks must gossip"
+        flow = netobs.flow_table()
+        for (src, dst), total in by_link.items():
+            assert flow[src][dst]["sent_bytes"] == total, \
+                f"link {src}->{dst}"
+        # recv never exceeds the schedule (dispatchers stop mid-heap)
+        for src in addrs:
+            for dst, pf in flow.get(src, {}).items():
+                if dst in addrs:
+                    exp = sum(
+                        d[4] * (2 if "+dup" in d[5] else 1)
+                        for d in h.net.decisions()
+                        if (d[0], d[1]) == (dst, src)
+                        and d[5].startswith("deliver"))
+                    assert pf["recv_bytes"] <= exp
+
+        # (2) duplicate-waste moved under the armed dup policy
+        rep = netobs.report()
+        assert rep["totals"]["useful_receipts"] > 0
+        assert rep["totals"]["duplicate_receipts"] > 0
+        assert 0.0 < rep["totals"]["duplicate_ratio"] < 1.0
+
+        # (3) the artifact gossip table: canonical names, policy JOIN,
+        # byte totals preserved by the keying fold
+        gt = h.gossip_table()
+        assert gt["links"]
+        for key, row in gt["links"].items():
+            src, dst = key.split("->")
+            assert src in names.values() and dst in names.values()
+            assert "latency_s" in row["policy"]
+        assert sum(r["sent_bytes"] for r in gt["links"].values()) == \
+            sum(by_link.values())
+        assert any(r["dup_parts"] + r["dup_votes"] > 0
+                   for r in gt["links"].values())
+        assert any(r["rtt"] for r in gt["links"].values())
+        armed = [r for r in gt["links"].values()
+                 if r["policy"]["dup"] == 0.25]
+        assert armed, "armed LinkPolicy must survive the JOIN"
+
+        # (4) /debug/net serves the same report over HTTP
+        srv = PprofServer("127.0.0.1:0")
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{srv.laddr}/debug/net", timeout=10) as r:
+                served = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        assert served["totals"] == rep["totals"]
+        assert served["enabled"] is True
+
+        # (5) /metrics: the dead-since-seed byte counters finally move
+        netobs.publish_pending()
+        text = metrics.DEFAULT.render_text()
+        assert _scrape_value(
+            text, "tendermint_p2p_message_send_bytes_total") >= 0
+        assert "tendermint_p2p_message_send_bytes_total" in text
+        assert "tendermint_p2p_message_receive_bytes_total" in text
+        assert "tendermint_p2p_gossip_receipts_total" in text
+        assert "tendermint_p2p_peer_rtt_seconds" in text
+    finally:
+        if not stopped:
+            h.stop()
