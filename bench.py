@@ -1700,12 +1700,132 @@ def _statesync_main():
           f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
 
 
+def run_light_serve(n_vals: int, n_heights: int, clients: int):
+    """Light-serve core (ADR-026, shared by BENCH_LIGHT=1 and
+    bench_report config16): build a deterministic chain, then drive
+    `clients` concurrent light clients through ONE LightServe — every
+    client adjacent-verifies the same heights, so the serving plane's
+    cross-client coalescing runs one shared certificate verification
+    per height while every client keeps its own verdict + latency.
+    Host-capable by construction: the certificate checks route through
+    the degradation runtime, so without an accelerator they verify on
+    the host plane and the line still lands rc=0."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from helpers import build_chain, make_genesis
+
+    from tendermint_tpu.libs.kvdb import MemDB
+    from tendermint_tpu.light.service import LightRequest, LightServe
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.types.basic import Timestamp
+    from tendermint_tpu.types.light_block import SignedHeader
+
+    gdoc, privs = make_genesis(n_vals)
+    blocks, commits, states = build_chain(gdoc, privs, n_heights)
+    shs = [SignedHeader(b.header, commits[i])
+           for i, b in enumerate(blocks)]
+    now = Timestamp(1700005000, 0)
+    period = 3600.0 * 24 * 14
+
+    svc = LightServe(BlockStore(MemDB()), StateStore(MemDB()),
+                     gdoc.chain_id, prewarm=False)
+    svc.start()
+
+    def req(i):
+        return LightRequest("adjacent", gdoc.chain_id,
+                            trusted=shs[i - 1], untrusted=shs[i],
+                            untrusted_vals=states[i].validators,
+                            now=now, trusting_period_s=period)
+
+    # prewarm: comb tables for the set, plus one solo verification so
+    # XLA compiles land OUTSIDE the measured window
+    from tendermint_tpu.ops import ed25519 as edops
+    edops.prewarm([v.pub_key.bytes()
+                   for v in states[1].validators.validators])
+    warm = svc.verify(req(1), client="warmup", timeout=120.0)
+    assert warm.ok, f"warmup verification failed: {warm.error}"
+
+    total = 0
+    futs = []
+    t0 = time.perf_counter()
+    for h in range(1, len(shs)):
+        # every client asks for the SAME height back-to-back: the
+        # serving plane coalesces them into one shared certificate
+        for c in range(clients):
+            futs.append(svc.submit(req(h), client=f"client-{c}"))
+            total += 1
+    for f in futs:
+        v = f.result(timeout=svc.workers * 300.0)
+        assert v.ok, f"bench verification failed: {v.error}"
+    wall = time.perf_counter() - t0
+
+    st = svc.stats()
+    rep = svc.report()
+    svc.stop()
+    leads, hits = st["coalesce_lead"], st["coalesce_hit"]
+    return {
+        "headers": total,
+        "wall_s": round(wall, 4),
+        "headers_per_s": round(total / wall, 1) if wall else 0.0,
+        "clients": clients,
+        "validators": n_vals,
+        "heights": len(shs) - 1,
+        "coalesce_lead": leads,
+        "coalesce_hit": hits,
+        "coalesce_ratio": round(hits / (leads + hits), 4)
+        if (leads + hits) else 0.0,
+        "per_client_p99_ms": rep["per_client_p99_ms"],
+        "slo_light": rep["slo"],
+    }
+
+
+def _light_main():
+    """Light-serve config (BENCH_LIGHT=1, ADR-026, bench_report
+    config16): one rc=0 JSON line — headers/s through the coalesced
+    serving plane with N concurrent clients over the same heights,
+    the coalesce ratio (shared certificate executions vs requests),
+    and per-client p99 latency wired into the [slo] light stream."""
+    t_start = time.time()
+    # 48 validators: the minimal >2/3 certificate prefix (33 sigs) is
+    # over the device-lane floor, so the measured window shows the
+    # coalesced comb launches, not host-lane verifies
+    n_vals = int(os.environ.get("BENCH_LIGHT_VALS", "48"))
+    n_heights = int(os.environ.get("BENCH_LIGHT_HEIGHTS", "12"))
+    clients = int(os.environ.get("BENCH_LIGHT_CLIENTS", "16"))
+    from tendermint_tpu.libs import slo
+    slo.set_config(enabled=True, window=4096,
+                   targets={"light": 0.25}, budgets={"light": 0.1})
+    r = run_light_serve(n_vals=n_vals, n_heights=n_heights,
+                        clients=clients)
+    slo_rep = r.pop("slo_light") or {}
+    line = {
+        "metric": "light_serve_headers_per_s",
+        "value": r["headers_per_s"],
+        "unit": "headers/s",
+        **{k: v for k, v in r.items() if k != "headers_per_s"},
+        "slo_light_p99_ms": round(slo_rep.get("p99_s", 0.0) * 1000.0, 3)
+        if slo_rep else None,
+        "slo_light_burn": slo_rep.get("burn_rate") if slo_rep else None,
+        "note": "host-capable: certificate checks ride the degrade "
+                "runtime, rc=0 with or without an accelerator",
+        "trace": _trace_artifact("light"),
+    }
+    _emit(line)
+    print(f"# light bench: headers={r['headers']} "
+          f"wall_s={r['wall_s']} coalesce_ratio={r['coalesce_ratio']} "
+          f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_LIGHT") == "1":
+        _light_main()
+        return
     if os.environ.get("BENCH_CONTROL") == "1":
         _control_main()
         return

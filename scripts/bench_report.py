@@ -667,6 +667,31 @@ def config15_gossip(validators=4, heights=8):
             "sent_bytes": r["sent_bytes"]}
 
 
+def config16_light(validators=48, heights=12, clients=16):
+    """Light-client serving plane (light/service.py, ADR-026): N
+    concurrent clients adjacent-verify the SAME heights through one
+    LightServe, so the plane coalesces them into one shared
+    certificate verification per height.  Columns mirror the
+    BENCH_LIGHT=1 bench.py line: headers/s through the plane, the
+    coalesce ratio (shared executions vs requests), and the worst
+    per-client p99 — the number the [slo] light stream holds."""
+    from bench import run_light_serve
+
+    r = run_light_serve(n_vals=validators, n_heights=heights,
+                        clients=clients)
+    p99s = [v for k, v in r["per_client_p99_ms"].items()
+            if k != "warmup"]
+    return {"config": f"16: light serve {clients} clients x "
+                      f"{r['heights']} heights",
+            "headers_per_s": r["headers_per_s"],
+            "headers": r["headers"],
+            "coalesce_ratio": r["coalesce_ratio"],
+            "coalesce_lead": r["coalesce_lead"],
+            "coalesce_hit": r["coalesce_hit"],
+            "worst_client_p99_ms": max(p99s) if p99s else 0.0,
+            "validators": r["validators"]}
+
+
 def main():
     import json
 
@@ -688,7 +713,7 @@ def main():
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
            config11_consensus, config12_statesync, config13_control,
-           config14_propose, config15_gossip)
+           config14_propose, config15_gossip, config16_light)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

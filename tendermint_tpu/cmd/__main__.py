@@ -496,6 +496,32 @@ def cmd_debug_net(args):
         print(json.dumps(json.loads(body), indent=2))
 
 
+def cmd_debug_light(args):
+    """Snapshot the running node's light serving plane
+    (light/service.py, ADR-026) via its pprof listener's
+    GET /debug/light — admission and coalesce stats, the follow-cursor
+    table, and per-client p99 verify latency."""
+    import urllib.request
+
+    addr = _pprof_addr(args, "and enable the plane with "
+                             "[light_serve] enable or "
+                             "TM_TPU_LIGHT_SERVE=1")
+    url = f"http://{addr}/debug/light"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    if args.output_file:
+        out = os.path.abspath(args.output_file)
+        with open(out, "w") as f:
+            f.write(body)
+        doc = json.loads(body)
+        st = doc.get("stats") or {}
+        print(f"wrote light serving report "
+              f"({st.get('submitted', 0)} requests, coalesce ratio "
+              f"{doc.get('coalesce_ratio', 0.0)}) to {out}")
+    else:
+        print(json.dumps(json.loads(body), indent=2))
+
+
 def cmd_debug_control(args):
     """Snapshot the running node's adaptive control plane
     (libs/control.py, ADR-023) via its pprof listener's
@@ -869,6 +895,14 @@ def main(argv=None):
                     help="pprof listener (default: [rpc] pprof_laddr)")
     sp.add_argument("--output-file", dest="output_file", default="")
     sp.set_defaults(fn=cmd_debug_control)
+    sp = sub.add_parser("debug-light",
+                        help="snapshot the node's light serving plane "
+                             "(admission/coalesce stats + follow "
+                             "cursors + per-client p99)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="pprof listener (default: [rpc] pprof_laddr)")
+    sp.add_argument("--output-file", dest="output_file", default="")
+    sp.set_defaults(fn=cmd_debug_light)
     sp = sub.add_parser("debug-index",
                         help="list the pprof listener's registered "
                              "debug endpoints")

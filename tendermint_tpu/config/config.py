@@ -293,7 +293,7 @@ class SLOConfig:
     # observatory's proposal -> useful-part receipt latency (ADR-025)
     STREAMS = ("consensus", "commit", "blocksync", "mempool",
                "block_interval", "propose", "quorum_prevote", "apply",
-               "device_launch", "statesync", "gossip")
+               "device_launch", "statesync", "gossip", "light")
 
     enable: bool = False
     window: int = 1024
@@ -308,6 +308,7 @@ class SLOConfig:
     device_launch_p99_ms: float = 0.0
     statesync_p99_ms: float = 0.0
     gossip_p99_ms: float = 0.0
+    light_p99_ms: float = 0.0
     # per-stream error budgets in PERCENT of windowed requests allowed
     # over the p99 target (the burn-rate denominator; 1.0 = the p99
     # convention).  Replaces the old hardcoded _P99_BUDGET constant
@@ -322,6 +323,7 @@ class SLOConfig:
     device_launch_budget_pct: float = 1.0
     statesync_budget_pct: float = 1.0
     gossip_budget_pct: float = 1.0
+    light_budget_pct: float = 1.0
 
     def targets_s(self) -> dict:
         """Stream -> p99 target in seconds (only the set ones)."""
@@ -350,6 +352,43 @@ class SLOConfig:
             if not (0 < pct <= 100):
                 raise ValueError(
                     f"slo.{stream}_budget_pct must be in (0, 100]")
+
+
+@dataclass
+class LightServeConfig:
+    """Light-client serving plane (light/service.py, ADR-026): one
+    process-global LightServe front door for many concurrent
+    header-verifying clients.  `enable = false` (or TM_TPU_LIGHT_SERVE=0
+    for node-less tooling) is the kill switch: the node never constructs
+    the service and every light RPC route answers service-disabled —
+    the full node's own paths are untouched either way."""
+    enable: bool = True
+    queue: int = 4096           # bounded admission queue (requests);
+    #                             full = immediate busy + retry_after
+    workers: int = 1            # queue-draining worker threads
+    batch: int = 256            # max requests drained per worker wakeup
+    # per-client token bucket, requests per second; 0 = unlimited.
+    # Burst 0 = auto (max(1, rate)).
+    rate_per_s: float = 0.0
+    burst: int = 0
+    # header-range follow cursors (the subscription surface): bounded
+    # per client and globally; past the global bound the least-recently
+    # polled cursor is evicted (newest-first survival under pressure)
+    max_cursors_per_client: int = 4
+    max_cursors: int = 1024
+    cursor_batch: int = 64      # max headers returned per poll
+    prewarm: bool = True        # comb-table prewarm on valset change
+
+    def validate_basic(self):
+        for k in ("queue", "workers", "batch", "max_cursors_per_client",
+                  "max_cursors", "cursor_batch"):
+            if getattr(self, k) <= 0:
+                raise ValueError(f"light_serve.{k} must be positive")
+        # 0 = unlimited rate / auto burst; only negatives are nonsense
+        if self.rate_per_s < 0:
+            raise ValueError("light_serve.rate_per_s must be >= 0")
+        if self.burst < 0:
+            raise ValueError("light_serve.burst must be >= 0")
 
 
 @dataclass
@@ -449,6 +488,8 @@ class Config:
         default_factory=BlockPipelineConfig)
     devobs: DevObsConfig = field(default_factory=DevObsConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
+    light_serve: LightServeConfig = field(
+        default_factory=LightServeConfig)
 
     def validate_basic(self):
         """Reference config/config.go:107-133 Config.ValidateBasic:
@@ -456,7 +497,7 @@ class Config:
         for name in ("p2p", "mempool", "rpc", "consensus",
                      "batch_verifier", "verify_scheduler", "slo",
                      "block_pipeline", "devobs", "state_sync",
-                     "control"):
+                     "control", "light_serve"):
             section = getattr(self, name)
             vb = getattr(section, "validate_basic", None)
             if vb is None:
@@ -609,6 +650,7 @@ apply_p99_ms = {self.slo.apply_p99_ms}
 device_launch_p99_ms = {self.slo.device_launch_p99_ms}
 statesync_p99_ms = {self.slo.statesync_p99_ms}
 gossip_p99_ms = {self.slo.gossip_p99_ms}
+light_p99_ms = {self.slo.light_p99_ms}
 consensus_budget_pct = {self.slo.consensus_budget_pct}
 commit_budget_pct = {self.slo.commit_budget_pct}
 blocksync_budget_pct = {self.slo.blocksync_budget_pct}
@@ -620,6 +662,7 @@ apply_budget_pct = {self.slo.apply_budget_pct}
 device_launch_budget_pct = {self.slo.device_launch_budget_pct}
 statesync_budget_pct = {self.slo.statesync_budget_pct}
 gossip_budget_pct = {self.slo.gossip_budget_pct}
+light_budget_pct = {self.slo.light_budget_pct}
 
 [control]
 enable = {str(self.control.enable).lower()}
@@ -646,6 +689,18 @@ statesync_fetchers_step = {self.control.statesync_fetchers_step}
 comb_min_batch_min = {self.control.comb_min_batch_min}
 comb_min_batch_max = {self.control.comb_min_batch_max}
 comb_min_batch_step = {self.control.comb_min_batch_step}
+
+[light_serve]
+enable = {str(self.light_serve.enable).lower()}
+queue = {self.light_serve.queue}
+workers = {self.light_serve.workers}
+batch = {self.light_serve.batch}
+rate_per_s = {self.light_serve.rate_per_s}
+burst = {self.light_serve.burst}
+max_cursors_per_client = {self.light_serve.max_cursors_per_client}
+max_cursors = {self.light_serve.max_cursors}
+cursor_batch = {self.light_serve.cursor_batch}
+prewarm = {str(self.light_serve.prewarm).lower()}
 
 [consensus]
 timeout_propose = {c.timeout_propose}
@@ -771,6 +826,19 @@ propose_max_bytes = {c.propose_max_bytes}
             **{f: float(ct.get(f, getattr(defaults, f)))
                for knob in ControlConfig.KNOBS
                for f in (f"{knob}_min", f"{knob}_max", f"{knob}_step")})
+        ls = d.get("light_serve", {})
+        cfg.light_serve = LightServeConfig(
+            enable=bool(ls.get("enable", True)),
+            queue=int(ls.get("queue", 4096)),
+            workers=int(ls.get("workers", 1)),
+            batch=int(ls.get("batch", 256)),
+            rate_per_s=float(ls.get("rate_per_s", 0.0)),
+            burst=int(ls.get("burst", 0)),
+            max_cursors_per_client=int(
+                ls.get("max_cursors_per_client", 4)),
+            max_cursors=int(ls.get("max_cursors", 1024)),
+            cursor_batch=int(ls.get("cursor_batch", 64)),
+            prewarm=bool(ls.get("prewarm", True)))
         c = d.get("consensus", {})
         cc = ConsensusConfig()
         for k in ("timeout_propose", "timeout_propose_delta",

@@ -42,6 +42,23 @@ from __future__ import annotations
 
 # rank by lock id; see module docstring for the id grammar
 LOCK_ORDER = {
+    # -- light client trusted-state advance (light/, ADR-026): the
+    # client lock serializes the store read -> verify -> save path and
+    # is held across the verifier (scheduler _cond 20) and the trusted
+    # store (kvdb 65-69), so it must rank below both
+    "tendermint_tpu/light/client.py:Client._lock": 8,
+
+    # -- light serving plane (light/service.py, ADR-026): ingress
+    # discipline — _cond guards the admission queue + coalesce groups
+    # ONLY (bookkeeping); the verifier, scheduler (20), stores and
+    # metrics are all called with it released.  _rl_lock (per-client
+    # token buckets), _cur_lock (follow cursors; block-store reads run
+    # with it released) and _stats_lock are leaves taken alone.
+    "tendermint_tpu/light/service.py:LightServe._cond": 21,
+    "tendermint_tpu/light/service.py:LightServe._rl_lock": 23,
+    "tendermint_tpu/light/service.py:LightServe._cur_lock": 25,
+    "tendermint_tpu/light/service.py:LightServe._stats_lock": 37,
+
     # -- process-global installers (held while constructing the world) --
     "tendermint_tpu/crypto/degrade.py:_runtime_lock": 5,
     "tendermint_tpu/crypto/scheduler.py:_global_lock": 10,

@@ -160,6 +160,16 @@ REGISTERED_SITES = frozenset({
     # delivery proceeds untouched — the same contract
     # observatory.record / devobs.record proved for their planes
     "netobs.record",
+    # light serving plane (light/service.py, ADR-026): light.serve
+    # fires at the top of LightServe.submit (raise = the request
+    # degrades to the synchronous in-caller direct path — the exact
+    # verification the caller would run without the service, identical
+    # verdicts); light.coalesce fires before the worker groups a
+    # batch's certificate verifications (raise = the batch degrades to
+    # per-request direct certificate checks with no dedupe, identical
+    # verdicts)
+    "light.serve",
+    "light.coalesce",
 })
 
 # families for sites assembled at runtime ONLY (f"batch.{scheme}" in

@@ -42,6 +42,10 @@ image) and with near-zero overhead when idle:
                                ADR-025): per-peer/per-channel flow
                                ledgers, queue wait, flowrate stall,
                                RTT, duplicate-waste accounting
+  GET /debug/light             light serving plane (light/service.py,
+                               ADR-026): admission/coalesce stats,
+                               follow-cursor table, per-client p99
+                               latency
   GET /debug                   index: every registered debug endpoint
                                with a one-line description, so
                                operators stop guessing URLs
@@ -100,6 +104,9 @@ DEBUG_ENDPOINTS = (
     ("/debug/net?node=NAME",
      "gossip observatory: per-peer/per-channel flow, queue wait, "
      "stall, RTT, duplicate-waste accounting (ADR-025)"),
+    ("/debug/light",
+     "light serving plane: admission/coalesce stats, follow-cursor "
+     "table, per-client p99 latency (ADR-026)"),
 )
 
 
@@ -277,6 +284,15 @@ class _Handler(BaseHTTPRequestHandler):
                 node = q.get("node", [None])[0]
                 netobs.publish_pending()
                 self._send(200, json.dumps(netobs.report(node),
+                                           default=str),
+                           ctype="application/json")
+            elif url.path == "/debug/light":
+                # the light serving plane (ADR-026): admission and
+                # coalesce stats, the follow-cursor table, per-client
+                # p99 latency.  Lazy import: the pprof listener must
+                # stay importable without the light stack
+                from tendermint_tpu.light import service as light_svc
+                self._send(200, json.dumps(light_svc.report(),
                                            default=str),
                            ctype="application/json")
             elif url.path == "/debug/control":

@@ -70,6 +70,11 @@ KNOWN_SPANS = frozenset({
     "control.decide",
     # crypto/lanepool.py — sharded native C host verify (ADR-015)
     "lanepool.verify",
+    # light/service.py — the light serving plane (ADR-026):
+    # light.serve wraps one drained worker batch, light.coalesce wraps
+    # one SHARED certificate verification (waiters = how many requests
+    # it settles)
+    "light.coalesce", "light.serve",
     # networks/ — the in-process multi-node harness (ADR-019)
     "harness.scenario", "harness.step", "vnet.deliver",
     # p2p/netobs.py — the gossip observatory's deferred drain (ADR-025)

@@ -8,6 +8,7 @@ each hop doing one batched trust-level verify on the TPU plane — a
 """
 from __future__ import annotations
 
+import threading
 from fractions import Fraction
 from typing import List, Optional
 
@@ -63,6 +64,15 @@ class Client:
         # primary would be unchallenged.  Clients deliberately built with
         # zero witnesses (statesync bootstrap) are exempt.
         self._had_witnesses = bool(self.witnesses)
+        # serializes the trusted-store read -> verify -> advance path:
+        # concurrent verifiers (LightServe requests sharing one client,
+        # ADR-026) must not interleave store.get/latest_before with the
+        # trace's store.save, or two racers could each verify from a
+        # stale anchor and persist overlapping traces out of order.
+        # Reentrant: verify_light_block_at_height -> verify_light_block
+        # nests.  Rank 8 in devtools/lockorder.py — held across the
+        # verifier (scheduler _cond 20) and the store (kvdb 65-69)
+        self._lock = threading.RLock()
         from tendermint_tpu.libs import log as tmlog
         self.log = tmlog.logger("light")
         self._initialize(trust_options)
@@ -96,25 +106,31 @@ class Client:
 
     def update(self, now: Timestamp) -> Optional[LightBlock]:
         """Fetch + verify the primary's latest (reference client.go:436)."""
-        latest = self._from_primary(0)
-        if latest.height <= self.last_trusted_height():
-            return None
-        self.verify_light_block(latest, now)
-        return latest
+        with self._lock:
+            latest = self._from_primary(0)
+            if latest.height <= self.last_trusted_height():
+                return None
+            self.verify_light_block(latest, now)
+            return latest
 
     def verify_light_block_at_height(self, height: int,
                                      now: Timestamp) -> LightBlock:
         """Reference client.go:474."""
-        got = self.store.get(height)
-        if got is not None:
-            return got
-        lb = self._from_primary(height)
-        self.verify_light_block(lb, now)
-        return lb
+        with self._lock:
+            got = self.store.get(height)
+            if got is not None:
+                return got
+            lb = self._from_primary(height)
+            self.verify_light_block(lb, now)
+            return lb
 
     def verify_light_block(self, lb: LightBlock, now: Timestamp):
         """Reference client.go:558-611: pick sequential vs skipping from the
         nearest trusted anchor; on success cross-check witnesses."""
+        with self._lock:
+            self._verify_light_block_locked(lb, now)
+
+    def _verify_light_block_locked(self, lb: LightBlock, now: Timestamp):
         lb.validate_basic(self.chain_id)
         if self.store.get(lb.height) is not None:
             return

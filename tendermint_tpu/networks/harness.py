@@ -233,6 +233,7 @@ class NetHarness:
                  control_overrides: Optional[dict] = None,
                  slo_overrides: Optional[dict] = None,
                  verify_scheduler_overrides: Optional[dict] = None,
+                 light_serve_overrides: Optional[dict] = None,
                  power: int = 10, chain_id: str = "netharness-chain"):
         self.n_validators = validators
         self.n_nodes = validators + standbys
@@ -248,6 +249,7 @@ class NetHarness:
         self.slo_overrides = dict(slo_overrides or {})
         self.verify_scheduler_overrides = dict(
             verify_scheduler_overrides or {})
+        self.light_serve_overrides = dict(light_serve_overrides or {})
         self.workdir = workdir or tempfile.mkdtemp(prefix="tm_netharness_")
         self.net = VirtualNetwork(
             seed=seed,
@@ -266,6 +268,17 @@ class NetHarness:
         self._ramp_thread: Optional[threading.Thread] = None
         self._ramp_sent = 0
         self._ramp_rejected = 0
+        # light swarm (ADR-026): follower heads, errors and flood
+        # accounting; counters bump under the GIL, threads joined at
+        # stop
+        self._light_stop = threading.Event()
+        self._light_threads: List[threading.Thread] = []
+        self._light_heads: Dict[str, tuple] = {}
+        self._light_errors: List[str] = []
+        self._light_anchor = 0
+        self._light_flood_sent = 0
+        self._light_flood_refused = 0
+        self._light_sched_shed0: Optional[int] = None
         self._genesis_json: Optional[str] = None
         self._scaffold()
 
@@ -312,6 +325,8 @@ class NetHarness:
             setattr(cfg.slo, k, v)
         for k, v in self.verify_scheduler_overrides.items():
             setattr(cfg.verify_scheduler, k, v)
+        for k, v in self.light_serve_overrides.items():
+            setattr(cfg.light_serve, k, v)
         cfg.rpc.enabled = False
         cfg.p2p.pex = False
         cfg.p2p.laddr = hn.addr
@@ -359,6 +374,7 @@ class NetHarness:
             self._monitor.join(timeout=3.0)
         self.stop_ramp()
         self.stop_flood()
+        self.stop_light_swarm()
         for hn in self.nodes:
             try:
                 hn.stop()
@@ -715,6 +731,186 @@ class NetHarness:
             f"joiner never statesynced within {timeout}s "
             f"(state height {h}, heights={self.heights()})")
 
+    # -- light swarm (light/service.py, ADR-026) ---------------------------
+
+    def _light_service(self, target: int):
+        node = self.nodes[target].node
+        svc = getattr(node, "light_serve", None) if node else None
+        if svc is None or not svc.is_running():
+            raise ScenarioFailure(
+                f"node {target} runs no light serving plane "
+                "([light_serve] enable)")
+        return svc
+
+    def _snap_sched_shed(self):
+        """Baseline the scheduler's shed counter once per swarm so the
+        refusal gate can prove light load displaced NO verify work."""
+        if self._light_sched_shed0 is None:
+            from tendermint_tpu.crypto import scheduler as vsched
+            s = vsched.running()
+            if s is not None:
+                self._light_sched_shed0 = s.stats()["shed"]
+
+    def start_light_swarm(self, target: int, clients: int = 4):
+        """A swarm of header-verifying light clients following node
+        `target`'s serving plane via follow cursors, each one
+        adjacent-verifying every height against its own trusted state."""
+        self._light_service(target)  # fail fast before spawning
+        self._light_stop.clear()
+        self._light_anchor = max(2, self.nodes[target].height())
+        self._snap_sched_shed()
+        for i in range(clients):
+            cname = f"swarm-{i}"
+            t = threading.Thread(
+                target=self._light_follow_routine, args=(cname, target),
+                daemon=True, name=f"light-{cname}")
+            self._light_threads.append(t)
+            t.start()
+
+    def start_light_flood(self, target: int, batch: int = 64):
+        """A flooding light client hammering node `target`'s serving
+        plane: it must be refused busy/ratelimit at the front door
+        while honest followers and consensus proceed untouched."""
+        self._light_service(target)
+        self._snap_sched_shed()
+        t = threading.Thread(
+            target=self._light_flood_routine, args=(target, batch),
+            daemon=True, name="light-flooder")
+        self._light_threads.append(t)
+        t.start()
+
+    def stop_light_swarm(self):
+        self._light_stop.set()
+        for t in self._light_threads:
+            t.join(timeout=10.0)
+        self._light_threads = []
+
+    def _light_follow_routine(self, cname: str, target: int):
+        try:
+            svc = self._light_service(target)
+        except ScenarioFailure as e:  # node died under us
+            self._light_errors.append(f"{cname}: {e}")
+            return
+        from tendermint_tpu.light.service import LightRequest
+        # anchor past height 1: block 1 carries the (old) genesis time
+        # and would read as expired against a 14-day trusting period
+        trusted = None
+        trusted_vals = None
+        cursor = svc.subscribe(cname, from_height=self._light_anchor)
+        while not self._light_stop.is_set():
+            blocks = svc.poll(cursor, 8)
+            if blocks is None:
+                # evicted under pressure: re-subscribe from our head
+                nxt = trusted.height + 1 if trusted is not None \
+                    else self._light_anchor
+                cursor = svc.subscribe(cname, from_height=nxt)
+                time.sleep(0.05)
+                continue
+            if not blocks:
+                time.sleep(0.05)
+                continue
+            for lb in blocks:
+                if self._light_stop.is_set():
+                    return
+                sh, vals = lb.signed_header, lb.validators
+                if trusted is None:
+                    trusted, trusted_vals = sh, vals
+                    self._light_heads[cname] = (sh.height,
+                                                sh.header.hash())
+                    continue
+                if sh.height != trusted.height + 1:
+                    self._light_errors.append(
+                        f"{cname}: cursor height gap "
+                        f"{trusted.height} -> {sh.height}")
+                    return
+                req = LightRequest("adjacent", self.chain_id,
+                                   trusted=trusted, untrusted=sh,
+                                   untrusted_vals=vals)
+                v = svc.verify(req, client=cname, timeout=30.0)
+                tries = 0
+                while v.retry_after_s is not None and tries < 100 \
+                        and not self._light_stop.is_set():
+                    # busy under the flood: honest clients back off
+                    # and retry, they never skip a verification
+                    time.sleep(min(v.retry_after_s, 0.1))
+                    v = svc.verify(req, client=cname, timeout=30.0)
+                    tries += 1
+                if v.retry_after_s is not None:
+                    return  # stopping / saturated to the end
+                if not v.ok:
+                    self._light_errors.append(
+                        f"{cname}: refused height {sh.height}: "
+                        f"{v.error}")
+                    return
+                trusted, trusted_vals = sh, vals
+                self._light_heads[cname] = (sh.height, sh.header.hash())
+
+    def _light_flood_routine(self, target: int, batch: int):
+        try:
+            svc = self._light_service(target)
+        except ScenarioFailure as e:
+            self._light_errors.append(f"flooder: {e}")
+            return
+        from tendermint_tpu.light.service import LightRequest
+        while not self._light_stop.is_set():
+            for _ in range(batch):
+                fut = svc.submit(
+                    LightRequest("adjacent", self.chain_id),
+                    client="light-flooder")
+                self._light_flood_sent += 1
+                if fut.done():
+                    r = fut.result(0.1)
+                    if r.retry_after_s is not None:
+                        self._light_flood_refused += 1
+            time.sleep(0.02)
+
+    def expect_light_heads(self, min_delta: int = 1) -> dict:
+        """Gate: every honest follower verified heads that MATCH the
+        committed chain (hash equality against a running node's block
+        store), advanced at least `min_delta` past the swarm anchor,
+        and hit zero verification errors."""
+        if self._light_errors:
+            raise ScenarioFailure(
+                "light swarm errors: " + "; ".join(self._light_errors))
+        if not self._light_heads:
+            raise ScenarioFailure("light swarm verified no heads")
+        store = self.running_nodes()[0].node.block_store
+        for cname, (h, hh) in sorted(self._light_heads.items()):
+            if h < self._light_anchor + min_delta:
+                raise ScenarioFailure(
+                    f"{cname} head {h} never advanced {min_delta} past "
+                    f"anchor {self._light_anchor}")
+            meta = store.load_block_meta(h)
+            if meta is None:
+                raise ScenarioFailure(
+                    f"{cname} head {h} not in the committed store")
+            if meta.header.hash() != hh:
+                raise ScenarioFailure(
+                    f"{cname} verified head {h} diverges from the "
+                    "committed chain")
+        return dict(self._light_heads)
+
+    def expect_light_refusals(self, min_refused: int = 1) -> dict:
+        """Gate: the flooding client was refused at the front door at
+        least `min_refused` times AND the verify scheduler shed nothing
+        since the swarm began — light overload must never displace
+        consensus verification."""
+        if self._light_flood_refused < min_refused:
+            raise ScenarioFailure(
+                f"light flooder refused {self._light_flood_refused} "
+                f"times, wanted >= {min_refused} "
+                f"(sent {self._light_flood_sent})")
+        from tendermint_tpu.crypto import scheduler as vsched
+        s = vsched.running()
+        if s is not None and self._light_sched_shed0 is not None:
+            shed = s.stats()["shed"] - self._light_sched_shed0
+            if shed > 0:
+                raise ScenarioFailure(
+                    f"verify scheduler shed {shed} submissions under "
+                    "the light flood")
+        return {"sent": self._light_flood_sent,
+                "refused": self._light_flood_refused}
+
     def double_sign(self, idx: int):
         """Arm an equivocating prevoter (reference byzantine_test.go):
         alongside every honest prevote the node signs and gossips a
@@ -949,6 +1145,23 @@ class NetHarness:
                 step.get("stream", "consensus"),
                 min_burn=step.get("min"), max_burn=step.get("max"),
                 timeout=step.get("timeout", 30.0))
+        elif op == "light_swarm":
+            self.start_light_swarm(step.get("target", 0),
+                                   clients=step.get("clients", 4))
+        elif op == "light_flood":
+            self.start_light_flood(step.get("target", 0),
+                                   batch=step.get("batch", 64))
+        elif op == "stop_light_swarm":
+            self.stop_light_swarm()
+            ctx["light_heads"] = dict(self._light_heads)
+            ctx["light_flood_sent"] = self._light_flood_sent
+            ctx["light_flood_refused"] = self._light_flood_refused
+        elif op == "expect_light_heads":
+            ctx["light_verified"] = self.expect_light_heads(
+                min_delta=step.get("min_delta", 1))
+        elif op == "expect_light_refusals":
+            ctx["light_refusals"] = self.expect_light_refusals(
+                step.get("min", 1))
         elif op == "sleep":
             time.sleep(step.get("s", 0.5))
         else:  # pragma: no cover - validate_scenario gates this
@@ -1033,7 +1246,8 @@ class NetHarness:
                 control_overrides=scenario.get("control"),
                 slo_overrides=scenario.get("slo"),
                 verify_scheduler_overrides=scenario.get(
-                    "verify_scheduler"))
+                    "verify_scheduler"),
+                light_serve_overrides=scenario.get("light_serve"))
         h.start()
         try:
             return h.run_scenario(scenario)

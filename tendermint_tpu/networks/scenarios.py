@@ -44,6 +44,17 @@ Step vocabulary (harness._apply_step):
       static value (decision ring + control_knob_value gauges)
   {"op": "expect_burn", "stream": s, ...}  gate on a stream's SLO burn
       rate: "min" waits for burn to reach it, "max" to settle below
+  {"op": "light_swarm", "target": i, "clients": n}  a swarm of header-
+      verifying light clients following node i's serving plane
+      (ADR-026) via follow cursors, until "stop_light_swarm"
+  {"op": "light_flood", "target": i}       a flooding light client
+      hammering node i's serving plane front door
+  {"op": "stop_light_swarm"}
+  {"op": "expect_light_heads", "min_delta": d}  gate: every honest
+      follower's verified head matches the committed chain and
+      advanced >= d past the swarm anchor
+  {"op": "expect_light_refusals", "min": n}  gate: the flooder was
+      refused >= n times at the front door with ZERO scheduler sheds
   {"op": "sleep", "s": x}
 """
 from __future__ import annotations
@@ -73,6 +84,16 @@ _STEP_OPS = frozenset({
     # SLO burn rate ("expect_burn", min or max)
     "load_ramp", "stop_ramp", "control_set", "control_kill",
     "expect_control_reverted", "expect_burn",
+    # light serving plane (ADR-026): follow a live chain with a swarm
+    # of header-verifying light clients ("light_swarm"), hammer the
+    # front door with a flooding client ("light_flood"), stop both and
+    # snapshot the accounting ("stop_light_swarm"), gate that every
+    # honest follower's verified head MATCHES the committed chain
+    # ("expect_light_heads") and that the flooder was refused at the
+    # front door with ZERO verify-scheduler sheds
+    # ("expect_light_refusals")
+    "light_swarm", "light_flood", "stop_light_swarm",
+    "expect_light_heads", "expect_light_refusals",
 })
 
 
@@ -341,6 +362,36 @@ SCENARIOS: List[dict] = [validate_scenario(s) for s in (
             {"op": "expect_control_reverted", "timeout": 3.0},
             {"op": "stop_ramp"},
             {"op": "wait_height", "delta": 2, "timeout": 90},
+        ],
+    },
+    {
+        # ADR-026 acceptance: a swarm of header-verifying light
+        # clients follows a live 4-node chain THROUGH a validator-
+        # power change while a flooding client hammers the serving
+        # plane.  Invariants: every honest client's verified head
+        # matches the committed chain (hash equality), the flooder is
+        # refused busy/ratelimit at the bounded front door, and the
+        # verify scheduler sheds NOTHING — light overload must never
+        # displace consensus verification.
+        "name": "light_swarm_follow",
+        "validators": 4,
+        "light_serve": {"rate_per_s": 40.0, "burst": 8, "queue": 64},
+        "steps": [
+            {"op": "wait_height", "delta": 2, "timeout": 60},
+            {"op": "light_swarm", "target": 0, "clients": 4},
+            {"op": "wait_height", "delta": 2, "timeout": 90},
+            # validator-power change mid-follow: the swarm must verify
+            # straight through the new set (the prewarm path builds
+            # its comb tables off the request path)
+            {"op": "promote", "node": 3, "power": 20},
+            {"op": "wait_height", "delta": 3, "timeout": 120},
+            {"op": "light_flood", "target": 0},
+            # consensus must keep committing THROUGH the light flood
+            {"op": "wait_height", "delta": 2, "timeout": 90},
+            {"op": "stop_light_swarm"},
+            {"op": "expect_light_heads", "min_delta": 3},
+            {"op": "expect_light_refusals", "min": 1},
+            {"op": "wait_height", "delta": 1, "timeout": 60},
         ],
     },
     {

@@ -305,6 +305,26 @@ class Node(BaseService):
                 batch=mc.ingress_batch, workers=mc.ingress_workers,
                 rate_per_s=mc.ingress_rate_per_s, burst=mc.ingress_burst,
                 recheck_slice=mc.ingress_recheck_slice)
+        # -- light serving plane (light/service.py, ADR-026) -----------
+        # config wins over a stale TM_TPU_LIGHT_SERVE env in BOTH
+        # directions; disabled, the light RPC routes answer
+        # service-disabled and the node's own verify paths are
+        # untouched
+        from tendermint_tpu.light import service as _lightsvc
+        _lightsvc.set_enabled(cfg.light_serve.enable)
+        self.light_serve = None
+        if _lightsvc.enabled():
+            lc = cfg.light_serve
+            self.light_serve = _lightsvc.LightServe(
+                self.block_store, self.state_store,
+                self.genesis.chain_id, queue_size=lc.queue,
+                batch=lc.batch, workers=lc.workers,
+                rate_per_s=lc.rate_per_s, burst=lc.burst,
+                max_cursors_per_client=lc.max_cursors_per_client,
+                max_cursors=lc.max_cursors,
+                cursor_batch=lc.cursor_batch, prewarm=lc.prewarm,
+                event_bus=self.event_bus)
+            _lightsvc.install(self.light_serve)
         self.evidence_pool = EvidencePool(ev_db, self.state_store,
                                           self.block_store)
 
@@ -585,6 +605,17 @@ class Node(BaseService):
                           queue=self.ingress_gate.queue_size,
                           workers=self.ingress_gate.workers,
                           batch=self.ingress_gate.batch)
+        # light serving plane (ADR-026): start AFTER the verify
+        # scheduler too — its COMMIT-class certificate checks route
+        # through the same coalescing windows from the first request,
+        # and its on_start prewarms the comb tables for the CURRENT
+        # validator set
+        if self.light_serve is not None:
+            self.light_serve.start()
+            self.log.info("light serving plane started",
+                          queue=self.light_serve.queue_size,
+                          workers=self.light_serve.workers,
+                          batch=self.light_serve.batch)
         self.indexer_service.start()
         self.switch.start()
         for addr in filter(None,
@@ -750,6 +781,10 @@ class Node(BaseService):
             # before consensus/app stop: pending admissions settle (as
             # busy) instead of racing a dying app connection
             self.ingress_gate.stop()
+        if getattr(self, "light_serve", None) is not None:
+            # same ordering contract: pending light verifications
+            # settle (as busy) before the stores go away
+            self.light_serve.stop()
         if self._consensus_started.is_set():
             self.consensus.stop()
         if hasattr(self.priv_validator, "close"):

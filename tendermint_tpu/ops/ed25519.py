@@ -24,6 +24,7 @@ Split of labor:
 """
 from __future__ import annotations
 
+import atexit
 import hashlib
 import threading
 import time
@@ -888,6 +889,11 @@ class DeviceLRU:
 # chain + maybe a light client's). ------------------------------------
 
 PUB_CACHE_MIN = 4096      # below this the tunnel RTT dominates anyway
+PREWARM_MIN_KEYS = 32     # the device-lane batch floor (crypto/batch
+# tpu_threshold): a set smaller than this never reaches the device, so
+# prewarming it would burn an XLA compile for tables nothing uses.
+# comb_min_batch() (TM_TPU_COMB_MIN / set_comb_config) lowers the
+# effective floor for kernel tests
 _PUB_CACHE_MAX = 4
 _pub_cache = DeviceLRU(max_entries=_PUB_CACHE_MAX)
 
@@ -1096,6 +1102,78 @@ def _table_lookup(uniq: np.ndarray):
             return None, None
         remap[i] = row
     return entry, remap
+
+
+def prewarm(pubkeys, warm_kernel: bool = True) -> bool:
+    """Build the comb tables for a validator set OFF the request path
+    (LightServe / node.py call this on validator-set change, ADR-026),
+    so the first post-change verify pays gathers, not a table build.
+
+    `warm_kernel` additionally runs one tiny throwaway verify against
+    the freshly cached set, priming the nb=64 comb-kernel shape and
+    marking the (comb, 64, 1) launch bucket seen — the first real
+    request then records ``first_launch=False`` and compiles nothing.
+    Returns True when the tables are resident (already or newly built);
+    False when the comb path is disabled, the HBM budget declined, or
+    the set is below the device-lane floor (batches that small never
+    dispatch to the device, so tables — and the XLA compile a build
+    pays — are pure waste; a dev-node stopping seconds after start
+    must not leave a background compile racing interpreter teardown)."""
+    if not comb_enabled() or table_cache_budget_bytes() <= 0:
+        return False
+    keys = list(pubkeys)
+    if len(keys) < min(PREWARM_MIN_KEYS, comb_min_batch()):
+        return False
+    if not keys:
+        return False
+    pub_m = _to_u8_matrix(keys, 32)
+    if pub_m.shape != (len(keys), 32):
+        return False
+    uniq = np.unique(pub_m, axis=0)
+    entry, _ = _table_lookup(uniq)
+    if entry is None:
+        from tendermint_tpu.parallel.sharding import data_plane
+        plane = data_plane()
+        entry = _table_build(
+            uniq, hashlib.sha256(uniq.tobytes()).digest(),
+            replicas=2 if plane is not None else 1)
+        if entry is None:
+            return False
+    if warm_kernel:
+        k = min(4, uniq.shape[0])
+        try:
+            verify_batch([uniq[i].tobytes() for i in range(k)],
+                         [b"tm-tpu-prewarm"] * k, [b"\x01" * 64] * k)
+        except Exception:  # noqa: BLE001 - warm-up is best-effort; the
+            pass           # tables above are already resident
+    return True
+
+
+def prewarm_async(pubkeys) -> None:
+    """Dispatch ``prewarm`` onto a host-lane pool worker (or a
+    throwaway daemon thread when host verification is serial) — the
+    off-path seam the valset-change subscribers use."""
+    keys = [bytes(k) for k in pubkeys]
+
+    def _run():
+        try:
+            prewarm(keys)
+        except Exception:  # noqa: BLE001 - warm path must never raise
+            pass
+
+    from tendermint_tpu.crypto import lanepool
+    p = lanepool.pool()
+    if p is not None and p.try_submit(_run) is not None:
+        return
+    # a prewarm can be deep inside an XLA compile when the process
+    # exits, and freezing the worker there leaves the compiler's C++
+    # thread pool joinable at static teardown — std::terminate.  The
+    # atexit join (which runs BEFORE that teardown) waits the compile
+    # out; the small-set decline in prewarm() keeps the wait off dev
+    # nodes, and a finished thread joins instantly.
+    t = threading.Thread(target=_run, name="comb-prewarm", daemon=True)
+    atexit.register(t.join)
+    t.start()
 
 
 def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):

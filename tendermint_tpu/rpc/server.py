@@ -97,6 +97,11 @@ class RPCServer(BaseService):
             # reference rpc/core/routes.go AddUnsafeRoutes (--rpc.unsafe)
             self.routes["dial_seeds"] = self.dial_seeds
             self.routes["dial_peers"] = self.dial_peers
+        # light-client serving plane (light/service.py, ADR-026):
+        # thin parse/encode shims over the node's LightServe; overload
+        # maps to the same RPC_BUSY_CODE class as mempool admission
+        from tendermint_tpu.rpc import light as light_rpc
+        light_rpc.register(self)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -334,3 +334,108 @@ def test_unresponsive_witness_removed_after_strikes():
         c.verify_light_block_at_height(h, NOW)
     assert w not in c.witnesses
     assert good in c.witnesses
+
+
+def test_client_racing_verifiers_thread_safe():
+    """Two-plus verifiers sharing ONE Client (the LightServe follow
+    path, ADR-026): trusted-state updates are serialized by the client
+    lock, so concurrent bisections never tear the store or regress
+    last_trusted_height — every stored height hash-matches the chain."""
+    import threading
+
+    gdoc, lbs = _light_chain(30)
+    c = _make_client(lbs, gdoc.chain_id)
+    errs = []
+
+    def worker(h):
+        try:
+            lb = c.verify_light_block_at_height(h, NOW)
+            assert lb.height == h
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(h,))
+               for h in (30, 17, 25, 9, 30, 17, 22, 5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errs, errs
+    assert c.last_trusted_height() == 30
+    for h in c.store.heights():
+        assert c.store.get(h).hash() == lbs[h].hash()
+    # the merged trusted state still drives update() correctly:
+    # already at the chain head, nothing newer to fetch
+    assert c.update(NOW) is None
+
+
+@pytest.mark.slow
+def test_trusting_cert_through_comb_subset_survives_eviction(monkeypatch):
+    """The LightServe certificate seam (verify_commit_light_trusting)
+    through the comb SUBSET index: the minimal >2/3 prefix of a
+    48-validator commit (33 sigs) verifies against the prewarmed
+    48-key tables without a build; after the set is evicted mid-stream
+    the same certificate degrades to the ladder — accept AND reject
+    verdicts (lowest-failing-index error included) are identical on
+    both paths."""
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.setenv("TM_TPU_NO_MESH", "1")
+    from tendermint_tpu.parallel import sharding
+    monkeypatch.setattr(sharding, "_PLANE", None)
+    from test_comb import _batch, _eager_kernels
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.types.validator_set import CommitVerifyError
+
+    rt = degrade.configure(registry=Registry("light_trusting_comb"))
+    edops.table_cache_clear()
+    _eager_kernels(monkeypatch)
+    monkeypatch.setattr(edops, "_comb_min_override", 1)
+    edops.set_comb_config(enabled=True, table_cache_mb=64)
+
+    gdoc, privs = make_genesis(48)
+    blocks, commits, states = build_chain(gdoc, privs, 2)
+    vals, commit = states[1].validators, commits[1]
+    level = Fraction(2, 3)
+
+    def reject_msg():
+        orig = commit.signatures[0].signature
+        commit.signatures[0].signature = bytes([orig[0] ^ 1]) + orig[1:]
+        try:
+            with pytest.raises(CommitVerifyError) as ei:
+                vals.verify_commit_light_trusting(gdoc.chain_id, commit,
+                                                  level)
+            return str(ei.value)
+        finally:
+            commit.signatures[0].signature = orig
+
+    try:
+        # tables resident BEFORE the request (the LightServe prewarm)
+        assert edops.prewarm(
+            [v.pub_key.bytes() for v in vals.validators],
+            warm_kernel=False)
+        vals.verify_commit_light_trusting(gdoc.chain_id, commit, level)
+        ll = edops.last_launch()
+        assert ll["path"] == "comb"
+        assert not ll["table_build"]  # 33-key subset of the cached 48
+        assert ll["n"] == 33
+        comb_reject = reject_msg()
+        assert "#0" in comb_reject
+
+        # mid-stream eviction: shrink the budget, build an unrelated
+        # set — the 48-key tables are the LRU victim
+        edops.set_comb_config(table_cache_mb=2)
+        p, m, s = _batch(12, pool=6, tag=b"evictor")
+        assert edops.verify_batch(p, m, s, cache_pubs=True).all()
+        assert rt.metrics.table_evictions.value() >= 1
+
+        # same certificate, ladder path now — identical verdicts
+        vals.verify_commit_light_trusting(gdoc.chain_id, commit, level)
+        assert edops.last_launch()["path"] == "xla"
+        assert reject_msg() == comb_reject
+    finally:
+        edops.table_cache_clear()
+        edops._comb_enabled_override = None
+        edops._table_budget_override = None
+        degrade.reset()

@@ -1,0 +1,756 @@
+"""LightServe serving plane (light/service.py, ADR-026).
+
+Tier-1 covers the plane's mechanics with countable stub certificates
+(no XLA compile): cross-client coalescing runs ONE shared verification
+per certificate identity (within a batch and across workers), refusal
+paths settle immediately with Retry-After (queue overflow, per-client
+rate limit, verify timeout, stopping service), chaos at light.serve /
+light.coalesce degrades to direct per-request verification with
+verdicts identical to the solo path, follow cursors advance over a
+real committed chain and evict least-recently-polled under pressure,
+and the comb prewarm pins path=comb / first_launch=False for the
+first post-change request.  The slow tier runs the acceptance wave
+with REAL kernels: N concurrent clients over one large validator set
+cost exactly one coalesced comb device launch and zero new XLA
+shapes, per-client verdicts identical to solo verification.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from helpers import build_chain, make_genesis
+from tendermint_tpu.libs import fail, trace
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.light import service as lightsvc
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.service import (LightRequest, LightServe,
+                                          LightVerdict)
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.basic import Timestamp
+from tendermint_tpu.types.light_block import SignedHeader
+
+PERIOD = 3600.0 * 24 * 14
+NOW = Timestamp(1700005000, 0)
+CHAIN = "light-serve-chain"
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    fail.reset()
+    yield
+    fail.reset()
+
+
+# ---------------------------------------------------------------------------
+# countable stub certificates: the "trusting" kind only needs
+# trusted_vals + untrusted.commit, so the coalescing identity and the
+# shared-execution mechanics are testable without signatures
+# ---------------------------------------------------------------------------
+
+
+class _StubBlockID:
+    def __init__(self, h):
+        self.hash = b"blk-%027d" % h
+
+
+class _StubCommit:
+    def __init__(self, h):
+        self.height = h
+        self.round = 0
+        self.block_id = _StubBlockID(h)
+
+
+class _StubHeader:
+    def __init__(self, h):
+        self.commit = _StubCommit(h)
+
+
+class _StubVals:
+    """A countable certificate verifier.  `calls` records every actual
+    verification execution — the coalescing assertions count THESE, not
+    settle events."""
+
+    def __init__(self, tag: bytes, fail_with=None, block_on=None,
+                 started=None):
+        self.tag = tag
+        self.calls = []
+        self.fail_with = fail_with
+        self.block_on = block_on
+        self.started = started
+
+    def hash(self):
+        return b"vals-" + self.tag
+
+    def verify_commit_light_trusting(self, chain_id, commit, trust_level):
+        if self.started is not None:
+            self.started.set()
+        if self.block_on is not None:
+            assert self.block_on.wait(30.0), "plug never released"
+        self.calls.append((chain_id, commit.height))
+        if self.fail_with is not None:
+            raise self.fail_with
+
+
+def _req(vals, h=5):
+    return LightRequest("trusting", CHAIN, trusted_vals=vals,
+                        untrusted=_StubHeader(h))
+
+
+def _svc(**kw):
+    kw.setdefault("prewarm", False)
+    return LightServe(BlockStore(MemDB()), StateStore(MemDB()), CHAIN,
+                      **kw)
+
+
+def _plug(svc):
+    """Occupy the (single) worker with a blocking certificate so later
+    submissions accumulate in the queue deterministically.  Returns
+    (release_event, plug_future)."""
+    release, started = threading.Event(), threading.Event()
+    vals = _StubVals(b"plug", block_on=release, started=started)
+    fut = svc.submit(_req(vals, h=999), client="plug")
+    assert started.wait(10.0), "worker never picked up the plug"
+    return release, fut
+
+
+# ---------------------------------------------------------------------------
+# coalescing: one shared execution per certificate identity
+# ---------------------------------------------------------------------------
+
+
+def test_same_certificate_coalesces_to_one_execution():
+    svc = _svc(workers=1, batch=256)
+    svc.start()
+    try:
+        release, plug_fut = _plug(svc)
+        vals = _StubVals(b"shared")
+        futs = [svc.submit(_req(vals), client=f"client-{i}")
+                for i in range(24)]
+        assert svc.depth() == 24
+        release.set()
+        assert plug_fut.result(timeout=30.0).ok
+        verdicts = [f.result(timeout=30.0) for f in futs]
+        assert all(v.ok for v in verdicts)
+        # 24 requests over the same (chain, valset, height) certificate
+        # ran ONE verification
+        assert len(vals.calls) == 1
+        st = svc.stats()
+        # plug leads its own group; the wave is one lead + 23 hits
+        assert st["coalesce_lead"] == 2
+        assert st["coalesce_hit"] == 23
+        assert st["verified"] == 25
+        # latency samples per client for the debug surface
+        assert len(svc._per_client_p99_ms()) == 25
+    finally:
+        svc.stop()
+
+
+def test_distinct_certificates_and_shared_failure_verdicts():
+    """Distinct identities each run once; a failing certificate refutes
+    EVERY coalesced waiter with the verifier's message — identical to
+    what the solo direct path answers."""
+    svc = _svc(workers=1, batch=256)
+    svc.start()
+    try:
+        release, plug_fut = _plug(svc)
+        good = _StubVals(b"good")
+        bad = _StubVals(
+            b"bad", fail_with=verifier.LightError("insufficient power"))
+        good_futs = [svc.submit(_req(good), client=f"g{i}")
+                     for i in range(4)]
+        bad_futs = [svc.submit(_req(bad, h=7), client=f"b{i}")
+                    for i in range(4)]
+        release.set()
+        assert plug_fut.result(timeout=30.0).ok
+        for f in good_futs:
+            assert f.result(timeout=30.0).ok
+        for f in bad_futs:
+            v = f.result(timeout=30.0)
+            assert not v.ok and v.error == "insufficient power"
+            assert v.retry_after_s is None  # refuted, not retryable
+        assert len(good.calls) == 1 and len(bad.calls) == 1
+        # the solo path answers the same verdicts
+        solo_ok = svc._verify_direct(_req(_StubVals(b"good2")))
+        solo_bad = svc._verify_direct(_req(_StubVals(
+            b"bad2", fail_with=verifier.LightError("insufficient power"))))
+        assert solo_ok.ok
+        assert not solo_bad.ok and solo_bad.error == "insufficient power"
+        assert svc.stats()["refuted"] == 4
+    finally:
+        svc.stop()
+
+
+def test_invalid_request_refused_at_header_stage():
+    svc = _svc()
+    svc.start()
+    try:
+        v = svc.verify(LightRequest("trusting", CHAIN,
+                                    untrusted=_StubHeader(5)),
+                       client="broken", timeout=10.0)
+        assert not v.ok and "trusting request needs" in v.error
+        st = svc.stats()
+        assert st["invalid"] == 1 and st["coalesce_lead"] == 0
+        # adjacent/non-adjacent height discipline is checked host-side
+        sh3, sh4, sh9 = _StubHeader(3), _StubHeader(4), _StubHeader(9)
+        for t, u, kind, msg in (
+                (sh3, sh9, "adjacent", "must be adjacent"),
+                (sh3, sh4, "non_adjacent", "must be non adjacent")):
+            v = svc.verify(
+                LightRequest(kind, CHAIN, trusted=_Hdr(t), untrusted=_Hdr(u),
+                             untrusted_vals=_StubVals(b"x"), now=NOW),
+                timeout=10.0)
+            assert not v.ok and msg in v.error
+    finally:
+        svc.stop()
+
+
+class _Hdr:
+    """Adds the .height the adjacent checks read to a stub header."""
+
+    def __init__(self, sh):
+        self.commit = sh.commit
+        self.height = sh.commit.height
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown light request kind"):
+        LightRequest("sideways", CHAIN)
+
+
+def test_cross_worker_inflight_dedupe():
+    """The cross-batch seam directly: a second worker hitting an
+    in-flight key becomes a follower — no second execution, shared
+    verdict (including the error case)."""
+    svc = _svc()
+    release, started = threading.Event(), threading.Event()
+    vals = _StubVals(b"inflight", block_on=release, started=started)
+    key, run = svc._cert_tasks(_req(vals))[0]
+    out = {}
+
+    def lead():
+        out["lead"] = svc._cert_verify(key, run, 1)
+
+    def follow():
+        assert started.wait(10.0)
+        out["follow"] = svc._cert_verify(key, run, 1)
+
+    t1 = threading.Thread(target=lead)
+    t2 = threading.Thread(target=follow)
+    t1.start()
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    t1.join(10.0)
+    t2.join(10.0)
+    assert out["lead"] is None and out["follow"] is None
+    assert len(vals.calls) == 1
+    st = svc.stats()
+    assert st["coalesce_lead"] == 1 and st["coalesce_hit"] == 1
+    # the in-flight map is drained — nothing leaks across requests
+    assert not svc._inflight
+
+
+# ---------------------------------------------------------------------------
+# chaos: light.serve / light.coalesce degrade to direct verification
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_light_serve_degrades_to_in_caller_direct():
+    svc = _svc()
+    svc.start()
+    try:
+        fail.set_mode("light.serve", "raise")
+        good, bad = _StubVals(b"cg"), _StubVals(
+            b"cb", fail_with=verifier.LightError("no quorum"))
+        f1 = svc.submit(_req(good), client="c")
+        f2 = svc.submit(_req(bad), client="c")
+        # settled synchronously in the caller — no queue, no worker
+        assert f1.done() and f2.done()
+        assert f1.result(0).ok
+        v2 = f2.result(0)
+        assert not v2.ok and v2.error == "no quorum"
+        assert fail.fired("light.serve", "raise") >= 2
+        st = svc.stats()
+        assert st["direct_path"] == 2 and st["coalesce_lead"] == 0
+        assert svc.depth() == 0
+    finally:
+        svc.stop()
+
+
+def test_chaos_light_coalesce_degrades_to_per_request_direct():
+    svc = _svc(workers=1, batch=256)
+    svc.start()
+    try:
+        fail.set_mode("light.coalesce", "raise")
+        release, started = threading.Event(), threading.Event()
+        plug_vals = _StubVals(b"plug2", block_on=release, started=started)
+        plug_fut = svc.submit(_req(plug_vals, h=999), client="plug")
+        assert started.wait(10.0)
+        vals = _StubVals(b"chaos")
+        futs = [svc.submit(_req(vals), client=f"c{i}") for i in range(6)]
+        release.set()
+        assert plug_fut.result(timeout=30.0).ok
+        assert all(f.result(timeout=30.0).ok for f in futs)
+        # degraded: per-request certificate runs, no dedupe — but the
+        # verdicts are identical to the coalesced plane's
+        assert len(vals.calls) == 6
+        assert fail.fired("light.coalesce", "raise") >= 2
+        st = svc.stats()
+        assert st["coalesce_direct"] == 7  # plug + the 6-wave
+        assert st["coalesce_lead"] == 0 and st["coalesce_hit"] == 0
+        assert st["verified"] == 7
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the front door: immediate refusals with Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_ratelimit_refusal_immediate_with_retry_after():
+    svc = _svc(rate_per_s=2.0, burst=1)
+    svc.start()
+    try:
+        vals = _StubVals(b"rl")
+        assert svc.verify(_req(vals), client="flooder", timeout=10.0).ok
+        f = svc.submit(_req(vals), client="flooder")
+        assert f.done()  # settled at submit, nothing queued
+        v = f.result(0)
+        assert not v.ok and "rate limited" in v.error
+        assert v.retry_after_s == pytest.approx(0.5)  # 1/rate
+        # another client has its own bucket
+        assert svc.verify(_req(vals), client="other", timeout=10.0).ok
+        st = svc.stats()
+        assert st["ratelimited"] == 1 and st["verified"] == 2
+    finally:
+        svc.stop()
+
+
+def test_set_rate_reclamps_live_buckets():
+    svc = _svc(rate_per_s=100.0, burst=50)
+    svc.start()
+    try:
+        vals = _StubVals(b"clamp")
+        assert svc.verify(_req(vals), client="c", timeout=10.0).ok
+        svc.set_rate(rate_per_s=0.001, burst=1)
+        # the clamp-down never grants saved-up tokens: the bucket was
+        # re-clamped to burst=1 and the refill rate is ~zero
+        assert svc.verify(_req(vals), client="c", timeout=10.0).ok
+        v = svc.submit(_req(vals), client="c").result(0)
+        assert not v.ok and v.retry_after_s is not None
+    finally:
+        svc.stop()
+
+
+def test_queue_overflow_busy_verdict():
+    svc = _svc(queue_size=4, batch=1, workers=1)
+    svc.start()
+    try:
+        release, plug_fut = _plug(svc)
+        vals = _StubVals(b"flood")
+        queued = [svc.submit(_req(vals), client=f"q{i}") for i in range(4)]
+        assert svc.depth() == 4
+        spill = svc.submit(_req(vals), client="spill")
+        assert spill.done()
+        v = spill.result(0)
+        assert not v.ok and v.error == "light serve is busy"
+        assert 0.1 <= v.retry_after_s <= 5.0
+        release.set()
+        assert plug_fut.result(timeout=30.0).ok
+        assert all(f.result(timeout=30.0).ok for f in queued)
+        st = svc.stats()
+        assert st["busy"] == 1 and st["verified"] == 5
+    finally:
+        svc.stop()
+
+
+def test_verify_timeout_maps_to_busy():
+    svc = _svc(workers=1)
+    svc.start()
+    try:
+        release, plug_fut = _plug(svc)
+        v = svc.verify(_req(_StubVals(b"slowpoke")), client="w",
+                       timeout=0.05)
+        assert not v.ok and v.retry_after_s is not None
+        assert "timed out" in v.error
+        release.set()
+        assert plug_fut.result(timeout=30.0).ok
+    finally:
+        svc.stop()
+
+
+def test_stop_settles_stranded_and_post_stop_goes_direct():
+    svc = _svc(queue_size=16, batch=1, workers=1)
+    svc.start()
+    release, plug_fut = _plug(svc)
+    vals = _StubVals(b"stranded")
+    stranded = [svc.submit(_req(vals), client=f"s{i}") for i in range(3)]
+    threading.Timer(0.2, release.set).start()
+    svc.stop()
+    for f in stranded:
+        v = f.result(timeout=10.0)
+        assert not v.ok and v.error == "light serve stopping"
+        assert v.retry_after_s is not None
+    assert plug_fut.result(timeout=10.0).ok
+    # a stopped service serves in-caller — correct answers, no queue
+    post = _StubVals(b"post")
+    f = svc.submit(_req(post), client="late")
+    assert f.done() and f.result(0).ok and len(post.calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# follow cursors over a real committed chain
+# ---------------------------------------------------------------------------
+
+
+def _chain_stores(n_heights=6, n_vals=4):
+    from tendermint_tpu.blocksync.replay import block_id_of
+    from tendermint_tpu.state.state import state_from_genesis
+
+    gdoc, privs = make_genesis(n_vals)
+    blocks, commits, states = build_chain(gdoc, privs, n_heights)
+    block_store, state_store = BlockStore(MemDB()), StateStore(MemDB())
+    for b, c in zip(blocks, commits):
+        _bid, parts = block_id_of(b)
+        block_store.save_block(b, parts, c)
+    state_store.save(state_from_genesis(gdoc))  # height-1 validators
+    for st in states:
+        state_store.save(st)
+    return gdoc, blocks, block_store, state_store
+
+
+def test_follow_cursor_subscribe_poll_advance():
+    gdoc, blocks, block_store, state_store = _chain_stores(6)
+    svc = LightServe(block_store, state_store, gdoc.chain_id,
+                     cursor_batch=4, prewarm=False)
+    cid = svc.subscribe("alice")
+    out = svc.poll(cid)
+    assert [lb.height for lb in out] == [1, 2, 3, 4]
+    # each served light block carries the committed header + the
+    # certifying commit + that height's validator set
+    for lb in out:
+        assert lb.signed_header.header.hash() == \
+            blocks[lb.height - 1].header.hash()
+        assert lb.signed_header.commit.height == lb.height
+        assert not lb.validators.is_nil_or_empty()
+    out = svc.poll(cid)
+    assert [lb.height for lb in out] == [5, 6]  # top uses seen commit
+    assert svc.poll(cid) == []  # caught up
+    # explicit from_height and a bounded max_items
+    cid2 = svc.subscribe("bob", from_height=4)
+    assert [lb.height for lb in svc.poll(cid2, max_items=2)] == [4, 5]
+    svc.unsubscribe(cid2)
+    assert svc.poll(cid2) is None
+    assert svc.stats()["polled"] == 8
+
+
+def test_follow_cursor_eviction_per_client_and_global():
+    gdoc, _blocks, block_store, state_store = _chain_stores(3)
+    svc = LightServe(block_store, state_store, gdoc.chain_id,
+                     max_cursors_per_client=2, max_cursors=3,
+                     prewarm=False)
+    a1 = svc.subscribe("alice")
+    a2 = svc.subscribe("alice")
+    svc.poll(a1)  # a1 freshly polled: a2 is now alice's stalest
+    a3 = svc.subscribe("alice")  # per-client bound: evicts a2
+    assert svc.poll(a2) is None and svc.poll(a1) is not None
+    b1 = svc.subscribe("bob")
+    c1 = svc.subscribe("carol")  # global bound (3): evicts stalest
+    assert svc.poll(c1) is not None and svc.poll(b1) is not None
+    rep = svc.report()
+    assert rep["cursors"]["total"] <= 3
+    assert svc.stats()["cursors_evicted"] >= 2
+    assert a3 is not None
+
+
+def test_report_shape_and_coalesce_ratio():
+    svc = _svc()
+    svc.start()
+    try:
+        vals = _StubVals(b"rep")
+        assert svc.verify(_req(vals), client="r", timeout=10.0).ok
+        rep = svc.report()
+        assert rep["running"] and rep["chain_id"] == CHAIN
+        assert rep["stats"]["verified"] == 1
+        assert 0.0 <= rep["coalesce_ratio"] <= 1.0
+        assert rep["config"]["queue"] == svc.queue_size
+        assert "per_client_p99_ms" in rep and "r" in rep["per_client_p99_ms"]
+        # module surface (GET /debug/light reads this)
+        lightsvc.install(svc)
+        try:
+            assert lightsvc.report()["running"]
+        finally:
+            lightsvc.install(None)
+        assert lightsvc.report() == {"enabled": lightsvc.enabled(),
+                                     "running": False}
+    finally:
+        svc.stop()
+
+
+def test_enable_config_wins_over_env(monkeypatch):
+    monkeypatch.setenv("TM_TPU_LIGHT_SERVE", "0")
+    lightsvc.set_enabled(None)
+    try:
+        assert not lightsvc.enabled()
+        lightsvc.set_enabled(True)   # config wins over the stale env
+        assert lightsvc.enabled()
+        monkeypatch.setenv("TM_TPU_LIGHT_SERVE", "1")
+        lightsvc.set_enabled(False)  # ...in both directions
+        assert not lightsvc.enabled()
+    finally:
+        lightsvc.set_enabled(None)
+
+
+def test_config_light_serve_roundtrip(tmp_path):
+    from tendermint_tpu.config.config import Config
+
+    cfg = Config(home=str(tmp_path))
+    assert cfg.light_serve.enable is True
+    cfg.light_serve.enable = False
+    cfg.light_serve.queue = 128
+    cfg.light_serve.rate_per_s = 40.0
+    cfg.light_serve.burst = 8
+    cfg.save()
+    back = Config.load(str(tmp_path))
+    assert back.light_serve.enable is False
+    assert back.light_serve.queue == 128
+    assert back.light_serve.rate_per_s == pytest.approx(40.0)
+    assert back.light_serve.burst == 8
+    back.validate_basic()
+    back.light_serve.queue = 0
+    with pytest.raises(ValueError, match="light_serve.queue"):
+        back.validate_basic()
+
+
+# ---------------------------------------------------------------------------
+# prewarm (satellite: ops/ed25519.prewarm/prewarm_async)
+# ---------------------------------------------------------------------------
+
+
+def test_prewarm_pins_comb_path_first_launch_false(monkeypatch):
+    """After a valset-change prewarm, the FIRST real request finds the
+    tables resident and the kernel shape seen: path=comb,
+    first_launch=False, no table build on the request path."""
+    from test_comb import _batch, _stub_kernels
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.ops import ed25519 as edops
+
+    degrade.configure(registry=Registry("light_prewarm"))
+    edops.table_cache_clear()
+    rec = {}
+    _stub_kernels(monkeypatch, record=rec)
+    monkeypatch.setattr(edops, "_comb_min_override", 1)
+    try:
+        pubs, msgs, sigs = _batch(12, pool=6, tag=b"warmset")
+        assert edops.prewarm(pubs)
+        assert rec["builds"] == [8]  # tables built off the request path
+        # the first "request": same set, scheduler-lane shape (no
+        # cache_pubs) — comb hit, bucket already seen, zero builds
+        assert edops.verify_batch(pubs, msgs, sigs).all()
+        ll = edops.last_launch()
+        assert ll["path"] == "comb"
+        assert ll["first_launch"] is False
+        assert not ll["table_build"] and rec["builds"] == [8]
+        # prewarm is idempotent — resident tables short-circuit
+        assert edops.prewarm(pubs, warm_kernel=False)
+        assert rec["builds"] == [8]
+    finally:
+        edops.table_cache_clear()
+        degrade.reset()
+
+
+def test_prewarm_async_lands_off_thread(monkeypatch):
+    from test_comb import _batch, _stub_kernels
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.ops import ed25519 as edops
+
+    degrade.configure(registry=Registry("light_prewarm_async"))
+    edops.table_cache_clear()
+    rec = {}
+    _stub_kernels(monkeypatch, record=rec)
+    monkeypatch.setattr(edops, "_comb_min_override", 1)
+    try:
+        pubs, _msgs, _sigs = _batch(12, pool=6, tag=b"asyncset")
+        done = threading.Event()
+        orig = edops.prewarm
+
+        def _tracked(keys, warm_kernel=True):
+            try:
+                return orig(keys, warm_kernel=warm_kernel)
+            finally:
+                done.set()
+
+        monkeypatch.setattr(edops, "prewarm", _tracked)
+        edops.prewarm_async(pubs)
+        # wait for the WHOLE prewarm (tables + kernel warm) so the
+        # worker never outlives the stubbed kernels
+        assert done.wait(10.0)
+        assert rec.get("builds") == [8]
+    finally:
+        edops.table_cache_clear()
+        degrade.reset()
+
+
+def test_service_prewarms_current_set_on_start():
+    """on_start warms the CURRENT set (nobody waits for a valset change)
+    and the valset watcher prewarms again on the update event."""
+    from tendermint_tpu.types.event_bus import EventBus
+
+    gdoc, _blocks, block_store, state_store = _chain_stores(3)
+    bus = EventBus()
+    calls = []
+    svc = LightServe(block_store, state_store, gdoc.chain_id,
+                     prewarm=True, event_bus=bus)
+
+    import tendermint_tpu.ops.ed25519 as edops
+    orig = edops.prewarm_async
+    edops.prewarm_async = lambda keys: calls.append(len(list(keys)))
+    try:
+        svc.start()
+        assert calls and calls[0] == 4  # the current 4-validator set
+        bus.publish_validator_set_updates([])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(calls) < 2:
+            time.sleep(0.01)
+        assert len(calls) >= 2
+        assert svc.stats()["prewarms"] >= 2
+    finally:
+        edops.prewarm_async = orig
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# locksan: the serving plane's four locks under concurrent clients
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.locksan
+def test_locksan_concurrent_serve_hammer():
+    """A fresh LightServe built UNDER the lockset monitor (its _cond /
+    _rl_lock / _cur_lock / _stats_lock are wrapped and ranked), hammered
+    by concurrent submitters, followers and report readers — the
+    declared ordering holds (the conftest fixture fails the test on any
+    inversion) and every settled verdict is correct."""
+    gdoc, _blocks, block_store, state_store = _chain_stores(3)
+    svc = LightServe(block_store, state_store, gdoc.chain_id,
+                     workers=2, rate_per_s=10_000.0, burst=10_000,
+                     prewarm=False)
+    svc.start()
+    stop = threading.Event()
+    bad = []
+
+    def submitter(k):
+        vals = _StubVals(b"hammer-%d" % (k % 2))
+        for _ in range(200):
+            v = svc.verify(_req(vals), client=f"h{k}", timeout=30.0)
+            if not (v.ok or v.retry_after_s is not None):
+                bad.append(v.error)
+
+    def follower(k):
+        while not stop.is_set():
+            cid = svc.subscribe(f"f{k}")
+            svc.poll(cid)
+            svc.unsubscribe(cid)
+
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(4)] + \
+        [threading.Thread(target=follower, args=(k,)) for k in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(30):
+            svc.report()
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        svc.stop()
+    assert not bad, bad
+    st = svc.stats()
+    assert st["verified"] == 800 and st["refuted"] == 0
+    # every execution was a lead or a coalesced hit — none lost
+    assert st["coalesce_lead"] + st["coalesce_hit"] == 800
+
+
+# ---------------------------------------------------------------------------
+# slow: the acceptance wave with REAL kernels — one coalesced comb
+# launch for N clients, zero new XLA shapes, solo-identical verdicts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_acceptance_wave_one_coalesced_comb_launch(monkeypatch):
+    monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
+    monkeypatch.setenv("TM_TPU_NO_MESH", "1")
+    from tendermint_tpu.parallel import sharding
+    monkeypatch.setattr(sharding, "_PLANE", None)
+    from tendermint_tpu.crypto import degrade
+    from tendermint_tpu.devtools.tmlint.runtime import CompileSentinel
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.ops import ed25519 as edops
+
+    degrade.configure(registry=Registry("light_accept"))
+    edops.table_cache_clear()
+    monkeypatch.setattr(edops, "_comb_min_override", 1)
+
+    # 48 validators: the minimal >2/3 commit prefix is 33 signatures,
+    # over the device-lane threshold (32) — the certificate is a real
+    # comb launch, not a host-lane verify
+    gdoc, privs = make_genesis(48)
+    blocks, commits, states = build_chain(gdoc, privs, 2)
+    trusted = SignedHeader(blocks[0].header, commits[0])
+    untrusted = SignedHeader(blocks[1].header, commits[1])
+    vals = states[1].validators
+
+    def req():
+        return LightRequest("adjacent", gdoc.chain_id, trusted=trusted,
+                            untrusted=untrusted, untrusted_vals=vals,
+                            now=NOW, trusting_period_s=PERIOD)
+
+    svc = LightServe(BlockStore(MemDB()), StateStore(MemDB()),
+                     gdoc.chain_id, workers=1, batch=256, prewarm=False)
+    svc.start()
+    try:
+        # solo baseline + warm: tables and the nb=64 comb shape land
+        # BEFORE the measured wave
+        assert edops.prewarm([v.pub_key.bytes() for v in vals.validators])
+        solo = svc._verify_direct(req())
+        assert solo.ok, solo.error
+
+        trace.enable(capacity=1 << 14)
+        since = trace.last_seq()
+        sentinel = CompileSentinel(max_new_compiles=0).start()
+
+        release, plug_fut = _plug(svc)
+        futs = [svc.submit(req(), client=f"client-{i}") for i in range(12)]
+        release.set()
+        assert plug_fut.result(timeout=60.0).ok
+        verdicts = [f.result(timeout=120.0) for f in futs]
+        # per-client verdicts identical to the solo baseline
+        assert all(v.ok == solo.ok and v.error == solo.error
+                   for v in verdicts)
+
+        sentinel.check()  # zero new kernel compiles, no new bucket
+        spans = trace.snapshot(since)
+        coal = [r for r in spans if r["name"] == "light.coalesce"
+                and r["attrs"].get("cls") == "light"]
+        assert len(coal) == 1, coal  # ONE shared certificate execution
+        assert coal[0]["attrs"]["waiters"] == 12
+        launches = [r for r in spans if r["name"] == "device.launch"]
+        assert len(launches) == 1, launches  # ONE comb launch, period
+        ll = edops.last_launch()
+        assert ll["path"] == "comb" and ll["first_launch"] is False
+        st = svc.stats()
+        assert st["coalesce_hit"] >= 11
+    finally:
+        svc.stop()
+        edops.table_cache_clear()
+        degrade.reset()

@@ -395,6 +395,7 @@ CHAOS_TEST_FILES = ("test_chaos_matrix.py", "test_comb.py",
                     "test_control.py", "test_degrade.py",
                     "test_devobs.py", "test_ingress.py",
                     "test_latency_observatory.py",
+                    "test_light_serve.py",
                     "test_netharness.py", "test_netobs.py",
                     "test_observatory.py",
                     "test_pipeline.py", "test_propose_fastpath.py",

@@ -97,8 +97,8 @@ def test_mixed_batch_host_lanes_overlap_device_lane(monkeypatch):
     secp256k1 and sr25519 host lanes run on two DISTINCT host-pool
     worker threads, their spans overlap each other in time, and both
     overlap the ed25519 device launch — with the bitmap byte-identical
-    to the per-item host oracle.  Injected latency (50 ms at the host
-    C seam, 50 ms at the device kernel seam) makes every lane's span
+    to the per-item host oracle.  Injected latency (120 ms at the host
+    C seam, 120 ms at the device kernel seam) makes every lane's span
     long enough that real concurrency is the only way the overlap
     assertions can hold; the generous margins keep slow-CI noise out."""
     monkeypatch.setenv("TM_TPU_FORCE_BATCH", "1")
@@ -122,8 +122,8 @@ def test_mixed_batch_host_lanes_overlap_device_lane(monkeypatch):
     assert ok
 
     # stretch every lane so overlap is unambiguous in the trace
-    fail.set_mode("lanepool.verify", "latency:50")
-    fail.set_mode("ops.ed25519.verify_batch", "latency:50")
+    fail.set_mode("lanepool.verify", "latency:120")
+    fail.set_mode("ops.ed25519.verify_batch", "latency:120")
     was_enabled = trace.is_enabled()
     trace.enable()
     seq0 = trace.last_seq()
