@@ -1817,12 +1817,234 @@ def _light_main():
           f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
 
 
+def _mesh_leg_worker():
+    """One mesh-scaling leg (BENCH_MESH_WORKER=<ndev>), run in its own
+    process so the XLA_FLAGS host-device forcing and — for the global
+    leg (BENCH_MESH_NPROC=2) — jax.distributed initialization see a
+    fresh runtime.  Drives the PRODUCTION ops/ed25519.verify_batch seam
+    (the local overlapped mesh plane, or the ADR-027 global plane under
+    lockstep when distributed), and writes one JSON record to
+    $BENCH_MESH_OUT for the parent to aggregate.  On a backend without
+    multi-process computations the global leg degrades through the
+    plane's latch-off and reports global_latched_off=true — the capture
+    stays honest instead of dying rc=1."""
+    import jax
+
+    # the platform must be forced via config, not env alone: this image
+    # pre-imports jax with the tunneled-TPU plugin (see tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
+    nproc = int(os.environ.get("BENCH_MESH_NPROC", "1"))
+    pid = int(os.environ.get("BENCH_MESH_PID", "0"))
+    if nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=os.environ["BENCH_MESH_COORD"],
+            num_processes=nproc, process_id=pid)
+    n = int(os.environ.get("BENCH_MESH_BATCH", "4096"))
+    rounds = int(os.environ.get("BENCH_MESH_ROUNDS", str(ROUNDS)))
+    pubs, msgs, sigs = _make_batch_selfhosted(n)
+
+    from tendermint_tpu.crypto import devobs
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.parallel import sharding as shd
+
+    devobs.enable()  # the leg's record wants the chunk_overlap ratio
+
+    def once():
+        if nproc > 1:
+            with shd.lockstep():
+                return edops.verify_batch(pubs, msgs, sigs)
+        return edops.verify_batch(pubs, msgs, sigs)
+
+    # warmup compiles the leg's bucket(s); correctness stays LOUD
+    assert np.asarray(once()).all(), "mesh leg rejected valid signatures"
+    rates = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        out = once()
+        rates.append(n / (time.perf_counter() - t0))
+        assert np.asarray(out).all()
+    ll = edops.last_launch()
+    with open(os.environ["BENCH_MESH_OUT"], "w") as f:
+        json.dump({
+            "ndev": len(jax.devices()), "nproc": nproc, "pid": pid,
+            "sigs_per_s": round(max(rates), 1),
+            "median_sigs_per_s": round(float(np.median(rates)), 1),
+            "path": ll.get("path"), "shards": ll.get("shards"),
+            "chunk_overlap": ll.get("chunk_overlap"),
+            "global_latched_off": shd._GLOBAL_PLANE is False,
+        }, f)
+
+
+def run_mesh_scaling(counts=(1, 2, 4, 8), batch=None, rounds=None,
+                     include_global=True, timeout_s=900.0) -> dict:
+    """Mesh-scaling core (shared by BENCH_MESH=1 and bench_report
+    config17; ADR-027): one subprocess per device count, each forcing
+    <ndev> host CPU devices and pushing the same self-signed batch
+    through the production verify_batch seam, plus the 2-process x
+    4-device global-mesh leg (jax.distributed over loopback).  Every
+    leg is a fresh process because XLA fixes the device count at
+    backend init.  Returns {"rows", "global", "failures", ...};
+    scaling_efficiency is rate_N / (N * rate_1) against the 1-device
+    leg.  A leg that dies or times out lands in "failures" with its
+    log tail — the callers degrade it to a host-fallback line (rc=0),
+    never a crash."""
+    import socket
+    import subprocess
+    import tempfile
+
+    if batch is None:
+        batch = int(os.environ.get("BENCH_MESH_BATCH", "4096"))
+    if rounds is None:
+        rounds = int(os.environ.get("BENCH_MESH_ROUNDS", str(ROUNDS)))
+    tmp = tempfile.mkdtemp(prefix="bench_mesh_")
+    me = os.path.abspath(__file__)
+
+    def spawn(ndev, tag, nproc=1, coord="", pid=0):
+        out = os.path.join(tmp, f"leg_{tag}.{pid}.json")
+        log = os.path.join(tmp, f"leg_{tag}.{pid}.log")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
+        env.pop("TM_TPU_NO_MESH", None)
+        env.pop("BENCH_MESH", None)
+        env.update({"BENCH_MESH_WORKER": str(ndev),
+                    "BENCH_MESH_OUT": out,
+                    "BENCH_MESH_BATCH": str(batch),
+                    "BENCH_MESH_ROUNDS": str(rounds),
+                    "BENCH_MESH_NPROC": str(nproc),
+                    "BENCH_MESH_PID": str(pid),
+                    "BENCH_MESH_COORD": coord})
+        return subprocess.Popen([sys.executable, me], env=env,
+                                stdout=open(log, "wb"),
+                                stderr=subprocess.STDOUT), out, log
+
+    def harvest(procs, leg_name):
+        recs = []
+        for p, out, log in procs:
+            try:
+                p.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            if p.returncode == 0 and os.path.exists(out):
+                with open(out) as f:
+                    recs.append(json.load(f))
+            else:
+                tail = ""
+                if os.path.exists(log):
+                    with open(log, errors="replace") as f:
+                        tail = f.read()[-800:]
+                failures.append({"leg": leg_name, "rc": p.returncode,
+                                 "tail": tail})
+                return None
+        return recs
+
+    rows, failures = [], []
+    for ndev in counts:
+        recs = harvest([spawn(ndev, f"{ndev}dev")], f"{ndev}dev")
+        if recs:
+            rows.append(recs[0])
+
+    gl = None
+    if include_global and os.environ.get("BENCH_MESH_GLOBAL") != "0":
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        recs = harvest([spawn(4, "global", nproc=2, coord=coord, pid=k)
+                        for k in range(2)], "global")
+        if recs:
+            gl = recs[0]  # pid 0's record; both verified identically
+
+    base = next((r for r in rows if r["ndev"] == 1), None)
+    for r in rows + ([gl] if gl else []):
+        if base and base["sigs_per_s"]:
+            r["scaling_efficiency"] = round(
+                r["sigs_per_s"] / (r["ndev"] * base["sigs_per_s"]), 3)
+    return {"rows": rows, "global": gl, "failures": failures,
+            "batch": batch, "rounds": rounds}
+
+
+def _mesh_main():
+    """Mesh-scaling config (BENCH_MESH=1, ADR-027, bench_report
+    config17): per-device-count sigs/s through the production
+    verify_batch seam on forced host devices, the staging
+    chunk_overlap ratio, scaling efficiency vs the 1-device leg, and
+    the 2-process global-mesh leg.  One rc=0 JSON line per leg
+    (host-fallback note for a dead leg), each appended to
+    bench_history so bench_trend gets a per-device-count series."""
+    t_start = time.time()
+    from tendermint_tpu.crypto import ed25519 as edkeys
+
+    nbase = 400
+    bpubs, bmsgs, bsigs = _make_batch_selfhosted(nbase)
+    keys = [edkeys.PubKey(p) for p in bpubs]
+    t0 = time.perf_counter()
+    for i in range(nbase):
+        assert keys[i].verify_signature(bmsgs[i], bsigs[i])
+    cpu_rate = nbase / (time.perf_counter() - t0)
+
+    counts = tuple(int(x) for x in os.environ.get(
+        "BENCH_MESH_DEVS", "1,2,4,8").split(","))
+    r = run_mesh_scaling(counts=counts)
+    for row in r["rows"]:
+        _emit({
+            "metric": f"ed25519_mesh_verify_{row['ndev']}dev",
+            "value": row["sigs_per_s"],
+            "unit": "sigs/s",
+            "vs_baseline": round(row["sigs_per_s"] / cpu_rate, 2),
+            "median_value": row["median_sigs_per_s"],
+            "chunk_overlap": row.get("chunk_overlap"),
+            "scaling_efficiency": row.get("scaling_efficiency"),
+            "note": (f"path={row.get('path')} shards={row.get('shards')} "
+                     f"forced host devices, batch={r['batch']}"),
+        })
+    gl = r["global"]
+    if gl is not None:
+        note = (f"global-mesh 2proc x 4dev, batch={r['batch']}"
+                if gl.get("path") == "global-mesh" else
+                "global plane latched off (backend lacks multi-process "
+                f"computations), local-mesh degrade path={gl.get('path')}")
+        _emit({
+            "metric": "ed25519_mesh_verify_global_2x4",
+            "value": gl["sigs_per_s"],
+            "unit": "sigs/s",
+            "vs_baseline": round(gl["sigs_per_s"] / cpu_rate, 2),
+            "median_value": gl["median_sigs_per_s"],
+            "chunk_overlap": gl.get("chunk_overlap"),
+            "scaling_efficiency": gl.get("scaling_efficiency"),
+            "global_latched_off": gl.get("global_latched_off"),
+            "note": note,
+        })
+    for f in r["failures"]:
+        # same degrade contract as every other config: the leg's line
+        # still emits (rc=0) with the host number and an explicit note
+        _emit({
+            "metric": f"ed25519_mesh_verify_{f['leg']}",
+            "value": round(cpu_rate, 1),
+            "unit": "sigs/s",
+            "vs_baseline": 1.0,
+            "note": "device unavailable, host fallback",
+        })
+        print(f"# mesh leg {f['leg']} failed rc={f['rc']}: {f['tail']}",
+              file=sys.stderr)
+    print(f"# mesh bench: cpu_baseline={cpu_rate:.0f}/s "
+          f"legs={[row['ndev'] for row in r['rows']]} "
+          f"global={'ok' if gl else 'failed/skipped'} "
+          f"total_bench_s={time.time()-t_start:.0f}", file=sys.stderr)
+
+
 def main():
     # flight recorder on for the whole bench: every JSON line carries a
     # "trace" artifact path so the capture explains itself (which route,
     # what occupancy, compile vs execute) instead of being one number
     from tendermint_tpu.libs import trace
     trace.enable(capacity=1 << 15)
+    if os.environ.get("BENCH_MESH_WORKER"):
+        _mesh_leg_worker()
+        return
+    if os.environ.get("BENCH_MESH") == "1":
+        _mesh_main()
+        return
     if os.environ.get("BENCH_LIGHT") == "1":
         _light_main()
         return

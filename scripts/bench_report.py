@@ -692,6 +692,36 @@ def config16_light(validators=48, heights=12, clients=16):
             "validators": r["validators"]}
 
 
+def config17_mesh(counts=(1, 2, 4), batch=1024):
+    """Global mesh data plane (parallel/sharding.py, ADR-027): forced-
+    host-device scaling legs through the production verify_batch seam
+    plus the 2-process global-mesh leg, each in its own subprocess
+    (XLA fixes the device count at backend init, so in-process legs
+    are impossible).  Columns mirror the BENCH_MESH=1 bench.py lines:
+    per-device-count sigs/s, the staging chunk_overlap ratio, and
+    scaling efficiency rate_N / (N * rate_1)."""
+    from bench import run_mesh_scaling
+
+    r = run_mesh_scaling(counts=counts, batch=batch)
+    line = {"config": f"17: mesh scaling {'x'.join(map(str, counts))}dev "
+                      f"batch={batch}"}
+    for row in r["rows"]:
+        nd = row["ndev"]
+        line[f"sigs_per_s_{nd}dev"] = row["sigs_per_s"]
+        line[f"scaling_eff_{nd}dev"] = row.get("scaling_efficiency")
+        if row.get("chunk_overlap") is not None:
+            line[f"chunk_overlap_{nd}dev"] = row["chunk_overlap"]
+    gl = r.get("global")
+    if gl:
+        line["global_sigs_per_s"] = gl["sigs_per_s"]
+        line["global_path"] = gl.get("path")
+        line["global_latched_off"] = gl.get("global_latched_off")
+        line["global_scaling_eff"] = gl.get("scaling_efficiency")
+    if r["failures"]:
+        line["failed_legs"] = [f["leg"] for f in r["failures"]]
+    return line
+
+
 def main():
     import json
 
@@ -713,7 +743,8 @@ def main():
            config5_mixed, config6_verify_commit_100k, config7_rlc_sharded,
            config8_scheduler, config9_comb, config10_mempool,
            config11_consensus, config12_statesync, config13_control,
-           config14_propose, config15_gossip, config16_light)
+           config14_propose, config15_gossip, config16_light,
+           config17_mesh)
     only = os.environ.get("BENCH_ONLY", "")
     # round-over-round context (ISSUE 8): each config line carries
     # delta-vs-previous-round columns against the append-only

@@ -160,6 +160,10 @@ def build_series(rounds: list, history: list) -> dict:
             # carried one): compile_frac feeds the compile-inflation
             # exclusion in trend_rows
             "device": rec.get("device"),
+            # ADR-027 mesh-scaling columns (BENCH_MESH lines): the
+            # staging overlap ratio and rate_N/(N*rate_1) efficiency
+            "chunk_overlap": rec.get("chunk_overlap"),
+            "scaling_efficiency": rec.get("scaling_efficiency"),
         })
     return series
 
@@ -243,11 +247,14 @@ def render(summary: list, series_rows: dict, multichip: list) -> str:
         rows = series_rows[key]
         lines += ["", f"## trend: {key}"]
         lines.append(f"{'label':>14} {'value':>12} {'delta%':>8} "
-                     f"{'vs_base':>8}  flag")
+                     f"{'vs_base':>8} {'overlap':>8} {'scaleff':>8}  flag")
         for o in rows:
             lines.append(f"{o['label']:>14} {_fmt(o['value']):>12} "
                          f"{_fmt(o['delta_vs_prev_pct']):>8} "
-                         f"{_fmt(o.get('vs_baseline')):>8}  {o['flag']}")
+                         f"{_fmt(o.get('vs_baseline')):>8} "
+                         f"{_fmt(o.get('chunk_overlap')):>8} "
+                         f"{_fmt(o.get('scaling_efficiency')):>8}  "
+                         f"{o['flag']}")
     if multichip:
         lines += ["", "## multichip dryruns (MULTICHIP_r*.json)"]
         lines.append(f"{'round':>6} {'rc':>3} {'ok':>5} {'devices':>8}")

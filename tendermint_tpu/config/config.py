@@ -406,7 +406,8 @@ class ControlConfig:
     # one row per governed knob (libs/control.KNOB_SPECS)
     KNOBS = ("sched_window_ms", "host_pool_workers",
              "ingress_rate_per_s", "ingress_burst", "pipeline_depth",
-             "statesync_fetchers", "comb_min_batch")
+             "statesync_fetchers", "comb_min_batch",
+             "mesh_chunk_lanes")
 
     enable: bool = False
     period_ms: float = 1000.0   # decision-loop period
@@ -432,6 +433,9 @@ class ControlConfig:
     comb_min_batch_min: float = 16.0
     comb_min_batch_max: float = 4096.0
     comb_min_batch_step: float = 16.0
+    mesh_chunk_lanes_min: float = 1024.0
+    mesh_chunk_lanes_max: float = 65536.0
+    mesh_chunk_lanes_step: float = 1024.0
 
     def range_of(self, knob: str) -> tuple:
         return (getattr(self, f"{knob}_min"),
@@ -689,6 +693,9 @@ statesync_fetchers_step = {self.control.statesync_fetchers_step}
 comb_min_batch_min = {self.control.comb_min_batch_min}
 comb_min_batch_max = {self.control.comb_min_batch_max}
 comb_min_batch_step = {self.control.comb_min_batch_step}
+mesh_chunk_lanes_min = {self.control.mesh_chunk_lanes_min}
+mesh_chunk_lanes_max = {self.control.mesh_chunk_lanes_max}
+mesh_chunk_lanes_step = {self.control.mesh_chunk_lanes_step}
 
 [light_serve]
 enable = {str(self.light_serve.enable).lower()}

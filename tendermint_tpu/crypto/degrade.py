@@ -339,6 +339,15 @@ class DeviceLaneRuntime:
                 self._backend_backoff = self.cfg.backoff_base_s
             self.metrics.backend_probes.inc(
                 result="accelerator" if ok else "cpu")
+            # a successful probe is the one moment the device topology
+            # can have changed under a latched mesh plane (the backend
+            # came up after the plane's first look) — let the plane
+            # rebuild itself against the live device list (ADR-027)
+            try:
+                from tendermint_tpu.parallel import sharding
+                sharding.invalidate_on_topology_change()
+            except Exception:  # noqa: BLE001 - plane upkeep must not
+                pass            # fail a backend probe
             return ok
         except Exception:
             with self._backend_lock:
@@ -366,12 +375,21 @@ class DeviceLaneRuntime:
         self.metrics.device_launches.inc(site=site)
         # the launch runs on the lane worker thread: capture the caller's
         # span id HERE so the worker's span links into the caller's tree
-        # (the thread-local stack doesn't cross the pool boundary)
+        # (the thread-local stack doesn't cross the pool boundary).
+        # The lockstep mark (parallel/sharding, ADR-027) is thread-local
+        # for the same reason and crosses the boundary the same way —
+        # without re-arming it on the worker, a coordinated caller's
+        # batch would silently lose its global-mesh eligibility here
         parent = trace.current_id()
+        from tendermint_tpu.parallel import sharding
+        locked = sharding.in_lockstep()
 
         def _launch():
             with trace.span("device.launch", parent=parent, site=site):
                 fail.inject(site)
+                if locked:
+                    with sharding.lockstep():
+                        return fn(*args)
                 return fn(*args)
         try:
             return self._get_pool().submit(_launch)

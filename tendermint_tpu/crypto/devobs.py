@@ -101,7 +101,8 @@ PHASES = ("stage_s", "h2d_s", "compute_s", "collect_s", "drain_s")
 # ledger pools the instrumented sites feed today; ledger_set/add accept
 # any pool name (the gauge is labeled), this tuple is documentation +
 # the report's stable ordering
-KNOWN_POOLS = ("table_cache", "pub_cache", "base_comb", "staging")
+KNOWN_POOLS = ("table_cache", "pub_cache", "base_comb", "staging",
+               "mesh_tables")
 
 
 def shard_fields(n: int, nb: int, shards: int) -> dict:
@@ -377,6 +378,15 @@ class DevObs:
                 m.chunk_overlap.set(r["chunk_overlap"])
             if r.get("shard_imbalance") is not None:
                 m.shard_imbalance.set(r["shard_imbalance"])
+            sh = r.get("shard_h2d_s")
+            if sh:
+                # per-shard H2D walls from the overlapped mesh staging
+                # (ADR-027): publish the max/mean imbalance — a slow
+                # link or one oversubscribed shard position shows up
+                # here before it shows up as a widening drain_s
+                mean = sum(sh) / len(sh)
+                if mean > 0:
+                    m.shard_h2d_imbalance.set(max(sh) / mean)
             wall = r.get("wall_s")
             if wall is not None:
                 slo.observe("device_launch", wall)

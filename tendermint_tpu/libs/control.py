@@ -66,6 +66,11 @@ _MD_FACTOR = 0.5
 # this fraction of the observed peak
 _PIN_FRAC = 0.95
 
+# overlap-mode knobs shrink while the measured transfer/compute overlap
+# ratio of fresh mesh launches sits below this target (1 = transfer
+# fully hidden; 0.4 keeps the knob from chasing noise near full hiding)
+_OVERLAP_TARGET = 0.4
+
 
 class KnobSpec:
     """The literal declaration of one governed knob: its name, finite
@@ -88,7 +93,8 @@ class KnobSpec:
                 f"with lo <= hi, got {safe_range!r}")
         if not (math.isfinite(float(step)) and float(step) > 0):
             raise ValueError(f"knob {name!r}: step must be finite > 0")
-        if mode not in ("throughput", "admission", "backlog", "pressure"):
+        if mode not in ("throughput", "admission", "backlog", "pressure",
+                        "overlap"):
             raise ValueError(f"knob {name!r}: unknown mode {mode!r}")
         self.name = name
         self.safe_range = (lo, hi)
@@ -120,6 +126,12 @@ class KnobSpec:
 #   pressure    grow one step (demote work) while the signal gauge is
 #               pinned at >= 95% of its published peak; recover toward
 #               static after `recover_after` clean periods.
+#   overlap     move one step in the declared direction while FRESH
+#               launches publish the signal (a ratio gauge) below the
+#               overlap target — only a changed gauge value counts as
+#               fresh, so an idle path never walks its knob to the
+#               bound; recover toward static once the ratio is healthy
+#               or the path idles for `recover_after` periods.
 # ---------------------------------------------------------------------------
 
 KNOB_SPECS: Tuple[KnobSpec, ...] = (
@@ -143,6 +155,9 @@ KNOB_SPECS: Tuple[KnobSpec, ...] = (
     KnobSpec("comb_min_batch", safe_range=(16.0, 4096.0), step=16.0,
              direction=1, signal="hbm_resident", mode="pressure",
              labels={"pool": "table_cache"}),
+    KnobSpec("mesh_chunk_lanes", safe_range=(1024.0, 65536.0),
+             step=1024.0, direction=-1, signal="chunk_overlap",
+             mode="overlap"),
 )
 
 SPEC_BY_NAME: Dict[str, KnobSpec] = {s.name: s for s in KNOB_SPECS}
@@ -330,11 +345,13 @@ class Controller(BaseService):
         registry, so this is a cheap lookup, not a re-registration)."""
         from tendermint_tpu.libs.metrics import (BlockSyncMetrics,
                                                  CryptoMetrics,
+                                                 DevObsMetrics,
                                                  MempoolMetrics,
                                                  StateSyncMetrics)
         out = {}
         for bundle in (CryptoMetrics(), BlockSyncMetrics(),
-                       MempoolMetrics(), StateSyncMetrics()):
+                       MempoolMetrics(), StateSyncMetrics(),
+                       DevObsMetrics()):
             for attr, metric in vars(bundle).items():
                 out.setdefault(attr, metric)
         return out
@@ -448,6 +465,8 @@ class Controller(BaseService):
                 target, why = self._admission(k, prev, burns)
             elif mode == "backlog":
                 target, why = self._backlog(k, prev, sig)
+            elif mode == "overlap":
+                target, why = self._overlap(k, prev, sig)
             else:  # pressure
                 target, why = self._pressure(k, prev, sources)
             k.last_signal = sig
@@ -545,6 +564,35 @@ class Controller(BaseService):
         k.clean_periods += 1
         if k.clean_periods >= self.recover_after and prev != k.static:
             return self._toward(prev, k.static, k.step), "calm-recover"
+        return None, ""
+
+    def _overlap(self, k: Knob, prev: float, sig: Optional[float]):
+        """Shrink the staging chunk (the declared direction) while
+        fresh overlapped mesh launches report the transfer/compute
+        overlap ratio below target — more, smaller chunks give the
+        double buffer more compute to hide H2D behind; recover toward
+        static once the ratio is healthy or the path goes idle.  Only
+        a CHANGED gauge value counts as a fresh launch: the gauge holds
+        its last value between launches, and steering on a stale
+        reading would walk the knob to the bound on an idle mesh."""
+        fresh = (sig is not None and k.last_signal is not None
+                 and sig != k.last_signal)
+        if fresh and sig < _OVERLAP_TARGET:
+            k.clean_periods = 0
+            k.idle_periods = 0
+            return prev + k.spec.direction * k.step, "overlap-low"
+        if fresh:
+            k.clean_periods += 1
+            k.idle_periods = 0
+        else:
+            k.idle_periods += 1
+        recovered = (k.clean_periods >= self.recover_after
+                     or k.idle_periods >= self.recover_after)
+        if recovered and prev != k.static:
+            k.clean_periods = 0
+            k.idle_periods = 0
+            return self._toward(prev, k.static, k.step), \
+                "overlap-recover"
         return None, ""
 
     def _pressure(self, k: Knob, prev: float, sources: dict):

@@ -457,7 +457,8 @@ class CryptoMetrics:
         self.msm_route = reg.counter(
             "crypto", "msm_route_total",
             "Verify dispatch routes taken, by path "
-            "(rlc-sharded/rlc-single/mesh-sharded/pallas/xla/...) and "
+            "(rlc-sharded/rlc-single/mesh-sharded/mesh-xla/global-mesh/"
+            "pallas/xla/...) and "
             "outcome — only outcome=\"vouched\" means an RLC route "
             "actually stood in for per-signature verification; "
             "overflow/decode-failed/rejected bounced to the per-sig "
@@ -641,11 +642,19 @@ class DevObsMetrics:
             "max/mean real rows per shard of the most recent mesh "
             "launch (1 = balanced; pad-only shards drag the mean "
             "down).")
+        self.shard_h2d_imbalance = reg.gauge(
+            "crypto", "device_shard_h2d_imbalance",
+            "max/mean per-shard host->device put wall of the most "
+            "recent overlapped mesh staging launch (ADR-027; 1 = every "
+            "shard position staged equally fast — a slow link or "
+            "oversubscribed shard shows up here first).")
         self.hbm_resident = reg.gauge(
             "crypto", "hbm_resident_bytes",
             "Device-resident bytes per pool (table_cache = comb window "
             "tables, pub_cache = pubkey rows, base_comb = the static "
-            "basepoint comb, staging = launch staging buffers — "
+            "basepoint comb, mesh_tables = the data plane's extra "
+            "per-device comb copies or sharded slices (ADR-027), "
+            "staging = launch staging buffers — "
             "charged as the double-buffered in-flight window for the "
             "duration of the launch call; a caller that keeps results "
             "in flight after a non-blocking launch returns is not "

@@ -688,6 +688,10 @@ class Node(BaseService):
         reg("comb_min_batch",
             lambda: float(edops.comb_min_batch()),
             lambda v: edops.set_comb_config(min_batch=int(v)))
+        from tendermint_tpu.parallel import sharding
+        reg("mesh_chunk_lanes",
+            lambda: float(sharding.mesh_chunk_raw()),
+            lambda v: sharding.set_mesh_chunk(int(v)))
 
     def _on_breaker_transition(self, old: str, new: str, reason: str):
         self.log.info("device verify lane breaker transition",

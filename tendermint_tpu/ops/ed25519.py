@@ -485,8 +485,10 @@ COMB_GROUP_OPS = {"doublings": 0, "adds": 2 * COMB_WINDOWS}
 _GROUP_OPS_BY_PATH = {
     "xla": LADDER_GROUP_OPS, "mesh-sharded": LADDER_GROUP_OPS,
     "pallas": LADDER_GROUP_OPS, "pallas-split": LADDER_GROUP_OPS,
-    "mesh-pallas": LADDER_GROUP_OPS,
+    "mesh-pallas": LADDER_GROUP_OPS, "mesh-xla": LADDER_GROUP_OPS,
+    "global-mesh": LADDER_GROUP_OPS,
     "comb": COMB_GROUP_OPS, "mesh-comb": COMB_GROUP_OPS,
+    "mesh-comb-sharded": COMB_GROUP_OPS,
 }
 
 
@@ -980,7 +982,7 @@ def table_cache_budget_bytes() -> int:
 class CombTables:
     """One cached validator set: device-resident comb tables + metadata."""
     __slots__ = ("set_hash", "index", "tables", "dec_ok", "nbytes",
-                 "k", "k_pad", "mesh_repl")
+                 "k", "k_pad", "mesh_repl", "mesh_shard")
 
     def __init__(self, set_hash, index, tables, dec_ok, nbytes, k, k_pad):
         self.set_hash = set_hash
@@ -990,10 +992,13 @@ class CombTables:
         self.nbytes = nbytes
         self.k = k
         self.k_pad = k_pad
-        # (mesh, replicated operand tuple) placed once by the data
+        # (mesh, operand tuple, ledger bytes) placed once by the data
         # plane's verify_comb — without it every mesh launch would
-        # re-replicate the full table set (~198 KB/key) across shards
+        # re-replicate the full table set (~198 KB/key) across shards.
+        # mesh_repl holds full per-device copies, mesh_shard the
+        # validator-axis slices of the budget-fallback gather path
         self.mesh_repl = None
+        self.mesh_shard = None
 
 
 _table_key_lock = threading.Lock()
@@ -1001,6 +1006,19 @@ _table_key_index: "dict[bytes, bytes]" = {}  # pubkey bytes -> set hash
 
 
 def _table_evicted(set_hash, entry):
+    # release the data plane's mesh copies with the build copy — the
+    # mesh_tables ledger pool must not keep charging bytes whose owner
+    # the LRU already let go (the device buffers free when the entry's
+    # last reference drops)
+    freed = 0
+    for slot in ("mesh_repl", "mesh_shard"):
+        cached = getattr(entry, slot, None)
+        if cached is not None:
+            freed += cached[2]
+            setattr(entry, slot, None)
+    if freed:
+        from tendermint_tpu.crypto import devobs
+        devobs.ledger_add("mesh_tables", -freed)
     with _table_key_lock:
         for kb in entry.index:
             if _table_key_index.get(kb) != set_hash:
@@ -1040,19 +1058,19 @@ def _comb_k_pad(k: int) -> int:
     return max(8, 1 << (k - 1).bit_length())
 
 
-def _table_build(uniq: np.ndarray, set_hash: bytes, replicas: int = 1):
+def _table_build(uniq: np.ndarray, set_hash: bytes):
     """Build + cache the comb tables for a distinct-key matrix (K, 32).
     Returns the CombTables entry, or None when the HBM budget says no
-    (route comb/declined — the ladder handles the batch).  `replicas=2`
-    on mesh hosts: verify_comb keeps a fully-replicated copy of the
-    tables per device, so the build device's real footprint is original
-    + replica — the budget must model (and the LRU must charge) both,
-    or the decline check under-counts by ~2x exactly where OOM bites."""
+    (route comb/declined — the ladder handles the batch).  The LRU
+    charges ONE copy: the mesh replication decision moved to the data
+    plane (sharding.comb_mesh_mode, ADR-027), which charges its extra
+    per-device copies — or the budget-fallback sharded slices — to the
+    mesh_tables ledger pool against the same budget at launch time."""
     from tendermint_tpu.crypto import degrade
 
     k = uniq.shape[0]
     k_pad = _comb_k_pad(k)
-    nbytes = replicas * k_pad * _TABLE_BYTES_PER_KEY
+    nbytes = k_pad * _TABLE_BYTES_PER_KEY
     budget = table_cache_budget_bytes()
     if nbytes > budget:
         degrade.publish_route("comb", "declined")
@@ -1132,11 +1150,8 @@ def prewarm(pubkeys, warm_kernel: bool = True) -> bool:
     uniq = np.unique(pub_m, axis=0)
     entry, _ = _table_lookup(uniq)
     if entry is None:
-        from tendermint_tpu.parallel.sharding import data_plane
-        plane = data_plane()
-        entry = _table_build(
-            uniq, hashlib.sha256(uniq.tobytes()).digest(),
-            replicas=2 if plane is not None else 1)
+        entry = _table_build(uniq,
+                             hashlib.sha256(uniq.tobytes()).digest())
         if entry is None:
             return False
     if warm_kernel:
@@ -1214,8 +1229,8 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
     if entry is None:
         if not can_build:
             return None
-        entry = _table_build(uniq, hashlib.sha256(uniq.tobytes()).digest(),
-                             replicas=2 if plane is not None else 1)
+        entry = _table_build(uniq,
+                             hashlib.sha256(uniq.tobytes()).digest())
         if entry is None:
             return None
         remap = np.arange(uniq.shape[0], dtype=np.int32)
@@ -1235,21 +1250,44 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
     s_digits = scalars_to_digits(s_b)
     k_digits = scalars_to_digits(kscal)
     use_mesh = plane is not None and plane.worth_sharding(n)
-    path = "mesh-comb" if use_mesh else "comb"
     phases = {"stage_s": time.perf_counter() - t0} if obs_on else {}
-    # chunk like every other device path (split_chunked_launch, the
-    # nb > MAX_CHUNK pipelined sub-batching): one unbounded launch for
-    # a huge batch would mint a fresh XLA bucket shape per size class
-    # and outgrow the degrade timeouts tuned for <= MAX_CHUNK lanes
-    parts, nb, shards = [], 0, 1
-    for a in range(0, n, MAX_CHUNK):
-        b = min(a + MAX_CHUNK, n)
-        rc, sc, kc, vc = (r_b[a:b], s_digits[a:b], k_digits[a:b],
-                          vidx[a:b])
-        if use_mesh:
-            part, cnb, shards = plane.verify_comb(
-                rc, sc, kc, vc, entry, _base_comb())
-        else:
+    res, path, nb, shards = None, "comb", 0, 1
+    if use_mesh:
+        # the data plane takes the FULL batch: it owns the chunking
+        # (double-buffered per-shard staging, ADR-027) and the
+        # budget-aware table layout; None (budget declined) or a chaos
+        # fault at its seam falls back to the single-device comb below
+        # — the tables are resident on the build device, so declining
+        # to the ladder would throw the cached work away
+        probe = {} if obs_on else None
+        try:
+            mesh_out = plane.verify_comb(r_b, s_digits, k_digits, vidx,
+                                         entry, _base_comb(),
+                                         probe=probe)
+        except fail.InjectedFault:
+            degrade.publish_route("mesh-comb", "declined")
+            mesh_out = None
+        if mesh_out is not None:
+            res, nb, shards, path = mesh_out
+            if obs_on:
+                phases.update(_overlap_phases({
+                    "stage_s": phases.get("stage_s", 0.0),
+                    "dma_s": probe.get("dma_s", 0.0),
+                    "dma_first_s": probe.get("dma_first_s", 0.0),
+                    "chunks": probe.get("chunks", 1)}))
+                if probe.get("shard_h2d_s"):
+                    phases["shard_h2d_s"] = probe["shard_h2d_s"]
+                phases.update(devobs.shard_fields(n, nb, shards))
+    if res is None:
+        # chunk like every other device path (split_chunked_launch, the
+        # nb > MAX_CHUNK pipelined sub-batching): one unbounded launch
+        # for a huge batch would mint a fresh XLA bucket shape per size
+        # class and outgrow degrade timeouts tuned for <= MAX_CHUNK
+        parts, nb, shards, path = [], 0, 1, "comb"
+        for a in range(0, n, MAX_CHUNK):
+            b = min(a + MAX_CHUNK, n)
+            rc, sc, kc, vc = (r_b[a:b], s_digits[a:b], k_digits[a:b],
+                              vidx[a:b])
             m = b - a
             cnb = bucket_size(m)
             if cnb != m:
@@ -1291,11 +1329,9 @@ def _comb_try(pubkeys, msgs, sigs, cache_pubs: bool, plane):
                                   entry.tables.z, entry.tables.t2d,
                                   entry.dec_ok, by, bm, bt)
                 part = np.asarray(out)[:m]
-        parts.append(np.asarray(part))
-        nb += cnb
-    res = parts[0] if len(parts) == 1 else np.concatenate(parts)
-    if obs_on and use_mesh:
-        phases.update(devobs.shard_fields(n, nb, shards))
+            parts.append(np.asarray(part))
+            nb += cnb
+        res = parts[0] if len(parts) == 1 else np.concatenate(parts)
     _record_launch(path, n, nb, time.perf_counter() - t0, shards=shards,
                    extra=dict(phases, table_build=built, set_k=entry.k,
                               k_pad=entry.k_pad))
@@ -1424,6 +1460,7 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     pubkey rows are kept device-resident keyed by content hash, so
     steady-state VerifyCommit ships 96 B/sig instead of 128."""
     from tendermint_tpu.libs import fail
+    from tendermint_tpu.parallel import sharding
     from tendermint_tpu.parallel.sharding import data_plane
 
     # chaos seam: the degradation runtime (crypto/degrade.py) wraps every
@@ -1434,6 +1471,34 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
     from . import msm
 
     with trace.span("ops.ed25519.verify_batch", n=len(pubkeys)) as sp:
+        # the GLOBAL plane outranks everything, but only answers inside
+        # a lockstep() window on a multi-process runtime (ADR-027):
+        # blocksync replay_window and the coordinated bulk verify, where
+        # every process is known to walk the same batches in the same
+        # order.  A chaos fault at its seam degrades this batch to the
+        # local paths below — on THIS process only; peers entering the
+        # collective without it rely on their own degrade timeouts, the
+        # price of testing a collective's failure path per-process.
+        gplane = sharding.global_plane()
+        if gplane is not None and gplane.worth_sharding(len(pubkeys)):
+            try:
+                return gplane.verify_batch(pubkeys, msgs, sigs)
+            except fail.InjectedFault:
+                from tendermint_tpu.crypto import degrade
+                degrade.publish_route("global-mesh", "declined")
+                sp.add(global_mesh_fault=True)
+            except Exception as e:  # noqa: BLE001 - collective runtime fault
+                # a REAL failure of the cross-process plane (most
+                # commonly a backend without multi-process computation
+                # support, e.g. the CPU backend of current jaxlib)
+                # latches the global plane off for the process: the
+                # compile is deterministic, so retrying every batch
+                # would pay the failed lowering forever.  Verification
+                # stays exact on the local paths below.
+                from tendermint_tpu.crypto import degrade
+                sharding.disable_global_plane()
+                degrade.publish_route("global-mesh", "declined")
+                sp.add(global_mesh_fault=True, global_mesh_err=type(e).__name__)
         # the mesh data plane is consulted FIRST, and the RLC fast path
         # dispatches THROUGH it: on a multi-chip host the Pippenger
         # bucket accumulation runs as per-shard partial MSMs with an
@@ -1473,7 +1538,15 @@ def verify_batch(pubkeys, msgs, sigs, cache_pubs: bool = False) -> np.ndarray:
         if out is not None:
             return out
         if plane is not None and plane.worth_sharding(len(pubkeys)):
-            return plane.verify_batch(pubkeys, msgs, sigs)
+            try:
+                return plane.verify_batch(pubkeys, msgs, sigs)
+            except fail.InjectedFault:
+                # chaos at the mesh staging seam
+                # (sharding.mesh_stage): degrade THIS batch to the
+                # single-device paths below, bitmap identical
+                from tendermint_tpu.crypto import degrade
+                degrade.publish_route(plane.MESH_PATH, "declined")
+                sp.add(mesh_fault=True)
         from tendermint_tpu.crypto import devobs
 
         # launch decomposition (ADR-021): with the observatory enabled

@@ -570,12 +570,18 @@ def _set_route(route: dict):
     nb = route.get("nb")
     if nb:  # an MSM actually launched (ineligible batches never do):
         # mirror it into the launch record so last_launch() and the
-        # bench route/occupancy columns cover the RLC fast path too
-        ed._set_last_launch({
+        # bench route/occupancy columns cover the RLC fast path too;
+        # the sharded path's staging decomposition (h2d_s, per-shard
+        # put walls — ADR-027) rides along into the devobs records
+        rec = {
             "path": route["path"], "n": route["n"], "nb": nb,
             "occupancy": route["n"] / nb,
             "shards": route.get("shards", 1),
-            "outcome": route.get("outcome")})
+            "outcome": route.get("outcome")}
+        for k in ("h2d_s", "shard_h2d_s"):
+            if k in route:
+                rec[k] = route[k]
+        ed._set_last_launch(rec)
     trace.instant("msm.route", **route)
     cur = trace.current()
     cur.add(path=route.get("path"), outcome=route.get("outcome"))
@@ -609,13 +615,21 @@ def verify_batch_rlc(pubkeys, msgs, sigs, plane=None, z=None) -> bool:
     r_bytes, zk, z, zs = staged
     use_pallas = ed._use_pallas()
     if plane is not None and plane.worth_sharding_msm(n):
+        from tendermint_tpu.crypto import devobs
+
         nb = plane.msm_bucket(n)
         c = _pick_c(nb // plane.nshard)
         r_bytes, pub_m, zk, z = _pad_rows(r_bytes, pub_m, zk, z, nb)
+        probe = {} if devobs.is_enabled() else None
         ws, ok_all, overflow = plane.msm_window_sums(
-            r_bytes, pub_m, zk, z, zs, c, use_pallas=use_pallas)
+            r_bytes, pub_m, zk, z, zs, c, use_pallas=use_pallas,
+            probe=probe)
         route = {"path": "rlc-sharded", "n": n, "nb": nb,
                  "shards": plane.nshard, "c": c}
+        if probe:
+            # per-shard H2D walls from the explicit sharded staging
+            # (ADR-027) ride the route into last_launch -> devobs
+            route.update(probe)
     else:
         nb = ed.bucket_size(n)
         c = _pick_c(nb)

@@ -17,7 +17,10 @@ every local chip instead of leaving N-1 idle.
 """
 from __future__ import annotations
 
+import os
+import threading
 import time
+from contextlib import contextmanager
 from functools import partial
 
 import jax
@@ -35,8 +38,63 @@ def make_mesh(devices=None, axis: str = BATCH_AXIS) -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+# ---------------------------------------------------------------------------
+# staging chunk knob (ADR-027).  The overlapped mesh paths stage the
+# batch as double-buffered chunks of nshard * mesh_chunk_lanes() rows:
+# smaller chunks hide more H2D behind compute (higher chunk_overlap)
+# at the cost of more dispatches.  The control plane steers the RAW
+# value (KnobSpec "mesh_chunk_lanes", signal chunk_overlap); the
+# EFFECTIVE chunk is the raw value's power-of-two floor so chunked
+# launches stay inside the known compile-bucket shapes (tmlint
+# CompileSentinel) — additive knob steps still move the effective
+# chunk whenever they cross a power-of-two boundary.
+# ---------------------------------------------------------------------------
+
+MESH_CHUNK_DEFAULT = edops.SPLIT_CHUNK  # per-shard lanes per H2D chunk
+_MESH_CHUNK_MIN = 256
+_mesh_chunk_override = None
+
+
+def mesh_chunk_raw() -> int:
+    """The raw (unrounded) chunk knob value — the coordinate the
+    control plane reads and writes."""
+    v = _mesh_chunk_override
+    if v is None:
+        try:
+            v = int(os.environ.get("TM_TPU_MESH_CHUNK",
+                                   MESH_CHUNK_DEFAULT))
+        except (TypeError, ValueError):
+            v = MESH_CHUNK_DEFAULT
+    return int(v)
+
+
+def mesh_chunk_lanes() -> int:
+    """Effective per-shard lanes of one staging chunk: the raw knob
+    clamped into [_MESH_CHUNK_MIN, MAX_CHUNK] and floored to a power
+    of two."""
+    v = max(_MESH_CHUNK_MIN, min(mesh_chunk_raw(), edops.MAX_CHUNK))
+    return 1 << (v.bit_length() - 1)
+
+
+def set_mesh_chunk(lanes=None):
+    """Node-config / control-plane seam for the staging chunk.  None
+    reverts to the env/default (TM_TPU_MESH_CHUNK, same contract as
+    edops.set_comb_config)."""
+    global _mesh_chunk_override
+    _mesh_chunk_override = None if lanes is None else int(lanes)
+
+
 _PLANE = None
-_PLANE_LOCK = __import__("threading").Lock()
+_PLANE_KEY = None      # local-topology fingerprint the plane latched on
+_GLOBAL_PLANE = None
+_PLANE_LOCK = threading.Lock()
+
+
+def _topology_key():
+    try:
+        return tuple((d.platform, d.id) for d in jax.local_devices())
+    except Exception:  # noqa: BLE001 - backend down reads as "no devices"
+        return None
 
 
 def data_plane():
@@ -47,16 +105,18 @@ def data_plane():
     every call, so every BatchVerifier in the node — consensus vote
     coalescing, blocksync replay, VerifyCommit — shards across all LOCAL
     devices automatically.  Scoped to jax.local_devices(): each node
-    process verifies its own batches; a global multi-controller mesh
-    would require every process to enter the same computation in
-    lockstep, which uncoordinated reactor calls cannot guarantee.
-    Thread-safe (reactors call verify_batch concurrently).
-    TM_TPU_NO_MESH=1 forces single-device."""
-    global _PLANE
+    process verifies its own batches; the global multi-controller mesh
+    lives behind global_plane() and is reachable only from coordinated
+    lockstep() call sites (ADR-027).  Thread-safe (reactors call
+    verify_batch concurrently).  TM_TPU_NO_MESH=1 forces single-device.
+    The latch is topology-keyed: degrade's backend re-probe calls
+    invalidate_on_topology_change() so a backend that comes up after
+    the first probe gets its mesh instead of a forever-False plane."""
+    global _PLANE, _PLANE_KEY
     if _PLANE is None:
         with _PLANE_LOCK:
             if _PLANE is None:
-                import os
+                _PLANE_KEY = _topology_key()
                 if os.environ.get("TM_TPU_NO_MESH") == "1":
                     _PLANE = False
                 else:
@@ -67,6 +127,114 @@ def data_plane():
                     _PLANE = _DataPlane(make_mesh(jax.local_devices())) \
                         if ndev > 1 else False
     return _PLANE or None
+
+
+def invalidate_on_topology_change() -> bool:
+    """Drop a latched plane when the local device list no longer matches
+    the one it latched on (the satellite fix: a plane probed before the
+    backend came up latched False forever, so degrade's recovered
+    re-probe never got its mesh).  Called from
+    degrade.backend_available() on every successful probe; rebuilding
+    happens lazily on the next data_plane() call.  Returns True when a
+    stale plane was dropped."""
+    global _PLANE, _PLANE_KEY, _GLOBAL_PLANE
+    with _PLANE_LOCK:
+        if _PLANE is None:
+            return False
+        key = _topology_key()
+        if key == _PLANE_KEY:
+            return False
+        _PLANE = None
+        _PLANE_KEY = None
+        _GLOBAL_PLANE = None
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the global (multi-process) plane, gated to lockstep call sites
+# (ADR-027).  A collective over jax.devices() requires EVERY process to
+# enter the same computation in the same order; reactor-driven traffic
+# cannot guarantee that, so global_plane() only answers inside a
+# lockstep() window — blocksync replay_window and the coordinated bulk
+# verify, where the caller knows all processes walk the same batches.
+# ---------------------------------------------------------------------------
+
+_lockstep_tls = threading.local()
+
+
+@contextmanager
+def lockstep():
+    """Mark the calling thread as inside a COORDINATED verify window:
+    every participating process is entering the same verification calls
+    in the same order.  Only such windows may reach the global plane —
+    a collective one process skips deadlocks the rest (ADR-027)."""
+    prev = getattr(_lockstep_tls, "depth", 0)
+    _lockstep_tls.depth = prev + 1
+    try:
+        yield
+    finally:
+        _lockstep_tls.depth = prev
+
+
+def in_lockstep() -> bool:
+    return getattr(_lockstep_tls, "depth", 0) > 0
+
+
+def global_mesh_ready() -> bool:
+    """True when jax.distributed is initialized with >1 process and the
+    mesh is not disabled — the precondition for the global plane.
+    Never raises (callers probe it on hot paths)."""
+    if os.environ.get("TM_TPU_NO_MESH") == "1" or \
+            os.environ.get("TM_TPU_NO_GLOBAL_MESH") == "1":
+        return False
+    try:
+        return jax.process_count() > 1
+    except Exception:  # noqa: BLE001 - uninitialized runtime
+        return False
+
+
+def global_plane():
+    """The cross-process mesh plane over jax.devices(), or None.  Only
+    returned INSIDE a lockstep() window on a multi-process runtime —
+    everywhere else callers get None and stay on the local plane."""
+    global _GLOBAL_PLANE
+    if not in_lockstep() or not global_mesh_ready():
+        return None
+    if _GLOBAL_PLANE is None:
+        with _PLANE_LOCK:
+            if _GLOBAL_PLANE is None:
+                try:
+                    devs = jax.devices()
+                except Exception:  # noqa: BLE001 - backend down
+                    return None
+                _GLOBAL_PLANE = _GlobalDataPlane(make_mesh(devs)) \
+                    if len(devs) > 1 else False
+    return _GLOBAL_PLANE or None
+
+
+def disable_global_plane():
+    """Latch the global plane OFF for this process (ops/ed25519 calls
+    this when a real — non-chaos — collective/compile fault surfaces,
+    e.g. a backend without multi-process computation support).  The
+    latch holds until a topology change re-probe
+    (invalidate_on_topology_change) clears it."""
+    global _GLOBAL_PLANE
+    with _PLANE_LOCK:
+        _GLOBAL_PLANE = False
+
+
+def _barrier(name: str, timeout_ms: int = 240_000):
+    """Cross-process rendezvous on the jax.distributed coordination
+    service (no-op single-process / uninitialized): the global plane
+    barriers after each ahead-of-time kernel compile so no process
+    dispatches into a collective a peer is still compiling."""
+    try:
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is not None:
+            client.wait_at_barrier(name, timeout_ms)
+    except Exception:  # noqa: BLE001 - single-process or old jax: the
+        pass            # compile skew risk is absent or accepted
 
 
 class _DataPlane:
@@ -193,11 +361,18 @@ class _DataPlane:
             return self._fns[key]
 
     def msm_window_sums(self, r_bytes, pub_m, zk, z, zs, c: int,
-                        use_pallas: bool = False):
+                        use_pallas: bool = False, probe: dict = None):
         """Mesh-sharded equivalent of msm._msm_core: identical combined
-        window sums (as group elements), batch rows split across devices.
-        Inputs are the padded staged arrays (batch divisible by nshard);
-        returns (window sums (4, NLIMB, W), decode_ok_all, overflow)."""
+        window sums (as group elements), batch rows split across devices
+        by explicit per-shard device_puts (_put_sharded — each shard's
+        block lands directly on its device instead of one monolithic
+        put XLA re-slices).  Inputs are the padded staged arrays (batch
+        divisible by nshard); `probe` (devobs) receives the H2D wall
+        and per-shard put walls.  The MSM stays a SINGLE collective
+        launch — its output is one reduced window-sum set, so chunking
+        would demand a host-side group-add accumulation pass the comb
+        and ladder paths don't need (ADR-027).  Returns (window sums
+        (4, NLIMB, W), decode_ok_all, overflow)."""
         import numpy as np
 
         nb = r_bytes.shape[0]
@@ -205,8 +380,50 @@ class _DataPlane:
         zs_rows = np.zeros((self.nshard, 32), dtype=np.uint8)
         zs_rows[0] = zs
         fn = self._msm_fn(c, use_pallas)
-        return fn(jnp.asarray(r_bytes), jnp.asarray(pub_m),
-                  jnp.asarray(zk), jnp.asarray(z), jnp.asarray(zs_rows))
+        walls = []
+        args = self._put_sharded(
+            (np.asarray(r_bytes), np.asarray(pub_m), np.asarray(zk),
+             np.asarray(z), zs_rows),
+            (P(BATCH_AXIS, None),) * 5, walls=walls)
+        if probe is not None and walls:
+            probe["h2d_s"] = round(sum(walls), 6)
+            probe["shard_h2d_s"] = [round(w, 6) for w in walls]
+        return fn(*args)
+
+    # -- explicit per-shard staging (ADR-027) ------------------------------
+
+    def _put_sharded(self, arrays, specs, walls=None):
+        """Stage a tuple of batch-major operands shard by shard: slice
+        each operand's rows for every ADDRESSABLE mesh position,
+        device_put the slices onto that device, and assemble the global
+        arrays with jax.make_array_from_single_device_arrays.  On a
+        multi-process mesh each process stages ONLY its own shards —
+        this is what lets the global plane run without any process
+        holding the full batch's device buffers.  Appends one put wall
+        per local shard position to `walls` (the devobs per-shard H2D
+        decomposition and shard_h2d imbalance gauge)."""
+        import numpy as np
+
+        try:
+            pid = jax.process_index()
+        except Exception:  # noqa: BLE001 - single-process runtime
+            pid = 0
+        bufs = [[] for _ in arrays]
+        for pos, d in enumerate(self.mesh.devices.flat):
+            if getattr(d, "process_index", pid) != pid:
+                continue
+            t_put = time.perf_counter()
+            for ai, a in enumerate(arrays):
+                per = a.shape[0] // self.nshard
+                bufs[ai].append(jax.device_put(
+                    np.ascontiguousarray(a[pos * per:(pos + 1) * per]),
+                    d))
+            if walls is not None:
+                walls.append(time.perf_counter() - t_put)
+        return tuple(
+            jax.make_array_from_single_device_arrays(
+                a.shape, NamedSharding(self.mesh, spec), bufs[ai])
+            for ai, (a, spec) in enumerate(zip(arrays, specs)))
 
     # -- fixed-base comb over the mesh (ADR-013) ---------------------------
 
@@ -239,30 +456,76 @@ class _DataPlane:
             self._fns.setdefault("comb", f)
             return self._fns["comb"]
 
-    def verify_comb(self, r_b, s_digits, k_digits, vidx, entry, base):
-        """Mesh-sharded comb launch: identical bitmap to the
-        single-device comb kernel, batch rows split across devices,
-        tables replicated per shard.  Returns (bitmap[:n], nb, shards)."""
-        import numpy as np
-
+    def comb_mesh_mode(self, entry):
+        """Budget-aware replication decision (ADR-027): 'repl' while a
+        full table copy fits on every device NEXT TO the build copy the
+        table cache already charges ('repl' costs one extra table per
+        device), 'shard' when only a 1/nshard table slice does (the
+        gather path — lanes grouped by table-owning shard so every
+        gather stays local), None when even the slice blows the
+        per-device budget — the caller then runs the single-device comb
+        (the tables are already resident there), NOT the ladder."""
         from tendermint_tpu.ops import ed25519 as edops
 
+        tbytes = entry.k_pad * edops._TABLE_BYTES_PER_KEY
+        budget = edops.table_cache_budget_bytes()
+        if 2 * tbytes <= budget:
+            return "repl"
+        if entry.k_pad % self.nshard == 0 and \
+                tbytes + tbytes // self.nshard <= budget:
+            return "shard"
+        return None
+
+    def verify_comb(self, r_b, s_digits, k_digits, vidx, entry, base,
+                    probe: dict = None):
+        """Mesh-sharded comb launch over the FULL batch: identical
+        bitmap to the single-device comb kernel, batch rows split
+        across devices with double-buffered per-shard chunk staging
+        (_run_comb_chunks).  Table placement is budget-aware
+        (comb_mesh_mode): replicated per shard while the per-device
+        ledger allows, sharded-on-the-validator-axis gather layout when
+        it doesn't.  Returns (bitmap[:n], nb, shards, path) or None
+        when the budget declines both mesh layouts (the caller falls
+        back to the single-device comb, not the ladder)."""
+        from tendermint_tpu.crypto import degrade
+        from tendermint_tpu.libs import fail
+
         n = r_b.shape[0]
-        nshard = self.nshard
-        nb = max(-(-edops.bucket_size(n) // nshard) * nshard, nshard)
-        if nb != n:
-            pad = [(0, nb - n), (0, 0)]
-            r_b = np.pad(r_b, pad)
-            s_digits = np.pad(s_digits, pad)
-            k_digits = np.pad(k_digits, pad)
-            vidx = np.pad(vidx, (0, nb - n))
-        # replicate the weights of this path (per-validator tables,
-        # decode verdicts, static basepoint comb) across the mesh ONCE
-        # per entry and reuse the committed copies on every launch —
-        # entry.tables is committed to the build device, so passing it
-        # raw would make jit re-replicate ~198 KB/key per call (a
-        # benign race: two first launches both device_put, one copy
-        # wins the slot, the other is garbage once its launch retires)
+        mode = self.comb_mesh_mode(entry)
+        if mode is None:
+            degrade.publish_route("mesh-comb", "declined")
+            return None
+        # chaos seam: a raise here degrades this batch to the
+        # single-device comb in ops/ed25519._comb_try (exact bitmap)
+        fail.inject("sharding.mesh_comb")
+        if mode == "shard":
+            out = self._verify_comb_sharded(r_b, s_digits, k_digits,
+                                            vidx, entry, base, probe)
+            if out is None:
+                degrade.publish_route("mesh-comb", "declined")
+                return None
+            bitmap, nb = out
+            return bitmap[:n], nb, self.nshard, "mesh-comb-sharded"
+        table_ops = self._comb_repl_operands(entry, base)
+        fn = self._comb_fn()
+        bitmap, nb = self._run_comb_chunks(
+            lambda args: fn(*args, *table_ops)[0],
+            r_b, s_digits, k_digits, vidx, probe)
+        return bitmap[:n], nb, self.nshard, "mesh-comb"
+
+    def _comb_repl_operands(self, entry, base):
+        """Replicate the weights of this path (per-validator tables,
+        decode verdicts, static basepoint comb) across the mesh ONCE
+        per entry and reuse the committed copies on every launch —
+        entry.tables is committed to the build device, so passing it
+        raw would make jit re-replicate ~198 KB/key per call (a benign
+        race: two first launches both device_put, one copy wins the
+        slot, the other is garbage once its launch retires).  The
+        nshard-1 EXTRA copies charge the mesh_tables ledger pool; the
+        build copy stays on table_cache's books."""
+        from tendermint_tpu.crypto import devobs
+        from tendermint_tpu.ops import ed25519 as edops
+
         cached = entry.mesh_repl
         if cached is None or cached[0] is not self.mesh:
             by, bm, bt = base
@@ -270,12 +533,206 @@ class _DataPlane:
                 (entry.tables.ypx, entry.tables.ymx, entry.tables.z,
                  entry.tables.t2d, entry.dec_ok, by, bm, bt),
                 NamedSharding(self.mesh, P()))
-            cached = (self.mesh, repl)
+            tbytes = (self.nshard - 1) * entry.k_pad * \
+                edops._TABLE_BYTES_PER_KEY
+            prev = cached[2] if cached is not None else 0
+            cached = (self.mesh, repl, tbytes)
             entry.mesh_repl = cached
-        bitmap, _ = self._comb_fn()(
-            jnp.asarray(r_b), jnp.asarray(s_digits),
-            jnp.asarray(k_digits), jnp.asarray(vidx), *cached[1])
-        return np.asarray(bitmap)[:n], nb, nshard
+            devobs.ledger_add("mesh_tables", tbytes - prev)
+        return cached[1]
+
+    def _run_comb_chunks(self, launch, r_b, s_digits, k_digits, vidx,
+                         probe):
+        """Double-buffered chunk driver for the replicated mesh comb:
+        pad to the usual pow2 bucket rounded to a shard multiple, split
+        into chunks of nshard * mesh_chunk_lanes() rows when that
+        divides the bucket (it always does for pow2 shard counts), and
+        issue chunk j+1's per-shard device_puts right after chunk j's
+        dispatch so H2D hides behind compute — the same discipline as
+        split_chunked_launch, feeding the same chunk_overlap probe."""
+        import numpy as np
+
+        from tendermint_tpu.crypto import devobs
+        from tendermint_tpu.ops import ed25519 as edops
+
+        nshard = self.nshard
+        n = r_b.shape[0]
+        lanes = min(mesh_chunk_lanes(), max(1, edops.MAX_CHUNK // nshard))
+        chunk_max = nshard * lanes
+        nb = max(-(-edops.bucket_size(n) // nshard) * nshard, nshard)
+        if not (chunk_max < nb and nb % chunk_max == 0):
+            chunk_max = nb
+        starts = list(range(0, nb, chunk_max))
+        if nb != n:
+            pad = [(0, nb - n), (0, 0)]
+            r_b = np.pad(r_b, pad)
+            s_digits = np.pad(s_digits, pad)
+            k_digits = np.pad(k_digits, pad)
+            vidx = np.pad(vidx, (0, nb - n))
+        specs = (P(BATCH_AXIS),) * 4
+        chunk_walls = []
+
+        def stage(a):
+            w = []
+            args = self._put_sharded(
+                (r_b[a:a + chunk_max], s_digits[a:a + chunk_max],
+                 k_digits[a:a + chunk_max], vidx[a:a + chunk_max]),
+                specs, walls=w)
+            chunk_walls.append(w)
+            return args
+
+        row_bytes = 32 + 64 + 64 + vidx.dtype.itemsize
+        inflight = min(nb, 2 * chunk_max) * row_bytes
+        devobs.ledger_add("staging", inflight)
+        outs = []
+        try:
+            nxt = stage(0)
+            for ci, _s in enumerate(starts):
+                cur = nxt
+                outs.append(launch(cur))
+                if ci + 1 < len(starts):
+                    nxt = stage(starts[ci + 1])
+        finally:
+            devobs.ledger_add("staging", -inflight)
+        res = np.concatenate([np.asarray(o) for o in outs]) \
+            if len(outs) > 1 else np.asarray(outs[0])
+        self._merge_probe(probe, chunk_walls, len(starts))
+        return res, nb
+
+    @staticmethod
+    def _merge_probe(probe, chunk_walls, chunks):
+        """Fold one launch's per-chunk/per-shard put walls into a devobs
+        probe dict (accumulating — the comb may be preceded by a table
+        build that already charged stage time)."""
+        if probe is None or not chunk_walls:
+            return
+        sums = [sum(w) for w in chunk_walls]
+        probe["dma_s"] = probe.get("dma_s", 0.0) + sum(sums)
+        probe.setdefault("dma_first_s", sums[0])
+        probe["chunks"] = probe.get("chunks", 0) + chunks
+        nloc = max(len(w) for w in chunk_walls)
+        sh = [round(sum(w[i] for w in chunk_walls if i < len(w)), 6)
+              for i in range(nloc)]
+        prev = probe.get("shard_h2d_s")
+        probe["shard_h2d_s"] = [round(a + b, 6)
+                                for a, b in zip(prev, sh)] \
+            if prev and len(prev) == len(sh) else sh
+
+    # -- sharded-table comb (budget fallback, ADR-027) ---------------------
+
+    def _comb_sharded_fn(self):
+        """Sharded-table comb: window tables and decode verdicts split
+        on the VALIDATOR axis (each device holds k_pad/nshard
+        validators' tables), batch lanes grouped host-side by their
+        table-owning shard so every per-lane gather is shard-local —
+        the layout that engages when replicating the full table next to
+        the build copy would blow the per-device HBM budget."""
+        with self._lock:
+            fn = self._fns.get("comb-sharded")
+        if fn is not None:
+            return fn
+        from jax.experimental.shard_map import shard_map
+
+        from tendermint_tpu.ops import ed25519 as edops
+
+        def body(r, sd, kd, vl, ty, tm, tz, td, dok, by, bm, bt):
+            return edops.comb_verify_staged(r, sd, kd, vl, ty, tm, tz,
+                                            td, dok, by, bm, bt)
+
+        f = jax.jit(shard_map(
+            body, mesh=self.mesh,
+            in_specs=((P(BATCH_AXIS),) * 4
+                      + (P(None, None, None, BATCH_AXIS),) * 4
+                      + (P(BATCH_AXIS), P(), P(), P())),
+            out_specs=P(BATCH_AXIS), check_rep=False))
+        with self._lock:
+            self._fns.setdefault("comb-sharded", f)
+            return self._fns["comb-sharded"]
+
+    def _comb_shard_operands(self, entry, base):
+        """Table slices committed once per entry: tables/dec_ok sharded
+        on the validator (last / only) axis, basepoint comb replicated.
+        Charges ONE extra table total ((nshard * slice) = one copy) to
+        the mesh_tables pool."""
+        from tendermint_tpu.crypto import devobs
+        from tendermint_tpu.ops import ed25519 as edops
+
+        cached = entry.mesh_shard
+        if cached is None or cached[0] is not self.mesh:
+            by, bm, bt = base
+            kspec = NamedSharding(self.mesh,
+                                  P(None, None, None, BATCH_AXIS))
+            vspec = NamedSharding(self.mesh, P(BATCH_AXIS))
+            repl = NamedSharding(self.mesh, P())
+            ops = (jax.device_put(entry.tables.ypx, kspec),
+                   jax.device_put(entry.tables.ymx, kspec),
+                   jax.device_put(entry.tables.z, kspec),
+                   jax.device_put(entry.tables.t2d, kspec),
+                   jax.device_put(entry.dec_ok, vspec),
+                   jax.device_put(by, repl), jax.device_put(bm, repl),
+                   jax.device_put(bt, repl))
+            tbytes = entry.k_pad * edops._TABLE_BYTES_PER_KEY
+            prev = cached[2] if cached is not None else 0
+            cached = (self.mesh, ops, tbytes)
+            entry.mesh_shard = cached
+            devobs.ledger_add("mesh_tables", tbytes - prev)
+        return cached[1]
+
+    def _verify_comb_sharded(self, r_b, s_digits, k_digits, vidx, entry,
+                             base, probe):
+        """Launch the sharded-table comb: group lanes by table-owning
+        shard (owner = vidx // (k_pad/nshard)), pad every owner group
+        to the bucket of the LARGEST group so the mesh stays rectangular,
+        scatter rows into their owner's slot range, verify with local
+        vidx (vidx % k_per), and inverse-permute the bitmap back to lane
+        order.  The permutation breaks chunk contiguity, so this path
+        stages in one per-shard put set instead of the double-buffered
+        chunk loop.  Returns (bitmap (n,), nb) or None when the skewed
+        per-shard bucket would exceed MAX_CHUNK lanes (caller declines
+        to the single-device comb)."""
+        import numpy as np
+
+        from tendermint_tpu.crypto import devobs
+        from tendermint_tpu.ops import ed25519 as edops
+
+        nshard = self.nshard
+        n = r_b.shape[0]
+        k_per = entry.k_pad // nshard
+        own = (vidx // k_per).astype(np.int64)
+        counts = np.bincount(own, minlength=nshard)
+        per = int(edops.bucket_size(max(int(counts.max()), 1)))
+        if per > edops.MAX_CHUNK:
+            return None
+        nb = nshard * per
+        order = np.argsort(own, kind="stable")
+        group_starts = np.zeros(nshard + 1, dtype=np.int64)
+        np.cumsum(counts, out=group_starts[1:])
+        slot_sorted = (np.arange(n, dtype=np.int64)
+                       - group_starts[own[order]] + own[order] * per)
+        slots = np.empty(n, dtype=np.int64)
+        slots[order] = slot_sorted
+
+        def scatter(a):
+            out = np.zeros((nb,) + a.shape[1:], dtype=a.dtype)
+            out[slots] = a
+            return out
+
+        rs, ss, ks = scatter(r_b), scatter(s_digits), scatter(k_digits)
+        vl = np.zeros(nb, dtype=vidx.dtype)
+        vl[slots] = (vidx % k_per).astype(vidx.dtype)
+        table_ops = self._comb_shard_operands(entry, base)
+        fn = self._comb_sharded_fn()
+        walls = []
+        row_bytes = 32 + 64 + 64 + vidx.dtype.itemsize
+        devobs.ledger_add("staging", nb * row_bytes)
+        try:
+            args = self._put_sharded((rs, ss, ks, vl),
+                                     (P(BATCH_AXIS),) * 4, walls=walls)
+            out = np.asarray(fn(*args, *table_ops))
+        finally:
+            devobs.ledger_add("staging", -nb * row_bytes)
+        self._merge_probe(probe, [walls], 1)
+        return out[slots], nb
 
     def _packed_fn(self):
         """TPU path: the fused Pallas kernel inside shard_map, packed
@@ -295,15 +752,128 @@ class _DataPlane:
                 self._fns["packed"] = jax.jit(f)
             return self._fns["packed"]
 
-    def _compact(self):
-        """Portable path (CPU mesh tests, non-TPU backends): the
-        XLA-composed kernel with batch-sharded in_shardings; returns the
-        bucketing run closure from make_sharded_verifier."""
+    # -- overlapped compact ladder (ADR-027) -------------------------------
+
+    MESH_PATH = "mesh-xla"
+    FAIL_SITE = "sharding.mesh_stage"
+
+    def _step_fn(self, nb: int):
+        """Cached jitted compact-ladder step for one chunk shape:
+        (pub, r, s_digits, k_digits, live) -> (bitmap, all_valid), BOTH
+        outputs replicated — the bitmap all-gather replaces the host
+        stitch, and the jnp.all over live lanes lowers to the psum'd
+        all-valid bit (pad lanes read as valid so a padded bucket can
+        still report all-valid).  The global plane compiles this ahead
+        of the first collective call and barriers (_seal)."""
+        key = ("step", nb)
         with self._lock:
-            if "compact" not in self._fns:
-                _, run = make_sharded_verifier(self.mesh)
-                self._fns["compact"] = run
-            return self._fns["compact"]
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        batch_sharded = NamedSharding(self.mesh, P(BATCH_AXIS))
+        repl = NamedSharding(self.mesh, P())
+
+        def step(pub, r, s_digits, k_digits, live):
+            bitmap = edops.verify_staged(pub, r, s_digits, k_digits)
+            return bitmap, jnp.all(bitmap | ~live)
+
+        f = jax.jit(step, in_shardings=(batch_sharded,) * 5,
+                    out_shardings=(repl, repl))
+        f = self._seal(f, nb)
+        with self._lock:
+            self._fns.setdefault(key, f)
+            return self._fns[key]
+
+    def _seal(self, f, nb: int):
+        """Local plane: jit compiles lazily on first call (no peers to
+        coordinate with).  The global plane overrides with an AOT
+        compile + barrier."""
+        return f
+
+    def _verify_compact(self, dev, host_ok):
+        """Overlapped compact-ladder mesh launch (the portable path —
+        CPU mesh tests, non-TPU backends, and the global plane): pad to
+        the usual pow2 bucket rounded to a shard multiple, then launch
+        double-buffered chunks of nshard * mesh_chunk_lanes() rows —
+        chunk j+1's per-shard device_puts are issued right after chunk
+        j's dispatch, so H2D hides behind compute exactly like
+        split_chunked_launch, and the put walls feed the devobs
+        chunk_overlap ratio the control plane steers the chunk knob on.
+        Bitmap identical to the single-device ladder."""
+        import numpy as np
+
+        from tendermint_tpu.crypto import devobs
+        from tendermint_tpu.libs import fail
+
+        t0 = time.perf_counter()
+        # chaos seam: a raise here degrades this batch to the
+        # single-device ladder in ops/ed25519.verify_batch
+        fail.inject(self.FAIL_SITE)
+        obs_on = devobs.is_enabled()
+        n = host_ok.shape[0]
+        nshard = self.nshard
+        nb = max(-(-edops.bucket_size(n) // nshard) * nshard, nshard)
+        padded = edops._pad_dev(dict(dev), n, nb)
+        live = np.zeros(nb, dtype=bool)
+        live[:n] = True
+        chunk_max = nshard * mesh_chunk_lanes()
+        if not (chunk_max < nb and nb % chunk_max == 0):
+            chunk_max = nb
+        starts = list(range(0, nb, chunk_max))
+        names = ("pub", "r", "s_digits", "k_digits")
+        specs = (P(BATCH_AXIS),) * 5
+        stage_s = time.perf_counter() - t0
+        fn = self._step_fn(chunk_max)
+        chunk_walls = []
+
+        def stage(a):
+            w = []
+            args = self._put_sharded(
+                tuple(padded[k][a:a + chunk_max] for k in names)
+                + (live[a:a + chunk_max],), specs, walls=w)
+            chunk_walls.append(w)
+            return args
+
+        row_bytes = 32 + 32 + 64 + 64 + 1
+        inflight = min(nb, 2 * chunk_max) * row_bytes
+        devobs.ledger_add("staging", inflight)
+        outs, flags = [], []
+        try:
+            nxt = stage(0)
+            for ci, _s in enumerate(starts):
+                cur = nxt
+                bm, av = fn(*cur)
+                outs.append(bm)
+                flags.append(av)
+                if ci + 1 < len(starts):
+                    nxt = stage(starts[ci + 1])
+        finally:
+            devobs.ledger_add("staging", -inflight)
+        t_col = time.perf_counter()
+        res = np.concatenate([np.asarray(o) for o in outs]) \
+            if len(outs) > 1 else np.asarray(outs[0])
+        all_valid = all(bool(np.asarray(f)) for f in flags)
+        drain_s = time.perf_counter() - t_col
+        # all_valid is the device-reduced verdict every process of a
+        # global mesh observes identically (the psum'd bit of the
+        # acceptance criteria); recorded even with devobs off
+        extra = {"all_valid": all_valid}
+        if obs_on:
+            probe = {"stage_s": stage_s}
+            self._merge_probe(probe, chunk_walls, len(starts))
+            extra.update(edops._overlap_phases({
+                "stage_s": probe["stage_s"],
+                "dma_s": probe.get("dma_s", 0.0),
+                "dma_first_s": probe.get("dma_first_s", 0.0),
+                "chunks": probe.get("chunks", len(starts))}))
+            if probe.get("shard_h2d_s"):
+                extra["shard_h2d_s"] = probe["shard_h2d_s"]
+            extra["drain_s"] = drain_s
+            extra.update(devobs.shard_fields(n, nb, nshard))
+        edops._record_launch(self.MESH_PATH, n, nb,
+                             time.perf_counter() - t0, shards=nshard,
+                             extra=extra)
+        return res[:n] & host_ok
 
     def verify_batch(self, pubkeys, msgs, sigs):
         """Mesh-sharded equivalent of ops/ed25519.verify_batch: identical
@@ -368,9 +938,7 @@ class _DataPlane:
                 extra.update(devobs.shard_fields(n, nb, self.nshard))
         else:
             dev, host_ok = edops.prepare_batch(pubkeys, sigs, msgs)
-            n = host_ok.shape[0]
-            return self._compact()(dev, bucket=True,
-                                   shards=self.nshard) & host_ok
+            return self._verify_compact(dev, host_ok)
         t_col = time.perf_counter()
         res = np.asarray(out)
         if extra is not None:
@@ -382,6 +950,42 @@ class _DataPlane:
                              time.perf_counter() - t0, shards=self.nshard,
                              extra=extra)
         return res[:n] & host_ok
+
+
+class _GlobalDataPlane(_DataPlane):
+    """The cross-process execution plane (ADR-027): the same sharded
+    compact ladder as _DataPlane but over ALL processes' devices
+    (jax.devices()), with each process staging only its addressable
+    shards (_put_sharded skips non-local mesh positions) and both
+    outputs replicated — the bitmap all-gather and the psum'd all-valid
+    bit arrive identically on every process.  Kernels compile AHEAD of
+    the first collective call with a coordination-service barrier after
+    the compile, so no process dispatches into a collective a peer is
+    still compiling.  Only reachable through global_plane(), i.e. from
+    inside a lockstep() window (blocksync replay_window, coordinated
+    bulk verify) — reactor-driven traffic keeps the local plane."""
+
+    MESH_PATH = "global-mesh"
+    FAIL_SITE = "sharding.global_plane"
+
+    def _seal(self, f, nb: int):
+        import numpy as np
+
+        batch_sharded = NamedSharding(self.mesh, P(BATCH_AXIS))
+        shapes = (((nb, 32), np.uint8), ((nb, 32), np.uint8),
+                  ((nb, 64), np.int8), ((nb, 64), np.int8),
+                  ((nb,), np.bool_))
+        args = [jax.ShapeDtypeStruct(s, d, sharding=batch_sharded)
+                for s, d in shapes]
+        compiled = f.lower(*args).compile()
+        _barrier(f"tm_tpu_gmesh_step_{nb}")
+        return compiled
+
+    def verify_batch(self, pubkeys, msgs, sigs):
+        # the compact ladder is the one kernel shape proven over DCN;
+        # the fused Pallas path stays per-process for now (ADR-027)
+        dev, host_ok = edops.prepare_batch(pubkeys, sigs, msgs)
+        return self._verify_compact(dev, host_ok)
 
 
 def make_sharded_verifier(mesh: Mesh, axis: str = BATCH_AXIS):

@@ -10,7 +10,20 @@ writes its addressable bitmap shards for the parent to stitch and
 check.  XLA inserts the cross-process collective for the replicated
 all-valid bit (the psum in make_sharded_verifier's out_shardings).
 
-Usage: python multihost_worker.py <pid> <nproc> <coord> <npz> <out>
+Two modes (argv[6], default "raw"):
+
+  raw   — the original dryrun: make_sharded_verifier driven directly,
+          per-process addressable bitmap shards written for the parent
+          to stitch.
+  prod  — the PRODUCTION path (ADR-027): ops/ed25519.verify_batch
+          called inside a sharding.lockstep() window, exactly the shape
+          blocksync replay_window / coordinated bulk verify produce.
+          The route must come back "global-mesh" with the psum'd
+          all-valid bit in the launch record; the returned bitmap is
+          replicated, so each process emits the FULL bitmap and the
+          parent asserts both copies equal the host oracle.
+
+Usage: python multihost_worker.py <pid> <nproc> <coord> <npz> <out> [mode]
 Env: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4
 """
 from __future__ import annotations
@@ -25,9 +38,44 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _main_prod(pid, nproc, npz_path, out_path):
+    """Production route: verify_batch under lockstep() — the global
+    mesh plane end-to-end, including the AOT-compile + barrier seal and
+    the per-process addressable staging inside _put_sharded."""
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519 as edops
+    from tendermint_tpu.parallel import sharding as shd
+
+    assert shd.global_mesh_ready(), "distributed runtime not detected"
+
+    data = np.load(npz_path)
+    pubs = [bytes(p) for p in data["pubs"]]
+    sigs = [bytes(s) for s in data["sigs"]]
+    msgs = [bytes(m) for m in data["msgs"]]
+
+    with shd.lockstep():
+        bitmap = edops.verify_batch(pubs, msgs, sigs)
+    ll = edops.last_launch()
+    with open(out_path, "w") as f:
+        json.dump({
+            "pid": pid,
+            "path": ll.get("path"),
+            "shards": ll.get("shards"),
+            "all_valid": ll.get("all_valid"),
+            # a backend without multi-process computations (CPU jaxlib
+            # today) latches the global plane off after the first real
+            # collective fault; the parent asserts the degrade contract
+            # in that case instead of the global route
+            "global_latched_off": shd._GLOBAL_PLANE is False,
+            "bitmap": np.asarray(bitmap).astype(int).tolist(),
+        }, f)
+
+
 def main():
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     coord, npz_path, out_path = sys.argv[3], sys.argv[4], sys.argv[5]
+    mode = sys.argv[6] if len(sys.argv) > 6 else "raw"
 
     import jax
 
@@ -39,6 +87,10 @@ def main():
                                num_processes=nproc, process_id=pid)
     assert len(jax.devices()) == 4 * nproc, jax.devices()
     assert len(jax.local_devices()) == 4
+
+    if mode == "prod":
+        _main_prod(pid, nproc, npz_path, out_path)
+        return
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -75,7 +75,7 @@ def test_every_declared_spec_row_is_well_formed():
         lo, hi = s.safe_range
         assert lo <= hi and s.step > 0
         assert s.mode in ("throughput", "admission", "backlog",
-                          "pressure")
+                          "pressure", "overlap")
         assert SPEC_BY_NAME[s.name] is s
 
 
@@ -200,6 +200,48 @@ def test_backlog_pinned_grow_calm_recover():
     assert (d.value, d.reason) == (5.0, "queue-pinned")
     d = _decide(ctl, k, COLD, src(1.0))   # calm: back toward static
     assert (d.value, d.reason) == (4.0, "calm-recover")
+
+
+def test_overlap_shrink_on_fresh_low_recover_on_healthy_or_idle():
+    """The mesh staging-chunk policy (ADR-027): only a CHANGED
+    chunk_overlap gauge value counts as a fresh launch (the gauge holds
+    its last value between launches — steering on a stale reading would
+    walk the knob to the bound on an idle mesh); fresh-and-low shrinks
+    the raw chunk, healthy or idle periods recover toward static."""
+    ctl = Controller(period_ms=10, recover_after=2)
+    h = Holder(4096.0)
+    spec = _spec(mode="overlap", name="t_chunk", rng=(1024.0, 65536.0),
+                 step=1024.0, direction=-1, signal="chunk_overlap")
+    k = ctl.register(spec, h.get, h.set)
+
+    def src(ratio):
+        class G:
+            def value(self, **kw):
+                return ratio
+        return {spec.signal: G()}
+
+    # the first reading has no history: never a step (idle, not fresh)
+    assert _decide(ctl, k, COLD, src(0.10)) is None
+    # unchanged gauge = no launch since: still no step
+    assert _decide(ctl, k, COLD, src(0.10)) is None
+    # a CHANGED low ratio is a fresh overlapped launch: shrink
+    d = _decide(ctl, k, COLD, src(0.05))
+    assert (d.direction, d.value, d.reason) == ("shrink", 3072.0,
+                                                "overlap-low")
+    d = _decide(ctl, k, COLD, src(0.03))
+    assert d.value == 2048.0 and h.v == 2048.0
+    # fresh healthy readings: recover toward static after recover_after
+    assert _decide(ctl, k, COLD, src(0.55)) is None
+    d = _decide(ctl, k, COLD, src(0.60))
+    assert (d.value, d.reason) == (3072.0, "overlap-recover")
+    # the path going idle (gauge frozen) also recovers toward static
+    assert _decide(ctl, k, COLD, src(0.60)) is None
+    d = _decide(ctl, k, COLD, src(0.60))
+    assert (d.value, d.reason) == (4096.0, "overlap-recover")
+    assert h.v == k.static
+    # pinned at the declared floor: a shrink below lo clamps to prev
+    h.v = 1024.0
+    assert _decide(ctl, k, COLD, src(0.01)) is None
 
 
 def test_decision_seam_refusal_and_error_containment():

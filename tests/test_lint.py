@@ -395,7 +395,7 @@ CHAOS_TEST_FILES = ("test_chaos_matrix.py", "test_comb.py",
                     "test_control.py", "test_degrade.py",
                     "test_devobs.py", "test_ingress.py",
                     "test_latency_observatory.py",
-                    "test_light_serve.py",
+                    "test_light_serve.py", "test_mesh_sweep.py",
                     "test_netharness.py", "test_netobs.py",
                     "test_observatory.py",
                     "test_pipeline.py", "test_propose_fastpath.py",
@@ -435,7 +435,8 @@ def test_every_registered_chaos_site_is_exercised():
     from tendermint_tpu.libs import fail
 
     armed = _armed_sites()
-    static = {s for s in fail.REGISTERED_SITES if s.startswith("ops.")}
+    static = {s for s in fail.REGISTERED_SITES
+              if s.startswith(("ops.", "sharding."))}
     missing = static - armed
     assert not missing, (
         f"registered chaos sites never armed by {CHAOS_TEST_FILES}: "

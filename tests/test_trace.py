@@ -207,7 +207,7 @@ def test_batch_verifier_span_tree_and_exports(_device_lane, tmp_path):
     # the kernel dispatch under the launch, carrying route + occupancy
     opsspan = spans["ops.ed25519.verify_batch"]
     assert opsspan["parent"] == launch["id"]
-    assert opsspan["attrs"]["path"] in ("mesh-sharded", "xla")
+    assert opsspan["attrs"]["path"] in ("mesh-xla", "mesh-sharded", "xla")
     assert opsspan["attrs"]["nb"] == 64
     assert opsspan["attrs"]["occupancy"] == pytest.approx(40 / 64)
 
@@ -268,7 +268,7 @@ def test_last_launch_snapshot_is_immutable(_device_lane):
     ok, bits = _mixed_batch_verify()
     assert ok
     rec = edops.last_launch()
-    assert rec["path"] in ("mesh-sharded", "xla")
+    assert rec["path"] in ("mesh-xla", "mesh-sharded", "xla")
     assert rec["nb"] == 64 and rec["shards"] >= 1
     with pytest.raises(TypeError):
         rec["path"] = "tampered"
