@@ -392,11 +392,15 @@ class DeviceLaneRuntime:
                         return fn(*args)
                 return fn(*args)
         try:
-            return self._get_pool().submit(_launch)
+            f = self._get_pool().submit(_launch)
         except Exception as e:  # noqa: BLE001 - e.g. pool at shutdown
-            f: _cf.Future = _cf.Future()
+            f = _cf.Future()
             f.set_exception(e)
-            return f
+        # collect() reads this on a wedge: a lockstep launch that times
+        # out is the global collective's signature hang (a peer never
+        # entered), and the latch must trip on the FIRST one
+        f.tm_lockstep = locked
+        return f
 
     def collect(self, site: str, fut: _cf.Future,
                 host_fn: Callable[[], np.ndarray],
@@ -433,6 +437,18 @@ class DeviceLaneRuntime:
                     reason = "timeout"
                     self._quarantine_pool()
                     fut.cancel()
+                    from tendermint_tpu.parallel import sharding
+                    if getattr(fut, "tm_lockstep", False) and \
+                            sharding.global_mesh_ready():
+                        # a coordinated launch wedged past the deadline
+                        # on a multi-process runtime means a collective
+                        # a peer never entered: latch the global plane
+                        # off NOW (and poison it job-wide) rather than
+                        # burning one launch deadline per subsequent
+                        # batch — the worst case for a purely local
+                        # wedge is an overly cautious fallback,
+                        # verification stays exact either way
+                        sharding.disable_global_plane()
             except Exception as e:  # noqa: BLE001 - any fault degrades
                 reason = "integrity" if isinstance(e, DeviceLaneError) \
                     else "raise"

@@ -376,6 +376,10 @@ class DevObs:
                 m.device_drain.observe(r["drain_s"], path=path)
             if r.get("chunk_overlap") is not None:
                 m.chunk_overlap.set(r["chunk_overlap"])
+                # the companion launch-sequence gauge the control
+                # plane's overlap mode reads for freshness: a stable
+                # ratio republished by a busy path still advances it
+                m.chunk_overlap_seq.set(r.get("obs_seq", 0))
             if r.get("shard_imbalance") is not None:
                 m.shard_imbalance.set(r["shard_imbalance"])
             sh = r.get("shard_h2d_s")

@@ -128,10 +128,14 @@ class KnobSpec:
 #               static after `recover_after` clean periods.
 #   overlap     move one step in the declared direction while FRESH
 #               launches publish the signal (a ratio gauge) below the
-#               overlap target — only a changed gauge value counts as
-#               fresh, so an idle path never walks its knob to the
-#               bound; recover toward static once the ratio is healthy
-#               or the path idles for `recover_after` periods.
+#               overlap target — freshness tracks the companion
+#               "<signal>_seq" launch-sequence gauge when one is
+#               published (a busy path repeatedly reporting the SAME
+#               stable ratio still counts), falling back to a changed
+#               gauge value otherwise, so an idle path never walks its
+#               knob to the bound; recover toward static once the
+#               ratio is healthy or the path idles for
+#               `recover_after` periods.
 # ---------------------------------------------------------------------------
 
 KNOB_SPECS: Tuple[KnobSpec, ...] = (
@@ -172,8 +176,8 @@ class Knob:
                  "static", "integral",
                  # per-knob controller bookkeeping (mutated only from
                  # the decision loop / under Controller._lock)
-                 "last_signal", "clean_periods", "idle_periods",
-                 "engaged")
+                 "last_signal", "last_seq", "clean_periods",
+                 "idle_periods", "engaged")
 
     def __init__(self, spec: KnobSpec, getter: Callable[[], float],
                  setter: Callable[[float], object],
@@ -197,6 +201,7 @@ class Knob:
         self.integral = bool(integral)
         self.static = float(getter())
         self.last_signal: Optional[float] = None
+        self.last_seq: Optional[float] = None
         self.clean_periods = 0
         self.idle_periods = 0
         # admission knobs with static == 0 (unlimited) only cap once
@@ -325,6 +330,7 @@ class Controller(BaseService):
             k.idle_periods = 0
             k.engaged = False
             k.last_signal = None
+            k.last_seq = None
         with self._lock:
             self._ring.extend(decs)
             self._reverted = True
@@ -466,7 +472,7 @@ class Controller(BaseService):
             elif mode == "backlog":
                 target, why = self._backlog(k, prev, sig)
             elif mode == "overlap":
-                target, why = self._overlap(k, prev, sig)
+                target, why = self._overlap(k, prev, sig, sources)
             else:  # pressure
                 target, why = self._pressure(k, prev, sources)
             k.last_signal = sig
@@ -566,17 +572,36 @@ class Controller(BaseService):
             return self._toward(prev, k.static, k.step), "calm-recover"
         return None, ""
 
-    def _overlap(self, k: Knob, prev: float, sig: Optional[float]):
+    def _overlap(self, k: Knob, prev: float, sig: Optional[float],
+                 sources: dict):
         """Shrink the staging chunk (the declared direction) while
         fresh overlapped mesh launches report the transfer/compute
         overlap ratio below target — more, smaller chunks give the
         double buffer more compute to hide H2D behind; recover toward
-        static once the ratio is healthy or the path goes idle.  Only
-        a CHANGED gauge value counts as a fresh launch: the gauge holds
-        its last value between launches, and steering on a stale
-        reading would walk the knob to the bound on an idle mesh."""
-        fresh = (sig is not None and k.last_signal is not None
-                 and sig != k.last_signal)
+        static once the ratio is healthy or the path goes idle.
+        Freshness tracks the companion "<signal>_seq" launch-sequence
+        gauge when the bundle publishes one: the ratio gauge holds its
+        last value between launches, so steering on a stale reading
+        would walk the knob to the bound on an idle mesh — but a busy
+        path repeatedly publishing the SAME (quantized/stable) low
+        ratio must still register as fresh, which only a monotonic
+        launch counter can distinguish.  Without a seq gauge (older
+        bundles, tests with bare sources) a changed value is the
+        fallback freshness test."""
+        seq = None
+        m = sources.get(k.spec.signal + "_seq")
+        if m is not None:
+            try:
+                seq = float(m.value(**k.spec.labels))
+            except Exception:  # noqa: BLE001 - unpublished seq gauge
+                seq = None
+        if seq is not None:
+            fresh = (sig is not None and k.last_seq is not None
+                     and seq != k.last_seq)
+        else:
+            fresh = (sig is not None and k.last_signal is not None
+                     and sig != k.last_signal)
+        k.last_seq = seq
         if fresh and sig < _OVERLAP_TARGET:
             k.clean_periods = 0
             k.idle_periods = 0

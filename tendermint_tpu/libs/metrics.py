@@ -637,6 +637,13 @@ class DevObsMetrics:
             "host->device DMA wall issued while a previous chunk's "
             "kernel was in flight (1 = transfer fully hidden behind "
             "compute, 0 = serial).")
+        self.chunk_overlap_seq = reg.gauge(
+            "crypto", "device_chunk_overlap_seq",
+            "Observatory sequence number of the launch that last set "
+            "crypto_device_chunk_overlap_ratio — the control plane's "
+            "overlap mode compares it across periods so a busy path "
+            "repeatedly publishing the same stable ratio still reads "
+            "as fresh (a frozen ratio AND a frozen seq = idle).")
         self.shard_imbalance = reg.gauge(
             "crypto", "device_shard_imbalance",
             "max/mean real rows per shard of the most recent mesh "

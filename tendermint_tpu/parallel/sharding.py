@@ -48,6 +48,16 @@ def make_mesh(devices=None, axis: str = BATCH_AXIS) -> Mesh:
 # launches stay inside the known compile-bucket shapes (tmlint
 # CompileSentinel) — additive knob steps still move the effective
 # chunk whenever they cross a power-of-two boundary.
+#
+# The knob governs the LOCAL plane only.  The global plane's chunk
+# count is part of a cross-process collective's shape: every process
+# must launch the same chunks in the same order, and the knob is
+# steered per-process (each controller reads its own chunk_overlap,
+# each process its own TM_TPU_MESH_CHUNK), so two peers whose knobs
+# drift across a power-of-two boundary would dispatch mismatched
+# collectives — a deadlock.  _GlobalDataPlane therefore pins its
+# chunk size to the code-constant default (_static_chunk_lanes),
+# identical on every process by construction.
 # ---------------------------------------------------------------------------
 
 MESH_CHUNK_DEFAULT = edops.SPLIT_CHUNK  # per-shard lanes per H2D chunk
@@ -73,6 +83,16 @@ def mesh_chunk_lanes() -> int:
     clamped into [_MESH_CHUNK_MIN, MAX_CHUNK] and floored to a power
     of two."""
     v = max(_MESH_CHUNK_MIN, min(mesh_chunk_raw(), edops.MAX_CHUNK))
+    return 1 << (v.bit_length() - 1)
+
+
+def _static_chunk_lanes() -> int:
+    """The chunk size with every per-process input excluded — no env
+    var, no override, no governed knob, just the code-constant default
+    clamped and floored exactly like mesh_chunk_lanes().  This is the
+    only chunk value safe to bake into a cross-process collective's
+    shape: identical on every process running the same build."""
+    v = max(_MESH_CHUNK_MIN, min(MESH_CHUNK_DEFAULT, edops.MAX_CHUNK))
     return 1 << (v.bit_length() - 1)
 
 
@@ -147,6 +167,7 @@ def invalidate_on_topology_change() -> bool:
         _PLANE = None
         _PLANE_KEY = None
         _GLOBAL_PLANE = None
+    _clear_poison()
     return True
 
 
@@ -196,7 +217,11 @@ def global_mesh_ready() -> bool:
 def global_plane():
     """The cross-process mesh plane over jax.devices(), or None.  Only
     returned INSIDE a lockstep() window on a multi-process runtime —
-    everywhere else callers get None and stay on the local plane."""
+    everywhere else callers get None and stay on the local plane.  A
+    peer's latch-off poisons a coordination-service key; the throttled
+    check here latches THIS process too, so one faulted participant
+    costs the job at most the in-flight batch instead of one degrade
+    timeout per peer per batch (ADR-027)."""
     global _GLOBAL_PLANE
     if not in_lockstep() or not global_mesh_ready():
         return None
@@ -209,32 +234,111 @@ def global_plane():
                     return None
                 _GLOBAL_PLANE = _GlobalDataPlane(make_mesh(devs)) \
                     if len(devs) > 1 else False
+    if _GLOBAL_PLANE and _peer_latched_off():
+        with _PLANE_LOCK:
+            _GLOBAL_PLANE = False
     return _GLOBAL_PLANE or None
+
+
+def _coord_client():
+    """The jax.distributed coordination-service client, or None when
+    the runtime is single-process / uninitialized / too old."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - old jax without the service
+        return None
+
+
+# every process that latches the global plane off writes a key under
+# this directory; peers poll it (throttled, non-blocking dir listing)
+# so a persistent per-process latch converges across the job instead
+# of draining one degrade timeout per peer per batch
+_GMESH_POISON_DIR = "tm_tpu_gmesh_disabled"
+_POISON_CHECK_EVERY_S = 2.0
+_poison_next_check = 0.0
+_poison_seen = False
 
 
 def disable_global_plane():
     """Latch the global plane OFF for this process (ops/ed25519 calls
     this when a real — non-chaos — collective/compile fault surfaces,
-    e.g. a backend without multi-process computation support).  The
-    latch holds until a topology change re-probe
-    (invalidate_on_topology_change) clears it."""
+    e.g. a backend without multi-process computation support; degrade's
+    settle calls it when a lockstep launch wedges past the launch
+    deadline).  The latch holds until a topology change re-probe
+    (invalidate_on_topology_change) clears it.  Best-effort, the latch
+    is also published to the coordination service so healthy peers stop
+    routing lockstep batches into a collective this process will never
+    enter again (see global_plane)."""
     global _GLOBAL_PLANE
     with _PLANE_LOCK:
         _GLOBAL_PLANE = False
+    client = _coord_client()
+    if client is None:
+        return
+    try:
+        pid = jax.process_index()
+    except Exception:  # noqa: BLE001 - runtime shutting down
+        pid = 0
+    try:
+        client.key_value_set(f"{_GMESH_POISON_DIR}/{pid}", "1")
+    except Exception:  # noqa: BLE001 - poison publication is advisory;
+        pass            # peers still converge on their own timeouts
+
+
+def _peer_latched_off() -> bool:
+    """True when any process of the job has published a global-plane
+    latch-off.  Non-blocking (key_value_dir_get lists what exists now)
+    and throttled to one coordination-service round trip per
+    _POISON_CHECK_EVERY_S; never raises."""
+    global _poison_next_check, _poison_seen
+    if _poison_seen:
+        return True
+    client = _coord_client()
+    if client is None:
+        return False
+    now = time.monotonic()
+    if now < _poison_next_check:
+        return False
+    _poison_next_check = now + _POISON_CHECK_EVERY_S
+    try:
+        entries = client.key_value_dir_get(_GMESH_POISON_DIR)
+    except Exception:  # noqa: BLE001 - coordinator unreachable: the
+        return False    # per-process latches still converge
+    _poison_seen = bool(entries)
+    return _poison_seen
+
+
+def _clear_poison():
+    """Topology re-probe cleared the local latch: drop the published
+    poison keys too (best-effort — a re-probe is the one event that
+    declares the collective worth retrying, ADR-027)."""
+    global _poison_next_check, _poison_seen
+    _poison_seen = False
+    _poison_next_check = 0.0
+    client = _coord_client()
+    if client is None:
+        return
+    try:
+        client.key_value_delete(f"{_GMESH_POISON_DIR}/")
+    except Exception:  # noqa: BLE001 - stale poison then re-latches
+        pass            # via _peer_latched_off, never crashes a probe
 
 
 def _barrier(name: str, timeout_ms: int = 240_000):
     """Cross-process rendezvous on the jax.distributed coordination
     service (no-op single-process / uninitialized): the global plane
     barriers after each ahead-of-time kernel compile so no process
-    dispatches into a collective a peer is still compiling."""
-    try:
-        from jax._src import distributed
-        client = distributed.global_state.client
-        if client is not None:
-            client.wait_at_barrier(name, timeout_ms)
-    except Exception:  # noqa: BLE001 - single-process or old jax: the
-        pass            # compile skew risk is absent or accepted
+    dispatches into a collective a peer is still compiling.  A REAL
+    rendezvous failure — timeout, missing peer, mismatched barrier
+    name — must propagate: proceeding would dispatch into a collective
+    a peer never entered, the exact hazard the barrier guards against.
+    verify_batch's exception handler turns the raise into a latched
+    local fallback."""
+    client = _coord_client()
+    if client is None:
+        return
+    client.wait_at_barrier(name, timeout_ms)
 
 
 class _DataPlane:
@@ -248,6 +352,13 @@ class _DataPlane:
         self.nshard = int(mesh.devices.size)
         self._fns = {}
         self._lock = __import__("threading").Lock()
+
+    def _chunk_lanes(self) -> int:
+        """Per-shard lanes of one staging chunk.  The local plane reads
+        the live governed knob; the global plane overrides with the
+        static code constant — its chunk count is collective shape and
+        must match on every process (module comment above)."""
+        return mesh_chunk_lanes()
 
     def worth_sharding(self, n: int) -> bool:
         """Small hot-path batches (a consensus vote window) stay on one
@@ -535,17 +646,26 @@ class _DataPlane:
                 NamedSharding(self.mesh, P()))
             tbytes = (self.nshard - 1) * entry.k_pad * \
                 edops._TABLE_BYTES_PER_KEY
-            prev = cached[2] if cached is not None else 0
-            cached = (self.mesh, repl, tbytes)
-            entry.mesh_repl = cached
-            devobs.ledger_add("mesh_tables", tbytes - prev)
+            # the check-and-set plus the ledger charge are one atomic
+            # unit: two racing first launches both device_put (benign —
+            # the loser's copy is garbage once its launch retires) but
+            # only the winner commits and charges, so the mesh_tables
+            # gauge never counts bytes _table_evicted frees only once
+            with self._lock:
+                cur = entry.mesh_repl
+                if cur is not None and cur[0] is self.mesh:
+                    return cur[1]
+                prev = cur[2] if cur is not None else 0
+                cached = (self.mesh, repl, tbytes)
+                entry.mesh_repl = cached
+                devobs.ledger_add("mesh_tables", tbytes - prev)
         return cached[1]
 
     def _run_comb_chunks(self, launch, r_b, s_digits, k_digits, vidx,
                          probe):
         """Double-buffered chunk driver for the replicated mesh comb:
         pad to the usual pow2 bucket rounded to a shard multiple, split
-        into chunks of nshard * mesh_chunk_lanes() rows when that
+        into chunks of nshard * _chunk_lanes() rows when that
         divides the bucket (it always does for pow2 shard counts), and
         issue chunk j+1's per-shard device_puts right after chunk j's
         dispatch so H2D hides behind compute — the same discipline as
@@ -557,7 +677,8 @@ class _DataPlane:
 
         nshard = self.nshard
         n = r_b.shape[0]
-        lanes = min(mesh_chunk_lanes(), max(1, edops.MAX_CHUNK // nshard))
+        lanes = min(self._chunk_lanes(),
+                    max(1, edops.MAX_CHUNK // nshard))
         chunk_max = nshard * lanes
         nb = max(-(-edops.bucket_size(n) // nshard) * nshard, nshard)
         if not (chunk_max < nb and nb % chunk_max == 0):
@@ -672,10 +793,16 @@ class _DataPlane:
                    jax.device_put(by, repl), jax.device_put(bm, repl),
                    jax.device_put(bt, repl))
             tbytes = entry.k_pad * edops._TABLE_BYTES_PER_KEY
-            prev = cached[2] if cached is not None else 0
-            cached = (self.mesh, ops, tbytes)
-            entry.mesh_shard = cached
-            devobs.ledger_add("mesh_tables", tbytes - prev)
+            # atomic check-and-set + charge, same discipline (and same
+            # double-charge hazard) as _comb_repl_operands above
+            with self._lock:
+                cur = entry.mesh_shard
+                if cur is not None and cur[0] is self.mesh:
+                    return cur[1]
+                prev = cur[2] if cur is not None else 0
+                cached = (self.mesh, ops, tbytes)
+                entry.mesh_shard = cached
+                devobs.ledger_add("mesh_tables", tbytes - prev)
         return cached[1]
 
     def _verify_comb_sharded(self, r_b, s_digits, k_digits, vidx, entry,
@@ -794,7 +921,7 @@ class _DataPlane:
         """Overlapped compact-ladder mesh launch (the portable path —
         CPU mesh tests, non-TPU backends, and the global plane): pad to
         the usual pow2 bucket rounded to a shard multiple, then launch
-        double-buffered chunks of nshard * mesh_chunk_lanes() rows —
+        double-buffered chunks of nshard * _chunk_lanes() rows —
         chunk j+1's per-shard device_puts are issued right after chunk
         j's dispatch, so H2D hides behind compute exactly like
         split_chunked_launch, and the put walls feed the devobs
@@ -816,7 +943,7 @@ class _DataPlane:
         padded = edops._pad_dev(dict(dev), n, nb)
         live = np.zeros(nb, dtype=bool)
         live[:n] = True
-        chunk_max = nshard * mesh_chunk_lanes()
+        chunk_max = nshard * self._chunk_lanes()
         if not (chunk_max < nb and nb % chunk_max == 0):
             chunk_max = nb
         starts = list(range(0, nb, chunk_max))
@@ -967,6 +1094,14 @@ class _GlobalDataPlane(_DataPlane):
 
     MESH_PATH = "global-mesh"
     FAIL_SITE = "sharding.global_plane"
+
+    def _chunk_lanes(self) -> int:
+        # the chunk count is part of the cross-process collective's
+        # shape: the per-process governed knob (and TM_TPU_MESH_CHUNK)
+        # is excluded here — two peers steered across a power-of-two
+        # boundary would otherwise launch mismatched chunk sequences
+        # into the same collective and deadlock the job
+        return _static_chunk_lanes()
 
     def _seal(self, f, nb: int):
         import numpy as np

@@ -244,6 +244,49 @@ def test_overlap_shrink_on_fresh_low_recover_on_healthy_or_idle():
     assert _decide(ctl, k, COLD, src(0.01)) is None
 
 
+def test_overlap_freshness_tracks_launch_seq():
+    """With the companion <signal>_seq gauge published, freshness is
+    the LAUNCH SEQUENCE, not the ratio value: a busy mesh path that
+    repeatedly publishes the same stable low ratio keeps stepping the
+    knob (the value-change test would misread it as idle and walk the
+    knob back toward static with the overlap target unmet), while a
+    frozen seq — no launches — still reads as idle."""
+    ctl = Controller(period_ms=10, recover_after=2)
+    h = Holder(4096.0)
+    spec = _spec(mode="overlap", name="t_chunk_seq",
+                 rng=(1024.0, 65536.0), step=1024.0, direction=-1,
+                 signal="chunk_overlap")
+    k = ctl.register(spec, h.get, h.set)
+
+    def src(ratio, seq):
+        class G:
+            def __init__(self, v):
+                self.v = v
+
+            def value(self, **kw):
+                return self.v
+        return {spec.signal: G(ratio),
+                spec.signal + "_seq": G(float(seq))}
+
+    # first reading seeds the seq history: never a step
+    assert _decide(ctl, k, COLD, src(0.10, 1)) is None
+    # SAME ratio, advancing seq = fresh launches below target: shrink
+    d = _decide(ctl, k, COLD, src(0.10, 2))
+    assert (d.direction, d.value, d.reason) == ("shrink", 3072.0,
+                                                "overlap-low")
+    d = _decide(ctl, k, COLD, src(0.10, 3))
+    assert d.value == 2048.0 and h.v == 2048.0
+    # frozen seq = no launches: idle periods recover toward static
+    assert _decide(ctl, k, COLD, src(0.10, 3)) is None
+    d = _decide(ctl, k, COLD, src(0.10, 3))
+    assert (d.value, d.reason) == (3072.0, "overlap-recover")
+    # healthy fresh launches also recover, exactly like the value test
+    assert _decide(ctl, k, COLD, src(0.80, 4)) is None
+    d = _decide(ctl, k, COLD, src(0.80, 5))
+    assert (d.value, d.reason) == (4096.0, "overlap-recover")
+    assert h.v == k.static
+
+
 def test_decision_seam_refusal_and_error_containment():
     ctl = Controller(period_ms=10)
     h = Holder(64.0)

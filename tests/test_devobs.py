@@ -176,6 +176,10 @@ def test_publish_pending_feeds_metrics_and_slo():
         assert m.device_drain.count(path="pallas-split") == 1
         assert m.device_collect.count(path="pallas-split") == 0
         assert m.chunk_overlap.value() == 0.75
+        # the companion freshness gauge advances with the launch's
+        # observatory seq, so the control plane can tell "busy path
+        # republishing the same ratio" from "idle path"
+        assert m.chunk_overlap_seq.value() == 1.0
         assert m.shard_imbalance.value() == 1.25
         # per-shard put walls [0.1, 0.3]: max/mean = 0.3/0.2
         assert m.shard_h2d_imbalance.value() == pytest.approx(1.5)

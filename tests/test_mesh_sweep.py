@@ -38,12 +38,16 @@ def _mesh_state():
         saved = (sharding._PLANE, sharding._PLANE_KEY,
                  sharding._GLOBAL_PLANE)
     sharding.set_mesh_chunk(None)
+    sharding._poison_seen = False
+    sharding._poison_next_check = 0.0
     fail.reset()
     edops._comb_enabled_override = None
     edops._comb_min_override = None
     edops._table_budget_override = None
     yield
     sharding.set_mesh_chunk(None)
+    sharding._poison_seen = False
+    sharding._poison_next_check = 0.0
     fail.reset()
     edops._comb_enabled_override = None
     edops._comb_min_override = None
@@ -311,6 +315,204 @@ def test_chaos_mesh_comb_seam_fires_before_any_launch():
                              np.zeros(8, np.int32),
                              _FakeEntry(), None) is None
     assert fail.fired("sharding.mesh_comb", "raise") == fired0
+
+
+class _FakeCoord:
+    """A stand-in jax.distributed coordination client: a dict-backed
+    KV store plus a barrier log (wait_at_barrier raising is the real
+    client's timeout shape)."""
+
+    def __init__(self, barrier_error=None):
+        self.kv = {}
+        self.barriers = []
+        self.barrier_error = barrier_error
+
+    def key_value_set(self, key, val):
+        self.kv[key] = val
+
+    def key_value_dir_get(self, d):
+        return [(k, v) for k, v in sorted(self.kv.items())
+                if k.startswith(d)]
+
+    def key_value_delete(self, key):
+        pref = key.rstrip("/")
+        for k in [k for k in self.kv if k.startswith(pref)]:
+            del self.kv[k]
+
+    def wait_at_barrier(self, name, timeout_ms):
+        if self.barrier_error is not None:
+            raise self.barrier_error
+        self.barriers.append(name)
+
+
+def test_global_plane_pins_static_chunk_lanes(monkeypatch):
+    """The chunk count is part of the cross-process collective's
+    shape, and the knob/env are steered PER-PROCESS: the global plane
+    must pin the code-constant default while the local plane keeps
+    following the governed knob — otherwise two peers steered across a
+    power-of-two boundary launch mismatched chunk sequences into the
+    same collective and deadlock."""
+    monkeypatch.delenv("TM_TPU_MESH_CHUNK", raising=False)
+    gp = sharding._GlobalDataPlane(
+        sharding.make_mesh(sharding.jax.local_devices()))
+    local = sharding.data_plane()
+    assert local is not None
+    static = sharding._static_chunk_lanes()
+    assert static == sharding.mesh_chunk_lanes()  # untouched knob
+
+    sharding.set_mesh_chunk(static // 2)           # steer the knob
+    assert local._chunk_lanes() == static // 2
+    assert gp._chunk_lanes() == static             # pinned
+    monkeypatch.setenv("TM_TPU_MESH_CHUNK", str(static // 4))
+    sharding.set_mesh_chunk(None)                  # env now governs
+    assert local._chunk_lanes() == static // 4
+    assert gp._chunk_lanes() == static             # still pinned
+
+
+def test_barrier_propagates_real_rendezvous_failure(monkeypatch):
+    """_barrier exists so no process dispatches into a collective a
+    peer is still compiling: a REAL rendezvous failure (timeout,
+    missing peer) must propagate so verify_batch's handler latches the
+    plane off — only the no-service cases are silent no-ops."""
+    boom = _FakeCoord(barrier_error=RuntimeError("barrier deadline"))
+    monkeypatch.setattr(sharding, "_coord_client", lambda: boom)
+    with pytest.raises(RuntimeError, match="barrier deadline"):
+        sharding._barrier("tm_tpu_gmesh_step_64")
+    # single-process / uninitialized runtime: no peers, no-op
+    monkeypatch.setattr(sharding, "_coord_client", lambda: None)
+    sharding._barrier("tm_tpu_gmesh_step_64")
+
+
+def test_latch_poison_propagates_cross_process(monkeypatch):
+    """disable_global_plane publishes a per-process poison key;
+    global_plane() on a HEALTHY peer sees it and latches too — one
+    faulted participant costs the job at most the in-flight batch, not
+    one degrade timeout per peer per batch — and the topology re-probe
+    that clears the local latch clears the poison directory with it."""
+    coord = _FakeCoord()
+    monkeypatch.setattr(sharding, "_coord_client", lambda: coord)
+    monkeypatch.setattr(sharding.jax, "process_count", lambda: 2)
+    monkeypatch.delenv("TM_TPU_NO_MESH", raising=False)
+    monkeypatch.delenv("TM_TPU_NO_GLOBAL_MESH", raising=False)
+
+    # the faulting process publishes its latch
+    sharding.disable_global_plane()
+    assert any(k.startswith(sharding._GMESH_POISON_DIR)
+               for k in coord.kv)
+
+    # a healthy peer with a LIVE plane latches on sight of the poison
+    gp = sharding._GlobalDataPlane(
+        sharding.make_mesh(sharding.jax.local_devices()))
+    with sharding._PLANE_LOCK:
+        sharding._GLOBAL_PLANE = gp
+    sharding._poison_seen = False
+    sharding._poison_next_check = 0.0
+    with sharding.lockstep():
+        assert sharding.global_plane() is None
+    assert sharding._GLOBAL_PLANE is False
+
+    # topology re-probe clears the local latch AND the poison keys
+    assert sharding.data_plane() is not None   # populate _PLANE
+    with sharding._PLANE_LOCK:
+        sharding._PLANE_KEY = ("stale", -1)
+    assert sharding.invalidate_on_topology_change() is True
+    assert not coord.kv
+    assert sharding._poison_seen is False
+
+
+def test_mesh_tables_ledger_charges_once_under_race():
+    """Two threads racing the first comb-table replication both
+    device_put (benign — one copy wins the slot) but the mesh_tables
+    ledger must be charged exactly once: _table_evicted frees the
+    winning tuple's bytes once, so a double charge would drift the
+    gauge upward forever."""
+    import threading as th
+
+    plane = sharding.data_plane()
+    assert plane is not None
+    k_pad = 4
+    tables = type("T", (), {})()
+    for name in ("ypx", "ymx", "z", "t2d"):
+        setattr(tables, name, np.zeros((1, 1, 1, k_pad), np.uint32))
+    entry = _FakeEntry(k_pad=k_pad)
+    entry.tables = tables
+    entry.dec_ok = np.ones(k_pad, dtype=bool)
+    entry.index = ()                   # _table_evicted walks the keys
+    base = (np.zeros(1, np.uint32),) * 3
+    tbytes = (plane.nshard - 1) * k_pad * edops._TABLE_BYTES_PER_KEY
+
+    devobs.reset()
+    devobs.enable()
+    try:
+        start = th.Barrier(4)
+        outs = []
+
+        def racer():
+            start.wait()
+            outs.append(plane._comb_repl_operands(entry, base))
+
+        threads = [th.Thread(target=racer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every racer got the SAME committed tuple, charged once
+        assert all(o is outs[0] for o in outs)
+        rep = devobs.OBS.ledger_report()
+        assert rep["mesh_tables"]["bytes"] == tbytes
+        # eviction frees exactly what was charged: the gauge returns
+        # to zero instead of drifting
+        edops._table_evicted("race-set", entry)
+        rep = devobs.OBS.ledger_report()
+        assert rep["mesh_tables"]["bytes"] == 0
+    finally:
+        devobs.reset()
+        devobs.enable()
+
+
+def test_lockstep_wedge_latches_global_plane_on_first_timeout():
+    """A coordinated (lockstep) launch that wedges past the launch
+    deadline on a multi-process runtime is the global collective's
+    signature hang — a peer never entered, and the worker thread never
+    returns, so verify_batch's exception handler can't latch.  The
+    degrade settle latches on the FIRST such timeout, bounding the
+    job-wide convergence to one hung batch per process instead of one
+    launch deadline per subsequent batch."""
+    import threading as th
+    import unittest.mock as mock
+
+    from tendermint_tpu.libs.metrics import Registry
+
+    cfg = degrade.DegradeConfig()
+    cfg.launch_timeout_s = 0.05
+    rt = degrade.configure(cfg, registry=Registry("mesh_wedge"))
+    release = th.Event()
+
+    def wedged():
+        release.wait(5.0)
+        return np.ones(4, dtype=bool)
+
+    try:
+        with mock.patch.object(sharding.jax, "process_count",
+                               lambda: 2):
+            with sharding._PLANE_LOCK:
+                sharding._GLOBAL_PLANE = None
+            with sharding.lockstep():
+                out = rt.run("batch.ed25519", wedged,
+                             lambda: np.zeros(4, dtype=bool))
+            assert not np.asarray(out).any()       # host fallback
+            assert sharding._GLOBAL_PLANE is False  # first wedge latched
+
+            # a NON-lockstep wedge never touches the global latch
+            with sharding._PLANE_LOCK:
+                sharding._GLOBAL_PLANE = None
+            out = rt.run("batch.ed25519", wedged,
+                         lambda: np.zeros(4, dtype=bool))
+            assert not np.asarray(out).any()
+            assert sharding._GLOBAL_PLANE is None
+    finally:
+        release.set()
+        degrade.reset()
 
 
 def test_chaos_global_plane_seam_fires_before_any_collective():
